@@ -1,0 +1,124 @@
+"""Benchmark: MNIST CNN training throughput, images/sec/chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``value`` is this framework's jitted scan-epoch training throughput on the
+available accelerator(s). ``vs_baseline`` compares against the reference
+implementation's approach — a PyTorch per-batch train loop with the same CNN
+architecture and optimizer, run on the hardware the reference can use here
+(CPU; the reference repo is CUDA-only and publishes no numbers of its own,
+see BASELINE.md) — measured in-process at bench time.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+BATCH = 1024
+BENCH_STEPS = 50
+TORCH_STEPS = 8
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_mnist_tpu.data.mnist import normalize_images, synthetic_dataset
+    from pytorch_distributed_mnist_tpu.models import get_model
+    from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_epoch
+
+    n_chips = jax.device_count()
+    mesh = make_mesh(("data",)) if n_chips > 1 else None
+    model = get_model("cnn")
+    state = create_train_state(model, jax.random.key(0))
+
+    images, labels = synthetic_dataset(BATCH, seed=0)
+    x = normalize_images(images)
+    y = labels.astype(np.int32)
+
+    def stacked(steps):
+        return {
+            "image": jnp.broadcast_to(x, (steps,) + x.shape),
+            "label": jnp.broadcast_to(y, (steps,) + y.shape),
+        }
+
+    epoch = make_train_epoch(mesh)
+    batches = stacked(BENCH_STEPS)
+    # Warmup with the SAME shape so the timed region is compile-free.
+    state, m = epoch(state, batches)
+    float(m.count)  # full host roundtrip: remote execution definitely done
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, m = epoch(state, batches)
+        assert float(m.count) == BATCH * BENCH_STEPS  # sync point
+        best = min(best, time.perf_counter() - t0)
+    return BATCH * BENCH_STEPS / best / n_chips
+
+
+def bench_torch_reference() -> float:
+    """Reference-style per-batch torch loop (same CNN, Adam), CPU."""
+    import torch
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    torch.set_num_threads(max(1, torch.get_num_threads()))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(1, 32, 3, padding=1)
+            self.conv2 = tnn.Conv2d(32, 64, 3, padding=1)
+            self.fc1 = tnn.Linear(64 * 14 * 14, 128)
+            self.fc2 = tnn.Linear(128, 10)
+
+        def forward(self, x):
+            x = F.relu(self.conv1(x))
+            x = F.relu(self.conv2(x))
+            x = F.max_pool2d(x, 2)
+            x = x.flatten(1)
+            return self.fc2(F.relu(self.fc1(x)))
+
+    model = Net()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    bs = 256
+    data = torch.randn(bs, 1, 28, 28)
+    target = torch.randint(0, 10, (bs,))
+    # warmup
+    for _ in range(2):
+        opt.zero_grad()
+        F.cross_entropy(model(data), target).backward()
+        opt.step()
+    t0 = time.perf_counter()
+    for _ in range(TORCH_STEPS):
+        opt.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+        loss.item()  # per-batch host sync, as the reference does (:94)
+    dt = time.perf_counter() - t0
+    return bs * TORCH_STEPS / dt
+
+
+def main() -> None:
+    value = bench_tpu()
+    try:
+        baseline = bench_torch_reference()
+    except Exception:
+        baseline = 0.0
+    vs = value / baseline if baseline > 0 else 0.0
+    print(json.dumps({
+        "metric": "mnist_cnn_train_images_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

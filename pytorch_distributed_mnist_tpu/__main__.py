@@ -1,0 +1,12 @@
+"""``python -m pytorch_distributed_mnist_tpu`` — single entry point.
+
+Replaces the reference's two launch modes selected by editing source
+(``/root/reference/multi_proc_single_gpu.py:353-359``, ``README.md:10-35``):
+on TPU the runtime is already one process per host, so there is nothing to
+spawn and no ``--local_rank`` to inject.
+"""
+
+from pytorch_distributed_mnist_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

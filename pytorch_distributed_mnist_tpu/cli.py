@@ -1,0 +1,212 @@
+"""CLI + per-process job driver.
+
+Flag and lifecycle parity with the reference's ``__main__`` + ``run``
+(``/root/reference/multi_proc_single_gpu.py:163-255, 288-359``), redesigned
+for the TPU runtime:
+
+- kept flags (same names/defaults): ``--root data``, ``-j/--workers 4``,
+  ``--epochs 20``, ``--start-epoch 0``, ``--batch-size 256``, ``--lr 1e-3``,
+  ``--momentum 0.9``, ``--wd 1e-4``, ``--resume ''``, ``-e/--evaluate``,
+  ``--seed`` (``:289-336``);
+- replaced flags: ``--backend/--init-method/--local_rank/--rank/
+  --world-size`` (torch rendezvous, ``:316-331``) become
+  ``--coordinator/--num-processes/--process-id`` feeding
+  ``jax.distributed.initialize`` — auto-detected on TPU pods, so none are
+  needed in the common case. There is no mode selection by editing source
+  (the reference's spawn-vs-launch comment dance, ``:353-359``);
+- new flags: ``--model`` (the reference hard-codes its model at ``:185``),
+  ``--dataset`` (hard-coded MNIST at ``:137``; BASELINE config 5 needs
+  FashionMNIST), ``--trainer-mode``, ``--profile-dir``, ``--checkpoint-dir``.
+
+Batch-size semantics: the reference's ``--batch-size`` is the per-node total
+divided among that node's GPUs (``:174``, ``:297-300``). Here it is the
+**global** batch divided among all chips by the mesh — the multi-host
+generalization of the same rule, documented instead of implicit.
+
+Lifecycle parity (``run``): distributed init (``:167``), model+optimizer
+(``:185-191``), resume (``:197-214``), loaders (``:218-221``),
+``--evaluate`` short-circuit (``:225-228``), epoch loop with sampler
+reseed + LR step decay + train + eval + best tracking + process-0
+checkpoint (``:230-255``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+from typing import Optional
+
+import jax
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+from pytorch_distributed_mnist_tpu.data.mnist import load_dataset, normalize_images
+from pytorch_distributed_mnist_tpu.models import get_model, list_models
+from pytorch_distributed_mnist_tpu.parallel.distributed import (
+    initialize_distributed,
+    process_count,
+    process_index,
+)
+from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_mnist_tpu.train.checkpoint import save_checkpoint, try_resume
+from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+from pytorch_distributed_mnist_tpu.utils.logging import log0
+from pytorch_distributed_mnist_tpu.utils.profiling import StepTimer, profile_trace
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-mnist",
+        description="TPU-native distributed MNIST training (JAX/XLA/pjit)",
+    )
+    # Reference-parity flags (defaults match :289-336).
+    p.add_argument("--root", type=str, default="data", help="dataset root dir")
+    p.add_argument("-j", "--workers", type=int, default=4,
+                   help="data-loader worker threads (used by the native "
+                        "loader backend when built; no-op otherwise)")
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--start-epoch", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="GLOBAL batch size, split across all chips")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--momentum", type=float, default=0.9, help="for --optimizer sgd")
+    p.add_argument("--wd", "--weight-decay", type=float, default=1e-4,
+                   dest="weight_decay", help="for --optimizer sgd")
+    p.add_argument("--resume", type=str, default="", help="checkpoint path to resume from")
+    p.add_argument("-e", "--evaluate", action="store_true",
+                   help="evaluate on the test set and exit")
+    p.add_argument("--seed", type=int, default=None)
+    # Distributed bootstrap (replaces --backend/--init-method/--rank/--world-size).
+    p.add_argument("--coordinator", type=str, default=None,
+                   help="coordinator address host:port for multi-host runs")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    # TPU-framework extensions.
+    p.add_argument("--model", type=str, default="cnn", choices=list_models())
+    p.add_argument("--dataset", type=str, default="mnist",
+                   choices=["mnist", "fashion_mnist", "synthetic"])
+    p.add_argument("--optimizer", type=str, default="adam", choices=["adam", "sgd"])
+    p.add_argument("--trainer-mode", type=str, default="scan",
+                   choices=["scan", "stepwise", "explicit"])
+    p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="write a jax.profiler trace here")
+    p.add_argument("--synthetic-train-size", type=int, default=60000)
+    p.add_argument("--synthetic-test-size", type=int, default=10000)
+    return p
+
+
+def _build_loaders(args, seed: int):
+    name = "mnist" if args.dataset == "synthetic" else args.dataset
+    synthesize = args.dataset == "synthetic"
+
+    def load_split(train: bool):
+        n = args.synthetic_train_size if train else args.synthetic_test_size
+        if not synthesize:
+            try:
+                return load_dataset(args.root, name, train=train,
+                                    synthesize_if_missing=False)
+            except FileNotFoundError:
+                split = "train" if train else "test"
+                log0(f"WARNING: no {name} {split}-split IDX files under "
+                     f"{args.root!r}; using the synthetic fallback dataset")
+        return load_dataset(args.root, name, train=train,
+                            synthetic_train_size=n, synthetic_test_size=n,
+                            seed=seed)
+
+    train_images, train_labels = load_split(train=True)
+    test_images, test_labels = load_split(train=False)
+    nproc, pid = process_count(), process_index()
+    train_loader = MNISTDataLoader(
+        normalize_images(train_images), train_labels,
+        batch_size=args.batch_size, train=True,
+        num_replicas=nproc, rank=pid, seed=seed,
+    )
+    test_loader = MNISTDataLoader(
+        normalize_images(test_images), test_labels,
+        batch_size=args.batch_size, train=False,
+        num_replicas=nproc, rank=pid, seed=seed,
+        shard=nproc > 1,
+    )
+    return train_loader, test_loader
+
+
+def run(args) -> dict:
+    """Per-process SPMD lifecycle; returns a summary dict for tests/benchmarks."""
+    # Must run before ANY jax call that initializes the backend (including
+    # jax.process_index in log0) — jax.distributed.initialize refuses to run
+    # after backend init, the analog of init_process_group-before-CUDA order.
+    initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+    log0(args)  # startup args print parity (:337)
+    seed = args.seed if args.seed is not None else 0
+    if args.seed is not None:
+        random.seed(args.seed)
+        np.random.seed(args.seed)
+
+    mesh = make_mesh(("data",))
+    log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
+         f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    model = get_model(args.model)
+    state = create_train_state(
+        model, jax.random.key(seed), lr=args.lr,
+        optimizer=args.optimizer, momentum=args.momentum,
+        weight_decay=args.weight_decay,
+    )
+    state, start_epoch, best_acc = try_resume(args.resume, state)
+    resumed = args.resume and start_epoch > 0
+    if not resumed:
+        # Reference precedence (:204): a resumed checkpoint's epoch wins over
+        # the --start-epoch flag; the flag only applies to fresh runs.
+        start_epoch = args.start_epoch
+
+    train_loader, test_loader = _build_loaders(args, seed)
+    trainer = Trainer(state, train_loader, test_loader, mesh=mesh, mode=args.trainer_mode)
+    lr_of = step_decay_schedule(args.lr)
+
+    if args.evaluate:
+        # Short-circuit parity (:225-228).
+        test_loss, test_acc = trainer.evaluate()
+        log0(f"Test Loss: {test_loss}, Test Acc: {test_acc}")
+        return {"test_loss": test_loss.average, "test_acc": test_acc.accuracy,
+                "best_acc": best_acc, "epochs_run": 0}
+
+    timer = StepTimer()
+    history = []
+    with profile_trace(args.profile_dir):
+        for epoch in range(start_epoch, args.epochs):
+            train_loader.set_sample_epoch(epoch)  # per-epoch reshuffle (:231)
+            trainer.state = trainer.state.with_learning_rate(lr_of(epoch))  # (:232)
+            train_loss, train_acc = trainer.train()
+            test_loss, test_acc = trainer.evaluate()
+            timer.tick(len(train_loader) * args.batch_size)
+            log0(f"Epoch: {epoch}/{args.epochs}, lr: {lr_of(epoch):g},"
+                 f" train loss: {train_loss}, train acc: {train_acc},"
+                 f" test loss: {test_loss}, test acc: {test_acc}")
+            is_best = test_acc.accuracy > best_acc  # (:245-246)
+            best_acc = max(test_acc.accuracy, best_acc)
+            save_checkpoint(
+                trainer.state, epoch=epoch, best_acc=best_acc, is_best=is_best,
+                directory=args.checkpoint_dir,
+            )
+            history.append({"epoch": epoch, "train_loss": train_loss.average,
+                            "train_acc": train_acc.accuracy,
+                            "test_loss": test_loss.average,
+                            "test_acc": test_acc.accuracy})
+    ips = timer.images_per_sec
+    log0(f"throughput: {ips:,.0f} images/sec "
+         f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")
+    return {"best_acc": best_acc, "history": history,
+            "images_per_sec": ips,
+            "images_per_sec_per_chip": timer.images_per_sec_per_chip,
+            "epochs_run": len(history)}
+
+
+def main(argv: Optional[list] = None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Model zoo.
+
+The reference hard-codes a single model (``Net``, a ``Linear(784, 10)``,
+``/root/reference/multi_proc_single_gpu.py:119-126``) and constructs it at a
+fixed call site (``:185``). Here the model is pluggable via a registry:
+``linear`` is the exact reference-parity model, ``cnn`` is the small convnet
+required for the >=99% MNIST accuracy target (BASELINE.md north star).
+"""
+
+from pytorch_distributed_mnist_tpu.models.linear import LinearNet
+from pytorch_distributed_mnist_tpu.models.cnn import ConvNet
+from pytorch_distributed_mnist_tpu.models.registry import get_model, register_model, list_models
+
+__all__ = ["LinearNet", "ConvNet", "get_model", "register_model", "list_models"]

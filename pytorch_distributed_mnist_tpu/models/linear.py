@@ -1,0 +1,35 @@
+"""Reference-parity linear model.
+
+Capability parity with ``Net`` in
+``/root/reference/multi_proc_single_gpu.py:119-126``: flatten the 28x28 image
+to 784 features and apply a single dense 784->10 projection (logistic
+regression; no conv, no activation, no dropout). Forward flattening mirrors
+``x.view(x.size(0), -1)`` (``:126``).
+
+TPU notes: the single matmul maps straight onto the MXU; ``compute_dtype``
+defaults to bfloat16 so the MXU runs at full rate, with params kept in
+float32 for a stable optimizer state. Logits are returned in float32 so the
+cross-entropy reduction is accurate.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_mnist_tpu.models.registry import register_model
+
+
+@register_model("linear")
+class LinearNet(nn.Module):
+    """Flatten -> Dense(num_classes)."""
+
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        del train  # no train-time-only behavior (parity: reference has none)
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="fc")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,38 @@
+"""Model registry: name -> constructor.
+
+The reference constructs its model at a hard-coded call site
+(``/root/reference/multi_proc_single_gpu.py:185``); the TPU framework makes
+the model a named, pluggable component so the CLI (``--model``) and tests can
+select architectures without editing source.
+"""
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str) -> Callable:
+    """Class decorator registering a model constructor under ``name``."""
+
+    def wrap(cls):
+        if name in _REGISTRY:
+            raise ValueError(f"model {name!r} already registered")
+        _REGISTRY[name] = cls
+        return cls
+
+    return wrap
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a registered model by name."""
+    try:
+        ctor = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    return ctor(**kwargs)
+
+
+def list_models():
+    return sorted(_REGISTRY)

@@ -1,0 +1,22 @@
+"""Numerical ops: loss functions and on-device metric accumulators."""
+
+from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy, cross_entropy_per_example
+from pytorch_distributed_mnist_tpu.ops.metrics import (
+    Average,
+    Accuracy,
+    MetricState,
+    metrics_init,
+    metrics_update,
+    metrics_merge,
+)
+
+__all__ = [
+    "cross_entropy",
+    "cross_entropy_per_example",
+    "Average",
+    "Accuracy",
+    "MetricState",
+    "metrics_init",
+    "metrics_update",
+    "metrics_merge",
+]

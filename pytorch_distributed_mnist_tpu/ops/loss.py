@@ -1,0 +1,39 @@
+"""Loss functions.
+
+Parity target: ``F.cross_entropy(output, target)`` at
+``/root/reference/multi_proc_single_gpu.py:88`` — softmax cross-entropy over
+integer class targets, *mean*-reduced over the batch. The mean reduction
+matters for distributed semantics: DDP averages gradients across ranks, so a
+per-rank batch-mean loss yields the global-batch-mean gradient. The TPU DP
+step keeps the same convention (see ``parallel/collectives.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-example softmax cross-entropy with integer labels, shape (B,).
+
+    Computed in float32 regardless of the model's compute dtype: the
+    log-sum-exp reduction is the numerically delicate part, and float32 here
+    costs nothing measurable on TPU (the FLOPs live in the matmuls).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return logz - label_logits
+
+
+def cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Mean softmax cross-entropy; with ``mask`` (0/1 per example), a masked
+    mean so padded examples (eval batch padding) contribute nothing."""
+    per_ex = cross_entropy_per_example(logits, labels)
+    if mask is None:
+        return jnp.mean(per_ex)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(per_ex * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,123 @@
+"""On-device metric accumulators.
+
+Parity targets: ``Average`` (``/root/reference/multi_proc_single_gpu.py:28-43``)
+— running weighted mean, ``update(value, n)`` accumulates ``sum += value*n``,
+``count += n``, formatted to 6 decimals — and ``Accuracy`` (``:46-65``) —
+argmax over the class axis, counts ``pred == target``, formatted as percent
+with 2 decimals.
+
+The TPU design differs deliberately from the reference's hot-loop behavior:
+the reference calls ``.item()`` on device tensors every batch (``:94``,
+``:62``), forcing a device->host sync per step. Here the accumulator state
+(``MetricState``) is a pytree of device scalars updated *inside* the jitted
+step; host transfer happens once per epoch when ``Average``/``Accuracy``
+read it out (SURVEY.md section 3.2).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MetricState(NamedTuple):
+    """Device-resident accumulator: weighted loss sum, correct count, count."""
+
+    loss_sum: jnp.ndarray  # f32 scalar: sum of per-example losses
+    correct: jnp.ndarray  # f32 scalar: number of correct predictions
+    count: jnp.ndarray  # f32 scalar: number of examples seen
+
+
+def metrics_init() -> MetricState:
+    zero = jnp.zeros((), jnp.float32)
+    return MetricState(zero, zero, zero)
+
+
+def metrics_update(
+    state: MetricState,
+    loss: jnp.ndarray,
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> MetricState:
+    """Fold one batch into the accumulator (jit-friendly, no host sync).
+
+    ``loss`` is the batch-*mean* loss (as produced by ``ops.loss.cross_entropy``);
+    it is re-weighted by the number of *real* examples exactly like the
+    reference's ``update(loss.item(), data.size(0))`` (``:94``, ``:41-43``).
+    ``mask`` (0/1 per example) excludes eval-padding examples from all three
+    counters, so padded samples are never double-counted — the reference
+    never pads (its test loader just emits a ragged final batch).
+    """
+    if mask is None:
+        n = jnp.asarray(labels.shape[0], jnp.float32)
+        hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    else:
+        mask = mask.astype(jnp.float32)
+        n = jnp.sum(mask)
+        hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32) * mask
+    return MetricState(
+        loss_sum=state.loss_sum + loss.astype(jnp.float32) * n,
+        correct=state.correct + jnp.sum(hit),
+        count=state.count + n,
+    )
+
+
+def metrics_merge(a: MetricState, b: MetricState) -> MetricState:
+    """Combine two accumulators (e.g. across devices after a psum gather)."""
+    return MetricState(a.loss_sum + b.loss_sum, a.correct + b.correct, a.count + b.count)
+
+
+class Average:
+    """Host-side running weighted mean; formatting parity with reference ``Average``.
+
+    ``__str__`` renders the mean to 6 decimal places, matching
+    ``/root/reference/multi_proc_single_gpu.py:34-35``.
+    """
+
+    def __init__(self) -> None:
+        self.sum = 0.0
+        self.count = 0
+
+    @property
+    def average(self) -> float:
+        if self.count == 0:
+            return 0.0
+        return self.sum / self.count
+
+    def update(self, value: float, number: int = 1) -> None:
+        self.sum += float(value) * number
+        self.count += number
+
+    def __str__(self) -> str:
+        return f"{self.average:.6f}"
+
+
+class Accuracy:
+    """Host-side accuracy meter; formatting parity with reference ``Accuracy``.
+
+    ``__str__`` renders a percentage with 2 decimals, matching
+    ``/root/reference/multi_proc_single_gpu.py:52-53``.
+    """
+
+    def __init__(self) -> None:
+        self.correct = 0
+        self.count = 0
+
+    @property
+    def accuracy(self) -> float:
+        if self.count == 0:
+            return 0.0
+        return self.correct / self.count
+
+    def update(self, correct: int, count: int) -> None:
+        self.correct += int(correct)
+        self.count += int(count)
+
+    def update_from_state(self, state: MetricState) -> None:
+        self.correct += int(state.correct)
+        self.count += int(state.count)
+
+    def __str__(self) -> str:
+        return f"{self.accuracy * 100:.2f}%"

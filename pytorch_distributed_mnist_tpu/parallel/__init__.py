@@ -1,0 +1,27 @@
+"""Parallelism layer: device mesh, shardings, collectives, multi-host bootstrap.
+
+This layer replaces the reference's entire distributed stack —
+``dist.init_process_group`` + NCCL + ``DistributedDataParallel``
+(``/root/reference/multi_proc_single_gpu.py:167-168, 188-189, 316-317``) —
+with the TPU-native equivalents: ``jax.distributed.initialize`` for
+multi-host bootstrap, a ``jax.sharding.Mesh`` whose ``data`` axis rides ICI,
+and XLA collectives (``lax.psum``) in place of DDP's bucketed allreduce.
+"""
+
+from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh, data_sharding, replicated_sharding
+from pytorch_distributed_mnist_tpu.parallel.distributed import (
+    initialize_distributed,
+    process_index,
+    process_count,
+    is_distributed,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "initialize_distributed",
+    "process_index",
+    "process_count",
+    "is_distributed",
+]

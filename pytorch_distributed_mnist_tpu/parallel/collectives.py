@@ -1,0 +1,76 @@
+"""Explicit collectives: the shard_map/psum data-parallel step.
+
+This is the TPU-native analog of what ``DistributedDataParallel`` does under
+the hood in the reference (``/root/reference/multi_proc_single_gpu.py:
+188-189``): every backward pass fires a gradient AllReduce (NCCL there, XLA
+``psum`` over the mesh's ``data`` axis here), then each replica applies the
+identical averaged update (``:91-92``).
+
+Two interchangeable implementations of the same semantics live in this
+framework:
+
+- the **auto (GSPMD)** path in ``train/steps.py``: write the global-batch
+  program, give jit the shardings, and XLA's sharding propagation inserts
+  the AllReduce — idiomatic, and what production code should use;
+- the **explicit** path here: ``jax.shard_map`` gives each device its local
+  shard and the gradient reduction is a visible ``lax.pmean`` — the direct
+  DDP translation, kept because it makes the communication auditable and
+  the DDP-equivalence property directly testable (SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
+from pytorch_distributed_mnist_tpu.ops.metrics import MetricState
+
+
+def make_explicit_dp_train_step(mesh: Mesh, axis: str = "data"):
+    """Build a donated, jitted DP train step with an explicit psum.
+
+    Returns ``step(state, batch) -> (state, MetricState)`` where ``batch`` is
+    a dict of global arrays sharded on ``axis`` along dim 0. Inside the
+    per-device body the batch is local; gradients are ``pmean``-ed across the
+    axis exactly as DDP averages rank gradients, so the update equals the
+    global-batch-mean gradient step (reference loss-mean semantics, ``:88``).
+    """
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def sharded_body(state, batch):
+        images, labels = batch["image"], batch["label"]
+        mask = batch.get("mask")
+
+        def loss_fn(params):
+            logits = state.apply_fn(params, images, train=True)
+            return cross_entropy(logits, labels, mask), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        # DDP semantics: average gradients of per-replica mean losses.
+        grads = lax.pmean(grads, axis)
+        new_state = state.apply_gradients(grads)
+        hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        if mask is None:
+            n = jnp.asarray(labels.shape[0], jnp.float32)
+        else:
+            n = jnp.sum(mask.astype(jnp.float32))
+            hit = hit * mask
+        metrics = MetricState(
+            loss_sum=lax.psum(loss * n, axis),
+            correct=lax.psum(jnp.sum(hit), axis),
+            count=lax.psum(n, axis),
+        )
+        return new_state, metrics
+
+    return jax.jit(sharded_body, donate_argnums=(0,))

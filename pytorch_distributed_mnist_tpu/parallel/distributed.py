@@ -1,0 +1,72 @@
+"""Multi-host bootstrap and process-topology probes.
+
+Replaces the reference's rendezvous stack:
+
+- ``dist.init_process_group(backend, init_method='tcp://...', world_size,
+  rank)`` (``/root/reference/multi_proc_single_gpu.py:167-168, 323-331``)
+  becomes ``jax.distributed.initialize(coordinator_address, num_processes,
+  process_id)`` — one process per *host* (SPMD), not per chip.
+- ``distributed_is_initialized()`` (``:21-25``) becomes ``is_distributed()``.
+- There is no backend flag: the mesh is the backend configuration; XLA routes
+  collectives over ICI within a slice and DCN across slices.
+
+All topology access goes through ``process_index()`` / ``process_count()``
+so multi-host shard arithmetic is unit-testable with monkeypatched values
+(SURVEY.md section 4, "multi-host logic").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-host runtime (idempotent).
+
+    With no arguments, auto-detects from the environment the way TPU pods
+    configure it (the analog of ``torch.distributed.launch`` injecting
+    ``--local_rank``, reference ``:319-321``). Explicit arguments mirror the
+    reference's ``--init-method`` / ``--world-size`` / ``--rank`` flags.
+    Single-process runs skip initialization entirely, like the reference's
+    world-size-1 path still calling ``init_process_group`` — except here
+    single-process needs no rendezvous at all.
+    """
+    global _initialized
+    if _initialized:
+        return
+    want_multi = (
+        coordinator_address is not None
+        or (num_processes or 0) > 1
+        or int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
+    )
+    if want_multi:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _initialized = True
+
+
+def is_distributed() -> bool:
+    """True iff more than one host process participates (cf. reference ``:21-25``)."""
+    return process_count() > 1
+
+
+def process_index() -> int:
+    """This host's rank among participating processes."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """Number of participating host processes."""
+    return jax.process_count()

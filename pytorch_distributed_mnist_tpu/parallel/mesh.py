@@ -1,0 +1,53 @@
+"""Device mesh construction and canonical shardings.
+
+The reference's "mesh" is implicit: one process per GPU, rank == device id
+(``/root/reference/multi_proc_single_gpu.py:180-181``), world_size asserted
+== local GPU count (``:351``), and the only parallel axis is data
+(SURVEY.md section 2c). Here the mesh is explicit and N-dimensional from day
+one: data parallelism is ``Mesh(devices, ('data',))``, and adding model/fsdp
+axes later is a ``PartitionSpec`` change, not new machinery.
+
+On TPU, mesh construction uses ``jax.devices()`` in their default order,
+which XLA lays out so that neighboring mesh positions are ICI neighbors —
+the gradient AllReduce over ``data`` therefore rides ICI, not DCN, exactly
+the property NCCL rings give the reference on NVLink.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: Tuple[str, ...] = ("data",),
+    shape: Optional[Tuple[int, ...]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all global devices).
+
+    With the default 1-D ``('data',)`` axes and no shape, every device joins
+    the data axis — the DDP-equivalent topology. Pass e.g.
+    ``axes=('data', 'model'), shape=(4, 2)`` for a 2-D layout.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (devs.size,) if len(axes) == 1 else None
+        if shape is None:
+            raise ValueError("shape is required for multi-axis meshes")
+    if int(np.prod(shape)) != devs.size:
+        raise ValueError(f"mesh shape {shape} != device count {devs.size}")
+    return Mesh(devs.reshape(shape), axes)
+
+
+def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for a batch: leading (batch) dim split across ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for params/opt state: fully replicated (DDP-style weights)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,7 @@
+"""Training engine: state, jitted steps, trainer, LR schedule, checkpointing."""
+
+from pytorch_distributed_mnist_tpu.train.state import TrainState, create_train_state
+from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
+from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+
+__all__ = ["TrainState", "create_train_state", "step_decay_schedule", "Trainer"]

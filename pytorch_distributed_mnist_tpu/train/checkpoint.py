@@ -1,0 +1,132 @@
+"""Checkpoint save / resume.
+
+Schema parity with the reference's richest auxiliary subsystem
+(``/root/reference/multi_proc_single_gpu.py:249-255, 263-271, 197-214``):
+
+- checkpoint dict ``{epoch: epoch+1, state_dict, best_acc, optimizer}``
+  becomes ``{epoch, best_acc}`` metadata + the flattened
+  ``{params, opt_state, step}`` leaf arrays;
+- one file per epoch (``checkpoint_{epoch}.npz``) plus a ``model_best``
+  copy on improvement (``:267-271``; every epoch's file retained, no GC,
+  same as the reference);
+- only process 0 writes (``:248-249``);
+- restore maps the saved arrays onto the *current* mesh: the analog of
+  ``torch.load(map_location=device)`` (``:202``) is ``device_put`` with each
+  leaf's target sharding, which is restore-time resharding — so a run
+  trained on 8 chips restores for single-chip ``--evaluate``
+  (BASELINE.json configs 3-4);
+- writes are atomic (tmp file + ``os.replace``), which the reference is not
+  — a rank killed mid-``torch.save`` leaves a truncated file there.
+
+Format: ``.npz`` (zip of npy arrays) + a JSON sidecar inside the archive —
+no pickle, no framework-versioned opaque bytes; leaves are matched to a
+*template* state at restore time, the same contract as
+``load_state_dict`` needing a constructed model (``:209``).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+CHECKPOINT_DIR = "checkpoints"
+
+
+def _leaves_with_names(tree: Any):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def save_checkpoint(
+    state,
+    *,
+    epoch: int,
+    best_acc: float,
+    is_best: bool,
+    directory: str = CHECKPOINT_DIR,
+    process_index: Optional[int] = None,
+) -> Optional[str]:
+    """Write ``checkpoint_{epoch}.npz`` (+ best copy); returns the path.
+
+    ``epoch`` is stored as ``epoch + 1`` — the reference's convention
+    (``:251``) so resume continues at the *next* epoch (``:204``). Only
+    process 0 writes (``:248-249``); other processes return None.
+    """
+    pid = jax.process_index() if process_index is None else process_index
+    if pid != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    named = _leaves_with_names({"params": state.params, "opt_state": state.opt_state,
+                               "step": state.step})
+    payload: Dict[str, np.ndarray] = {f"leaf_{i}": np.asarray(v) for i, (_, v) in enumerate(named)}
+    meta = {
+        "epoch": epoch + 1,
+        "best_acc": float(best_acc),
+        "leaf_names": [k for k, _ in named],
+        "format_version": 1,
+    }
+    buf = io.BytesIO()
+    np.savez(buf, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8), **payload)
+    path = os.path.join(directory, f"checkpoint_{epoch}.npz")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)  # atomic publish
+    if is_best:
+        best = os.path.join(directory, "model_best.npz")
+        shutil.copyfile(path, best + ".tmp")
+        os.replace(best + ".tmp", best)
+    return path
+
+
+def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
+    """Restore ``(state, start_epoch, best_acc)`` from ``path`` onto ``state``'s shardings.
+
+    ``state`` is the freshly-constructed template (model + optimizer built
+    exactly as at save time — the ``load_state_dict`` contract, ``:209-210``).
+    Each saved leaf is ``device_put`` with the template leaf's sharding:
+    restore-time resharding across mesh shapes.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        saved = [z[f"leaf_{i}"] for i in range(len(meta["leaf_names"]))]
+    tmpl_tree = {"params": state.params, "opt_state": state.opt_state, "step": state.step}
+    flat, treedef = jax.tree_util.tree_flatten(tmpl_tree)
+    if len(flat) != len(saved):
+        raise ValueError(
+            f"{path}: checkpoint has {len(saved)} leaves, current state has "
+            f"{len(flat)} — model/optimizer mismatch"
+        )
+    restored = []
+    for i, (tmpl, arr) in enumerate(zip(flat, saved)):
+        if tuple(np.shape(tmpl)) != arr.shape:
+            raise ValueError(
+                f"{path}: leaf {meta['leaf_names'][i]} shape {arr.shape} != "
+                f"expected {tuple(np.shape(tmpl))}"
+            )
+        arr = arr.astype(np.asarray(tmpl).dtype) if hasattr(tmpl, "dtype") else arr
+        sharding = getattr(tmpl, "sharding", None)
+        restored.append(jax.device_put(arr, sharding) if sharding is not None else arr)
+    tree = jax.tree_util.tree_unflatten(treedef, restored)
+    new_state = state.replace(
+        params=tree["params"], opt_state=tree["opt_state"], step=tree["step"]
+    )
+    return new_state, int(meta["epoch"]), float(meta["best_acc"])
+
+
+def try_resume(path: str, state) -> Tuple[Any, int, float]:
+    """Reference resume policy (``:197-214``): load if the file exists, else
+    warn and continue fresh with ``(state, 0, 0.0)``."""
+    if path and os.path.isfile(path):
+        state, start_epoch, best_acc = load_checkpoint(path, state)
+        print(f"=> loaded checkpoint '{path}' (epoch {start_epoch})")
+        return state, start_epoch, best_acc
+    if path:
+        print(f"=> no checkpoint found at '{path}'")
+    return state, 0, 0.0

@@ -1,0 +1,22 @@
+"""Learning-rate schedules.
+
+Parity target: ``adjust_learning_rate`` at
+``/root/reference/multi_proc_single_gpu.py:257-261`` — step decay
+``lr = base_lr * 0.1 ** (epoch // 10)``, applied once per epoch.
+
+The reference mutates optimizer param groups in-place each epoch; the TPU
+design instead passes the epoch's LR into the jitted step through an optax
+``inject_hyperparams`` wrapper, so the step function stays pure and the
+schedule stays a trivially unit-testable function (SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+
+def step_decay_schedule(base_lr: float, decay_factor: float = 0.1, decay_every: int = 10):
+    """Return ``lr(epoch)`` implementing the reference's step decay (``:259``)."""
+
+    def lr(epoch: int) -> float:
+        return base_lr * decay_factor ** (epoch // decay_every)
+
+    return lr

@@ -1,0 +1,162 @@
+"""Jitted train/eval step factories (GSPMD auto-sharded path).
+
+Replaces the reference's per-batch hot loop
+(``/root/reference/multi_proc_single_gpu.py:83-95``): H2D copy, forward,
+``F.cross_entropy``, ``zero_grad``/``backward``/``step``, plus two
+``.item()`` host syncs per batch. Here the whole of that is ONE compiled XLA
+program per batch — forward, loss, backward, gradient AllReduce (inserted by
+sharding propagation), Adam update, and metric accumulation fused together,
+with the input state donated so parameter buffers are updated in place.
+
+``make_train_epoch`` goes further than the reference can: it ``lax.scan``s
+the step over an epoch's worth of pre-staged batches, so an entire epoch is
+a single device program with zero host round-trips (SURVEY.md section 3.2
+names the reference's per-batch ``.item()`` syncs as the anti-pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
+from pytorch_distributed_mnist_tpu.ops.metrics import MetricState, metrics_init, metrics_update
+
+
+def _train_step(state, batch):
+    """One optimizer step on one (global) batch. Pure; jitted by the factory."""
+    mask = batch.get("mask")
+
+    def loss_fn(params):
+        logits = state.apply_fn(params, batch["image"], train=True)
+        return cross_entropy(logits, batch["label"], mask), logits
+
+    (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+    new_state = state.apply_gradients(grads)
+    metrics = metrics_update(metrics_init(), loss, logits, batch["label"], mask)
+    return new_state, metrics
+
+
+def _eval_step(state, batch):
+    """Forward + metrics, no gradient (reference ``evaluate``, ``:99-116``).
+
+    The batch's validity mask keeps padded examples out of the counts, so a
+    sharded eval reports exact whole-dataset metrics (the reference instead
+    evaluates the full set redundantly on every rank, ``:143-144``)."""
+    mask = batch.get("mask")
+    logits = state.apply_fn(state.params, batch["image"], train=False)
+    loss = cross_entropy(logits, batch["label"], mask)
+    return metrics_update(metrics_init(), loss, logits, batch["label"], mask)
+
+
+def _shardings(mesh: Optional[Mesh], axis: str):
+    if mesh is None:
+        return None, None
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(axis))
+    return repl, data
+
+
+def make_train_step(mesh: Optional[Mesh] = None, axis: str = "data"):
+    """Jitted ``step(state, batch) -> (state, MetricState)``.
+
+    With a mesh: state replicated, batch sharded on ``axis`` — XLA's sharding
+    propagation turns the gradient reduction into an AllReduce over ICI, the
+    TPU equivalent of DDP's NCCL allreduce (``:188-189``). Without a mesh:
+    plain single-device jit (the reference's world-size-1 mode).
+    """
+    repl, data = _shardings(mesh, axis)
+    if mesh is None:
+        return jax.jit(_train_step, donate_argnums=(0,))
+    # ``data`` is a prefix sharding: every batch leaf shards on dim 0.
+    return jax.jit(
+        _train_step,
+        donate_argnums=(0,),
+        in_shardings=(repl, data),
+        out_shardings=(repl, repl),
+    )
+
+
+def make_eval_step(mesh: Optional[Mesh] = None, axis: str = "data"):
+    """Jitted ``step(state, batch) -> MetricState`` (no state update).
+
+    Unlike the reference — where every rank redundantly evaluates the full
+    test set because the test loader never gets a ``DistributedSampler``
+    (``:143-144``, SURVEY.md section 3.3) — the eval batch is sharded across
+    the mesh too, and the counts reduce with the same AllReduce machinery.
+    """
+    repl, data = _shardings(mesh, axis)
+    if mesh is None:
+        return jax.jit(_eval_step)
+    return jax.jit(
+        _eval_step,
+        in_shardings=(repl, data),
+        out_shardings=repl,
+    )
+
+
+def make_train_epoch(mesh: Optional[Mesh] = None, axis: str = "data"):
+    """Jitted ``epoch(state, batches) -> (state, MetricState)`` via lax.scan.
+
+    ``batches`` is a dict of arrays with a leading steps axis:
+    ``image: (S, B, ...)``, ``label: (S, B)``; the batch axis B is sharded on
+    the mesh. The whole epoch runs as one XLA program — S fused train steps
+    with on-device metric accumulation, one host sync at the end.
+    """
+
+    def epoch(state, batches):
+        def body(carry, batch):
+            state, acc = carry
+            state, m = _train_step(state, batch)
+            acc = MetricState(
+                acc.loss_sum + m.loss_sum,
+                acc.correct + m.correct,
+                acc.count + m.count,
+            )
+            return (state, acc), None
+
+        (state, acc), _ = lax.scan(body, (state, metrics_init()), batches)
+        return state, acc
+
+    repl, _ = _shardings(mesh, axis)
+    if mesh is None:
+        return jax.jit(epoch, donate_argnums=(0,))
+    batch_shard = NamedSharding(mesh, P(None, axis))  # (steps, batch, ...) prefix
+    return jax.jit(
+        epoch,
+        donate_argnums=(0,),
+        in_shardings=(repl, batch_shard),
+        out_shardings=(repl, repl),
+    )
+
+
+def make_eval_epoch(mesh: Optional[Mesh] = None, axis: str = "data"):
+    """Jitted ``epoch(state, batches) -> MetricState`` via lax.scan."""
+
+    def epoch(state, batches):
+        def body(acc, batch):
+            m = _eval_step(state, batch)
+            return (
+                MetricState(
+                    acc.loss_sum + m.loss_sum,
+                    acc.correct + m.correct,
+                    acc.count + m.count,
+                ),
+                None,
+            )
+
+        acc, _ = lax.scan(body, metrics_init(), batches)
+        return acc
+
+    repl, _ = _shardings(mesh, axis)
+    if mesh is None:
+        return jax.jit(epoch)
+    batch_shard = NamedSharding(mesh, P(None, axis))
+    return jax.jit(
+        epoch,
+        in_shardings=(repl, batch_shard),
+        out_shardings=repl,
+    )

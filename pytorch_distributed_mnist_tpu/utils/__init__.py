@@ -1,0 +1,6 @@
+"""Utilities: rank-aware logging, profiling, seeding."""
+
+from pytorch_distributed_mnist_tpu.utils.logging import log0, get_logger
+from pytorch_distributed_mnist_tpu.utils.profiling import StepTimer, profile_trace
+
+__all__ = ["log0", "get_logger", "StepTimer", "profile_trace"]

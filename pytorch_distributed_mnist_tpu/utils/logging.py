@@ -1,0 +1,34 @@
+"""Rank-aware logging.
+
+The reference prints epoch metrics from **every** rank
+(``/root/reference/multi_proc_single_gpu.py:238-242``), so a 4-GPU run
+prints everything 4 times. Here the default is process-0-only printing
+(SURVEY.md section 5 observability note); ``all_ranks=True`` restores the
+reference behavior for debugging.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+
+def log0(*args, all_ranks: bool = False, **kwargs) -> None:
+    """print() from process 0 only (or all ranks when asked)."""
+    if all_ranks or jax.process_index() == 0:
+        print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+def get_logger(name: str = "tpu_mnist") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            logging.Formatter("%(asctime)s [p%(process)d] %(levelname)s %(message)s")
+        )
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    return logger

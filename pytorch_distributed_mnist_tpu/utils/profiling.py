@@ -1,0 +1,62 @@
+"""Profiling hooks.
+
+The reference has none — ``time`` is imported but never used
+(``/root/reference/multi_proc_single_gpu.py:5``; SURVEY.md section 5
+"Tracing/profiling: ABSENT"). The TPU build reports steps/sec and
+images/sec/chip (the BASELINE.md metric) and can capture an XLA profiler
+trace for xprof/tensorboard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+class StepTimer:
+    """Wall-clock throughput meter: images/sec and images/sec/chip."""
+
+    def __init__(self, num_chips: Optional[int] = None) -> None:
+        self.num_chips = num_chips or jax.device_count()
+        self.reset()
+
+    def reset(self) -> None:
+        self.images = 0
+        self.steps = 0
+        self._start = time.perf_counter()
+
+    def tick(self, batch_size: int) -> None:
+        self.images += batch_size
+        self.steps += 1
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._start
+
+    @property
+    def images_per_sec(self) -> float:
+        return self.images / max(self.elapsed, 1e-9)
+
+    @property
+    def images_per_sec_per_chip(self) -> float:
+        return self.images_per_sec / self.num_chips
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self.steps / max(self.elapsed, 1e-9)
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: Optional[str]):
+    """Capture a jax.profiler trace to ``logdir`` when set; no-op otherwise."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,48 @@
+"""Test environment: 8 virtual CPU devices, hermetic and TPU-free.
+
+Must run before jax initializes its backend, hence env vars at module import
+(pytest imports conftest before test modules). This is the simulated-mesh
+strategy from SURVEY.md section 4: ``xla_force_host_platform_device_count=8``
+lets every mesh/psum/sharded-loader property run on CPU without a pod.
+"""
+
+import os
+
+# Force CPU even when the environment points JAX at a real accelerator
+# (e.g. JAX_PLATFORMS=axon): the test suite must be hermetic and see exactly
+# 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# Some environments ship a jax plugin that force-writes jax_platforms on
+# import (overriding JAX_PLATFORMS); write it back before any backend
+# initializes so the suite really runs on the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+
+    from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+
+    assert jax.device_count() == 8, "virtual 8-device CPU mesh not active"
+    return make_mesh(("data",))
+
+
+@pytest.fixture(scope="session")
+def tiny_data():
+    """Small deterministic synthetic dataset, normalized, shared across tests."""
+    from pytorch_distributed_mnist_tpu.data.mnist import normalize_images, synthetic_dataset
+
+    images, labels = synthetic_dataset(512, seed=42)
+    return normalize_images(images), labels.astype(np.int32)

@@ -1,0 +1,98 @@
+"""Checkpoint save/restore: schema parity (epoch+1, best_acc), atomicity,
+resharding restore, missing-file policy (reference ``:197-214, 249-271``)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.mesh import replicated_sharding
+from pytorch_distributed_mnist_tpu.train.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    try_resume,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+
+def fresh_state(seed=0):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    return create_train_state(model, jax.random.key(seed))
+
+
+def test_round_trip_bitwise(tmp_path, tiny_data):
+    state = fresh_state()
+    step = make_train_step()
+    images, labels = tiny_data
+    batch = {"image": jnp.asarray(images[:32]), "label": jnp.asarray(labels[:32])}
+    for _ in range(3):
+        state, _ = step(state, batch)
+    path = save_checkpoint(state, epoch=2, best_acc=0.5, is_best=True,
+                           directory=str(tmp_path), process_index=0)
+    assert path and os.path.isfile(path)
+
+    template = fresh_state(seed=1)  # different init; must be fully overwritten
+    restored, start_epoch, best_acc = load_checkpoint(path, template)
+    assert start_epoch == 3  # saved as epoch+1 (:251), resume at next (:204)
+    assert best_acc == 0.5
+    assert int(restored.step) == 3
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_best_copy_written_only_when_best(tmp_path):
+    state = fresh_state()
+    save_checkpoint(state, epoch=0, best_acc=0.1, is_best=False,
+                    directory=str(tmp_path), process_index=0)
+    assert not os.path.exists(tmp_path / "model_best.npz")
+    save_checkpoint(state, epoch=1, best_acc=0.2, is_best=True,
+                    directory=str(tmp_path), process_index=0)
+    assert os.path.exists(tmp_path / "model_best.npz")
+    assert os.path.exists(tmp_path / "checkpoint_0.npz")  # per-epoch files kept
+
+
+def test_nonzero_process_does_not_write(tmp_path):
+    state = fresh_state()
+    out = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=True,
+                          directory=str(tmp_path / "p1"), process_index=1)
+    assert out is None
+    assert not os.path.exists(tmp_path / "p1")
+
+
+def test_try_resume_missing_file_continues_fresh(capsys):
+    state = fresh_state()
+    s2, epoch, best = try_resume("/nonexistent/ckpt.npz", state)
+    assert epoch == 0 and best == 0.0 and s2 is state
+    assert "no checkpoint found" in capsys.readouterr().out
+
+
+def test_restore_onto_mesh_resharding(tmp_path, mesh8):
+    """Train-on-N -> restore replicated on a mesh (BASELINE configs 3-4)."""
+    state = fresh_state()
+    path = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                           directory=str(tmp_path), process_index=0)
+    template = fresh_state(seed=1)
+    repl = replicated_sharding(mesh8)
+    template = template.replace(
+        params=jax.device_put(template.params, repl),
+        opt_state=jax.device_put(template.opt_state, repl),
+    )
+    restored, _, _ = load_checkpoint(path, template)
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert leaf.sharding.is_equivalent_to(repl, leaf.ndim)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    state = fresh_state()
+    path = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                           directory=str(tmp_path), process_index=0)
+    model = get_model("cnn")
+    cnn_state = create_train_state(model, jax.random.key(0))
+    with pytest.raises(ValueError):
+        load_checkpoint(path, cnn_state)

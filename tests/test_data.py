@@ -1,0 +1,158 @@
+"""Data pipeline: IDX round-trip, synthetic determinism, normalize transform,
+loader sharding/batching (reference ``:129-161``)."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    MNIST_MEAN,
+    MNIST_STD,
+    load_dataset,
+    normalize_images,
+    parse_idx,
+    synthetic_dataset,
+    write_idx,
+)
+
+
+def test_idx_round_trip(tmp_path):
+    arr = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    p = str(tmp_path / "imgs-idx3-ubyte")
+    write_idx(p, arr)
+    np.testing.assert_array_equal(parse_idx(p), arr)
+
+
+def test_idx_gzip(tmp_path):
+    import gzip
+
+    arr = np.arange(100, dtype=np.uint8)
+    raw = str(tmp_path / "x-idx1-ubyte")
+    write_idx(raw, arr)
+    gz = raw + ".gz"
+    with open(raw, "rb") as f, gzip.open(gz, "wb") as g:
+        g.write(f.read())
+    np.testing.assert_array_equal(parse_idx(gz), arr)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(b"\xff\xff\xff\xff garbage")
+    with pytest.raises(ValueError, match="not an IDX file"):
+        parse_idx(p)
+
+
+def test_synthetic_deterministic_and_shaped():
+    a_imgs, a_lbls = synthetic_dataset(64, seed=7)
+    b_imgs, b_lbls = synthetic_dataset(64, seed=7)
+    np.testing.assert_array_equal(a_imgs, b_imgs)
+    np.testing.assert_array_equal(a_lbls, b_lbls)
+    assert a_imgs.shape == (64, 28, 28) and a_imgs.dtype == np.uint8
+    assert set(np.unique(a_lbls)) <= set(range(10))
+    c_imgs, _ = synthetic_dataset(64, seed=8)
+    assert not np.array_equal(a_imgs, c_imgs)
+
+
+def test_load_dataset_prefers_real_idx(tmp_path):
+    imgs = np.full((10, 28, 28), 7, np.uint8)
+    lbls = np.arange(10, dtype=np.uint8) % 10
+    d = tmp_path / "mnist"
+    d.mkdir()
+    write_idx(str(d / "train-images-idx3-ubyte"), imgs)
+    write_idx(str(d / "train-labels-idx1-ubyte"), lbls)
+    got_imgs, got_lbls = load_dataset(str(tmp_path), "mnist", train=True)
+    np.testing.assert_array_equal(got_imgs, imgs)
+    np.testing.assert_array_equal(got_lbls, lbls)
+
+
+def test_load_dataset_missing_raises_when_no_fallback(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_dataset(str(tmp_path), "mnist", train=True, synthesize_if_missing=False)
+
+
+def test_load_dataset_train_test_disjoint_seeds(tmp_path):
+    tr, _ = load_dataset(str(tmp_path), train=True, synthetic_train_size=32)
+    te, _ = load_dataset(str(tmp_path), train=False, synthetic_test_size=32)
+    assert not np.array_equal(tr[:32], te[:32])
+
+
+def test_normalize_parity_with_reference_transform():
+    imgs = np.zeros((2, 28, 28), np.uint8)
+    imgs[0, 0, 0] = 255
+    x = normalize_images(imgs)
+    assert x.shape == (2, 28, 28, 1) and x.dtype == np.float32
+    np.testing.assert_allclose(x[1, 0, 0, 0], (0.0 - MNIST_MEAN) / MNIST_STD, rtol=1e-6)
+    np.testing.assert_allclose(x[0, 0, 0, 0], (1.0 - MNIST_MEAN) / MNIST_STD, rtol=1e-6)
+
+
+def _loader(n=100, bs=20, **kw):
+    images = np.arange(n, dtype=np.float32)[:, None, None, None] * np.ones((1, 28, 28, 1), np.float32)
+    labels = np.arange(n) % 10
+    return MNISTDataLoader(images, labels, batch_size=bs, **kw)
+
+
+def test_loader_batches_cover_shard():
+    loader = _loader(n=100, bs=20, train=True)
+    batches = list(loader)
+    assert len(batches) == 5 == len(loader)
+    seen = np.concatenate([b["image"][:, 0, 0, 0].astype(int) for b in batches])
+    assert sorted(seen.tolist()) == list(range(100))
+
+
+def test_loader_train_drops_ragged_batch():
+    loader = _loader(n=110, bs=20, train=True)
+    assert loader.steps_per_epoch == 5  # 110 // 20, ragged 10 dropped
+
+
+def test_loader_eval_pads_ragged_batch():
+    loader = _loader(n=110, bs=20, train=False)
+    assert loader.steps_per_epoch == 6  # ceil: every sample evaluated
+
+
+def test_loader_global_batch_split_across_processes():
+    l0 = _loader(n=64, bs=16, train=True, num_replicas=4, rank=0)
+    assert l0.local_batch_size == 4
+    shards = []
+    for r in range(4):
+        lr_ = _loader(n=64, bs=16, train=True, num_replicas=4, rank=r)
+        lr_.set_sample_epoch(3)
+        shards.append(np.concatenate([b["image"][:, 0, 0, 0].astype(int) for b in lr_]))
+    allidx = np.concatenate(shards)
+    assert sorted(allidx.tolist()) == list(range(64))  # joint exact cover
+
+
+def test_loader_epoch_reshuffle():
+    loader = _loader(n=100, bs=20, train=True)
+    loader.set_sample_epoch(0)
+    e0 = np.concatenate([b["label"] for b in loader])
+    loader.set_sample_epoch(1)
+    e1 = np.concatenate([b["label"] for b in loader])
+    assert not np.array_equal(e0, e1)
+
+
+def test_loader_eval_not_sharded_by_default():
+    # Reference parity: test loader never gets a DistributedSampler (:143-144).
+    loader = _loader(n=100, bs=20, train=False, num_replicas=4, rank=2)
+    seen = np.concatenate([b["image"][:, 0, 0, 0].astype(int) for b in loader])
+    assert sorted(seen.tolist()) == list(range(100))  # full set on every rank
+
+
+def test_loader_eval_sharded_when_asked():
+    shards = []
+    for r in range(4):
+        loader = _loader(n=100, bs=20, train=False, num_replicas=4, rank=r, shard=True)
+        shards.append(np.concatenate([b["image"][:, 0, 0, 0].astype(int) for b in loader]))
+    assert len(set(np.concatenate(shards).tolist())) == 100
+
+
+def test_loader_rejects_indivisible_batch():
+    with pytest.raises(ValueError, match="not divisible"):
+        _loader(n=64, bs=10, train=True, num_replicas=4, rank=0)
+
+
+def test_stacked_epoch_shapes():
+    loader = _loader(n=100, bs=20, train=True)
+    ep = loader.stacked_epoch()
+    assert ep["image"].shape == (5, 20, 28, 28, 1)
+    assert ep["label"].shape == (5, 20)

@@ -1,0 +1,87 @@
+"""Regression tests for review findings: eval padding must not double-count,
+empty loaders must not crash, start-epoch precedence, sampler pad masks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+from pytorch_distributed_mnist_tpu.data.sampler import DistributedShardSampler
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+
+
+def _loader(images, labels, bs, **kw):
+    return MNISTDataLoader(images, labels, batch_size=bs, **kw)
+
+
+def test_eval_counts_each_sample_exactly_once():
+    """110 samples, batch 20 -> 6 padded batches, but count must be 110."""
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(110, 28, 28, 1)).astype(np.float32)
+    labels = (np.arange(110) % 10).astype(np.int32)
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    test_loader = _loader(images, labels, 20, train=False)
+    train_loader = _loader(images, labels, 20, train=True)
+    for mode in ("scan", "stepwise"):
+        trainer = Trainer(state, train_loader, test_loader, mode=mode)
+        loss, acc = trainer.evaluate()
+        assert acc.count == 110, mode  # not 120
+        assert loss.count == 110, mode
+
+
+def test_eval_metrics_match_unpadded_truth():
+    """Masked padded eval == direct computation over exactly the 110 samples."""
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(110, 28, 28, 1)).astype(np.float32)
+    labels = (np.arange(110) % 10).astype(np.int32)
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    loader = _loader(images, labels, 20, train=False)
+    trainer = Trainer(state, loader, loader, mode="scan")
+    loss, acc = trainer.evaluate()
+
+    logits = model.apply(state.params, jnp.asarray(images))
+    pred = np.argmax(np.asarray(logits), axis=-1)
+    true_acc = float((pred == labels).mean())
+    np.testing.assert_allclose(acc.accuracy, true_acc, atol=1e-9)
+
+
+def test_sharded_eval_pad_not_counted():
+    """10 samples over 4 replicas: 12 slots, 2 pads -> global count == 10."""
+    total = 0
+    for r in range(4):
+        s = DistributedShardSampler(10, 4, r, shuffle=False)
+        _, valid = s.indices_and_mask()
+        total += int(valid.sum())
+    assert total == 10
+
+
+def test_empty_loader_returns_empty_meters():
+    images = np.zeros((8, 28, 28, 1), np.float32)
+    labels = np.zeros(8, np.int32)
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    # batch 16 > 8 samples with drop_last -> zero steps
+    loader = _loader(images, labels, 16, train=True)
+    assert loader.steps_per_epoch == 0
+    trainer = Trainer(state, loader, loader, mode="stepwise")
+    loss, acc = trainer.train()
+    assert loss.average == 0.0 and acc.count == 0  # no crash
+
+
+def test_start_epoch_flag_vs_resume_precedence(tmp_path):
+    from tests.test_integration import make_args
+    from pytorch_distributed_mnist_tpu.cli import run
+
+    run(make_args(tmp_path, epochs=2))
+    # Checkpoint epoch (2) must win over --start-epoch 0/1.
+    out = run(make_args(tmp_path, epochs=3, start_epoch=1,
+                        resume=str(tmp_path / "ckpt" / "checkpoint_1.npz")))
+    assert [h["epoch"] for h in out["history"]] == [2]
+    # Fresh run: the flag applies.
+    out2 = run(make_args(tmp_path, epochs=3, start_epoch=2,
+                         checkpoint_dir=str(tmp_path / "ckpt2")))
+    assert [h["epoch"] for h in out2["history"]] == [2]

@@ -1,0 +1,23 @@
+"""LR schedule parity: lr = base * 0.1**(epoch // 10)
+(``/root/reference/multi_proc_single_gpu.py:257-261``)."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
+
+
+@pytest.mark.parametrize(
+    "epoch,expected",
+    [(0, 1e-3), (9, 1e-3), (10, 1e-4), (19, 1e-4), (20, 1e-5), (35, 1e-6)],
+)
+def test_step_decay_reference_values(epoch, expected):
+    lr = step_decay_schedule(1e-3)
+    np.testing.assert_allclose(lr(epoch), expected, rtol=1e-12)
+
+
+def test_custom_decay():
+    lr = step_decay_schedule(0.1, decay_factor=0.5, decay_every=2)
+    assert lr(0) == 0.1
+    assert lr(2) == 0.05
+    assert lr(4) == 0.025

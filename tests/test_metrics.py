@@ -1,0 +1,62 @@
+"""Unit tests for ops/metrics.py — parity with reference Average/Accuracy
+(``/root/reference/multi_proc_single_gpu.py:28-65``)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.ops.metrics import (
+    Accuracy,
+    Average,
+    metrics_init,
+    metrics_merge,
+    metrics_update,
+)
+
+
+def test_average_weighted_mean_and_format():
+    m = Average()
+    m.update(2.0, 3)  # sum=6, count=3
+    m.update(4.0, 1)  # sum=10, count=4
+    assert m.average == 2.5
+    assert str(m) == "2.500000"  # 6-decimal format parity (:34-35)
+
+
+def test_average_empty_is_zero():
+    assert Average().average == 0.0
+
+
+def test_accuracy_percent_format():
+    a = Accuracy()
+    a.update(3, 4)
+    assert a.accuracy == 0.75
+    assert str(a) == "75.00%"  # percent 2-decimal parity (:52-53)
+
+
+def test_metric_state_update_matches_host_math():
+    ms = metrics_init()
+    logits = jnp.array([[2.0, 0.0], [0.0, 2.0], [2.0, 0.0]])
+    labels = jnp.array([0, 1, 1])  # preds: 0,1,0 -> 2 correct
+    ms = metrics_update(ms, jnp.asarray(0.5), logits, labels)
+    assert float(ms.count) == 3
+    assert float(ms.correct) == 2
+    np.testing.assert_allclose(float(ms.loss_sum), 1.5)
+
+
+def test_metrics_merge_adds():
+    a = metrics_update(metrics_init(), jnp.asarray(1.0), jnp.ones((2, 3)), jnp.zeros(2, jnp.int32))
+    b = metrics_update(metrics_init(), jnp.asarray(2.0), jnp.ones((4, 3)), jnp.zeros(4, jnp.int32))
+    m = metrics_merge(a, b)
+    assert float(m.count) == 6
+    np.testing.assert_allclose(float(m.loss_sum), 1.0 * 2 + 2.0 * 4)
+
+
+def test_accuracy_from_state():
+    ms = metrics_update(
+        metrics_init(),
+        jnp.asarray(0.0),
+        jnp.array([[1.0, 0.0], [1.0, 0.0]]),
+        jnp.array([0, 1]),
+    )
+    a = Accuracy()
+    a.update_from_state(ms)
+    assert a.accuracy == 0.5

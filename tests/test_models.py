@@ -1,0 +1,42 @@
+"""Model zoo: shapes, registry, dtype policy."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_mnist_tpu.models import ConvNet, LinearNet, get_model, list_models
+
+
+def test_registry_contains_both():
+    assert "linear" in list_models() and "cnn" in list_models()
+
+
+def test_registry_unknown_raises():
+    with pytest.raises(ValueError, match="unknown model"):
+        get_model("resnet9000")
+
+
+@pytest.mark.parametrize("name", ["linear", "cnn"])
+@pytest.mark.parametrize("shape", [(4, 28, 28, 1), (4, 28, 28), (4, 784)])
+def test_forward_shapes(name, shape):
+    model = get_model(name)
+    x = jnp.zeros(shape, jnp.float32)
+    params = model.init(jax.random.key(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32  # logits in f32 for stable xent
+
+
+def test_linear_param_count_matches_reference_net():
+    # Reference Net = Linear(784, 10): 784*10 weights + 10 bias (:123).
+    model = LinearNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 784)))
+    n = sum(p.size for p in jax.tree.leaves(params))
+    assert n == 784 * 10 + 10
+
+
+def test_cnn_is_bigger_than_linear():
+    cnn = ConvNet()
+    params = cnn.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
+    n = sum(p.size for p in jax.tree.leaves(params))
+    assert n > 100_000  # conv + dense stack for the 99% target

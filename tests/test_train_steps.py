@@ -1,0 +1,144 @@
+"""Train/eval step engine: loss decreases, DP equivalence (the DDP test),
+scan epoch == stepwise epoch, explicit shard_map == GSPMD auto."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.collectives import make_explicit_dp_train_step
+from pytorch_distributed_mnist_tpu.parallel.mesh import data_sharding, make_mesh
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import (
+    make_eval_step,
+    make_train_epoch,
+    make_train_step,
+)
+
+
+def fresh_state(model_name="linear", lr=1e-3):
+    model = get_model(model_name, compute_dtype=jnp.float32)  # f32 for exact tests
+    return create_train_state(model, jax.random.key(0), lr=lr)
+
+
+def batch_of(tiny_data, start, n):
+    images, labels = tiny_data
+    return {"image": jnp.asarray(images[start : start + n]),
+            "label": jnp.asarray(labels[start : start + n])}
+
+
+def test_loss_decreases_single_device(tiny_data):
+    state = fresh_state()
+    step = make_train_step()
+    batch = batch_of(tiny_data, 0, 64)
+    losses = []
+    for _ in range(20):
+        state, m = step(state, batch)
+        losses.append(float(m.loss_sum) / float(m.count))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_step_counter_increments(tiny_data):
+    state = fresh_state()
+    step = make_train_step()
+    state, _ = step(state, batch_of(tiny_data, 0, 32))
+    state, _ = step(state, batch_of(tiny_data, 32, 32))
+    assert int(state.step) == 2
+
+
+def test_dp_equivalence_8dev_vs_1dev(tiny_data, mesh8):
+    """N-device DP step == single-device step on the same global batch.
+
+    This is the DDP-equivalence property from SURVEY.md section 7 item 3: the
+    reference gets it from DDP allreduce; here sharding propagation must
+    produce the identical update.
+    """
+    batch = batch_of(tiny_data, 0, 128)
+
+    s1 = fresh_state()
+    step1 = make_train_step()
+    s1, m1 = step1(s1, batch)
+
+    s8 = fresh_state()
+    step8 = make_train_step(mesh8)
+    gbatch = {k: jax.device_put(v, data_sharding(mesh8)) for k, v in batch.items()}
+    s8, m8 = step8(s8, gbatch)
+
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(float(m1.loss_sum), float(m8.loss_sum), rtol=1e-5)
+    assert float(m1.count) == float(m8.count) == 128
+
+
+def test_explicit_shard_map_matches_auto(tiny_data, mesh8):
+    """shard_map+psum step produces the same update as the GSPMD auto step."""
+    batch = batch_of(tiny_data, 0, 128)
+    gbatch = {k: jax.device_put(v, data_sharding(mesh8)) for k, v in batch.items()}
+
+    sa = fresh_state()
+    auto = make_train_step(mesh8)
+    sa, ma = auto(sa, gbatch)
+
+    se = fresh_state()
+    explicit = make_explicit_dp_train_step(mesh8)
+    gbatch2 = {k: jax.device_put(v, data_sharding(mesh8)) for k, v in batch.items()}
+    se, me = explicit(se, gbatch2)
+
+    for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(se.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(float(ma.correct), float(me.correct))
+    np.testing.assert_allclose(
+        float(ma.loss_sum) / float(ma.count), float(me.loss_sum) / float(me.count), rtol=1e-5
+    )
+
+
+def test_scan_epoch_matches_stepwise(tiny_data):
+    images, labels = tiny_data
+    nsteps, bs = 4, 32
+    batches = {
+        "image": jnp.asarray(images[: nsteps * bs]).reshape(nsteps, bs, 28, 28, 1),
+        "label": jnp.asarray(labels[: nsteps * bs]).reshape(nsteps, bs),
+    }
+    s_scan = fresh_state()
+    epoch = make_train_epoch()
+    s_scan, m_scan = epoch(s_scan, batches)
+
+    s_step = fresh_state()
+    step = make_train_step()
+    total = None
+    for i in range(nsteps):
+        b = {"image": batches["image"][i], "label": batches["label"][i]}
+        s_step, m = step(s_step, b)
+        total = m if total is None else type(m)(
+            total.loss_sum + m.loss_sum, total.correct + m.correct, total.count + m.count
+        )
+    for a, b in zip(jax.tree.leaves(s_scan.params), jax.tree.leaves(s_step.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(float(m_scan.loss_sum), float(total.loss_sum), rtol=1e-5)
+
+
+def test_eval_step_does_not_train(tiny_data):
+    state = fresh_state()
+    ev = make_eval_step()
+    batch = batch_of(tiny_data, 0, 64)
+    before = jax.tree.map(np.asarray, state.params)
+    m = ev(state, batch)
+    after = jax.tree.map(np.asarray, state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    assert float(m.count) == 64
+
+
+def test_lr_injection_changes_update_magnitude(tiny_data):
+    batch = batch_of(tiny_data, 0, 64)
+    step = make_train_step()
+
+    def update_norm(lr):
+        s = fresh_state(lr=1e-3).with_learning_rate(lr)
+        p0 = jax.tree.map(np.asarray, s.params)
+        s, _ = step(s, batch)
+        deltas = jax.tree.map(lambda a, b: np.abs(np.asarray(a) - b).sum(), s.params, p0)
+        return sum(jax.tree.leaves(deltas))
+
+    assert update_norm(1e-2) > update_norm(1e-4) * 5
