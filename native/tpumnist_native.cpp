@@ -1,0 +1,178 @@
+// Native data-path backend for the TPU MNIST framework.
+//
+// The reference gets its native data machinery from torch's DataLoader
+// worker processes (multi_proc_single_gpu.py:156 num_workers) and
+// torchvision's C IO. This library is the TPU framework's first-party
+// equivalent: IDX parsing (raw + gzip), uint8->normalized-float32 transform,
+// and epoch batch gathering, all multithreaded over a caller-chosen worker
+// count (the CLI's -j/--workers flag).
+//
+// Exposed as a plain C ABI consumed from Python via ctypes (no pybind11 in
+// this environment). Buffer-returning calls allocate with malloc; the caller
+// must release with tm_free.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// Run body(start, end) over [0, n) split across `workers` threads.
+void parallel_for(int64_t n, int workers, void (*body)(int64_t, int64_t, void*),
+                  void* ctx) {
+  if (workers < 1) workers = 1;
+  if (workers == 1 || n < 1024) {
+    body(0, n, ctx);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    int64_t start = w * chunk;
+    int64_t end = start + chunk < n ? start + chunk : n;
+    if (start >= end) break;
+    threads.emplace_back(body, start, end, ctx);
+  }
+  for (auto& t : threads) t.join();
+}
+
+bool read_file(const char* path, std::vector<uint8_t>& out) {
+  size_t len = strlen(path);
+  bool gz = len > 3 && strcmp(path + len - 3, ".gz") == 0;
+  if (gz) {
+    gzFile f = gzopen(path, "rb");
+    if (!f) return false;
+    uint8_t buf[1 << 16];
+    int n;
+    while ((n = gzread(f, buf, sizeof(buf))) > 0) out.insert(out.end(), buf, buf + n);
+    gzclose(f);
+    return n == 0;
+  }
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    fclose(f);
+    return false;
+  }
+  out.resize(size);
+  bool ok = fread(out.data(), 1, size, f) == static_cast<size_t>(size);
+  fclose(f);
+  return ok;
+}
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) | (uint32_t(p[2]) << 8) |
+         uint32_t(p[3]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Load a uint8 IDX file (raw or .gz) in ONE read+inflate pass.
+// On success returns a malloc'd payload buffer (release with tm_free),
+// fills dims[0..*ndim) and *count. Returns nullptr on any error: unreadable
+// file, bad magic, non-uint8 dtype, ndim > max_dims, or truncated payload.
+uint8_t* tm_idx_load(const char* path, int64_t* dims, int* ndim, int max_dims,
+                     int64_t* count) {
+  std::vector<uint8_t> data;
+  if (!read_file(path, data) || data.size() < 4) return nullptr;
+  if (data[0] != 0 || data[1] != 0 || data[2] != 0x08) return nullptr;
+  int nd = data[3];
+  if (nd > max_dims) return nullptr;
+  size_t header = 4 + size_t(4) * nd;
+  if (data.size() < header) return nullptr;
+  int64_t total = 1;
+  for (int i = 0; i < nd; ++i) {
+    dims[i] = be32(&data[4 + 4 * i]);
+    total *= dims[i];
+  }
+  if (data.size() - header < size_t(total)) return nullptr;
+  uint8_t* out = static_cast<uint8_t*>(malloc(total > 0 ? total : 1));
+  if (!out) return nullptr;
+  memcpy(out, data.data() + header, total);
+  *ndim = nd;
+  *count = total;
+  return out;
+}
+
+void tm_free(void* p) { free(p); }
+
+struct NormCtx {
+  const uint8_t* in;
+  float* out;
+  float scale;   // 1 / (255 * std)
+  float offset;  // -mean / std
+};
+
+// out[i] = (in[i]/255 - mean) / std, multithreaded.
+int tm_normalize(const uint8_t* in, float* out, int64_t n, float mean,
+                 float stddev, int workers) {
+  NormCtx ctx{in, out, 1.0f / (255.0f * stddev), -mean / stddev};
+  parallel_for(
+      n, workers,
+      [](int64_t start, int64_t end, void* p) {
+        auto* c = static_cast<NormCtx*>(p);
+        for (int64_t i = start; i < end; ++i)
+          c->out[i] = float(c->in[i]) * c->scale + c->offset;
+      },
+      &ctx);
+  return 0;
+}
+
+struct GatherCtx {
+  const float* images;    // (N, row) flattened
+  const int32_t* labels;  // (N,)
+  const int64_t* indices; // (M,)
+  float* out_images;      // (M, row)
+  int32_t* out_labels;    // (M,)
+  int64_t row;
+  int64_t n;
+  std::atomic<bool> oob{false};
+};
+
+// Gather rows: out_images[j] = images[indices[j]], multithreaded over j.
+// This is the epoch-staging hot path (stacked_epoch): one pass builds the
+// (steps*batch, row) array fed to the device in a single transfer.
+int tm_gather(const float* images, const int32_t* labels, const int64_t* indices,
+              int64_t m, int64_t row, int64_t n, float* out_images,
+              int32_t* out_labels, int workers) {
+  GatherCtx ctx;
+  ctx.images = images;
+  ctx.labels = labels;
+  ctx.indices = indices;
+  ctx.out_images = out_images;
+  ctx.out_labels = out_labels;
+  ctx.row = row;
+  ctx.n = n;
+  parallel_for(
+      m, workers,
+      [](int64_t start, int64_t end, void* p) {
+        auto* c = static_cast<GatherCtx*>(p);
+        for (int64_t j = start; j < end; ++j) {
+          int64_t src = c->indices[j];
+          if (src < 0 || src >= c->n) {
+            c->oob.store(true, std::memory_order_relaxed);
+            continue;
+          }
+          memcpy(c->out_images + j * c->row, c->images + src * c->row,
+                 c->row * sizeof(float));
+          c->out_labels[j] = c->labels[src];
+        }
+      },
+      &ctx);
+  return ctx.oob.load(std::memory_order_relaxed) ? -1 : 0;
+}
+
+int tm_version() { return 2; }
+
+}  // extern "C"

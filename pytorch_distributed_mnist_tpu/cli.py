@@ -120,14 +120,14 @@ def _build_loaders(args, seed: int):
     test_images, test_labels = load_split(train=False)
     nproc, pid = process_count(), process_index()
     train_loader = MNISTDataLoader(
-        normalize_images(train_images), train_labels,
+        normalize_images(train_images, workers=args.workers), train_labels,
         batch_size=args.batch_size, train=True,
-        num_replicas=nproc, rank=pid, seed=seed,
+        num_replicas=nproc, rank=pid, seed=seed, workers=args.workers,
     )
     test_loader = MNISTDataLoader(
-        normalize_images(test_images), test_labels,
+        normalize_images(test_images, workers=args.workers), test_labels,
         batch_size=args.batch_size, train=False,
-        num_replicas=nproc, rank=pid, seed=seed,
+        num_replicas=nproc, rank=pid, seed=seed, workers=args.workers,
         shard=nproc > 1,
     )
     return train_loader, test_loader

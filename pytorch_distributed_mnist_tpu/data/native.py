@@ -1,0 +1,146 @@
+"""ctypes binding for the native C++ data backend (``native/``).
+
+The reference leans on torch's native DataLoader workers for its host-side
+data path (``/root/reference/multi_proc_single_gpu.py:156``); this module is
+the TPU framework's first-party equivalent: IDX parsing (raw + gzip),
+normalize, and epoch gather run in multithreaded C++ when
+``libtpumnist_native.so`` is built (``make -C native``), with the worker
+count coming from the CLI's ``-j/--workers`` flag. Every entry point has a
+pure-NumPy fallback in ``data/mnist.py`` / ``data/loader.py``; the native
+path is an optimization, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_NAME = "libtpumnist_native.so"
+
+
+def _find_library() -> Optional[str]:
+    override = os.environ.get("TPU_MNIST_NATIVE_LIB")
+    candidates = [override] if override else []
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(os.path.dirname(here))
+    candidates += [
+        os.path.join(repo_root, "native", _LIB_NAME),
+        os.path.join(here, _LIB_NAME),
+    ]
+    for c in candidates:
+        if c and os.path.isfile(c):
+            return c
+    return None
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _find_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.tm_idx_load.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.tm_idx_load.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.tm_free.restype = None
+    lib.tm_free.argtypes = [ctypes.c_void_p]
+    lib.tm_normalize.restype = ctypes.c_int
+    lib.tm_normalize.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+    ]
+    lib.tm_gather.restype = ctypes.c_int
+    lib.tm_gather.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ]
+    lib.tm_version.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_idx(path: str) -> Optional[np.ndarray]:
+    """Native IDX parse (uint8 only), one read+inflate pass; None if
+    unavailable or unsupported (the NumPy path then produces the real error)."""
+    lib = _load()
+    if lib is None:
+        return None
+    dims = (ctypes.c_int64 * 8)()
+    ndim = ctypes.c_int(0)
+    count = ctypes.c_int64(0)
+    buf = lib.tm_idx_load(path.encode(), dims, ctypes.byref(ndim), 8,
+                          ctypes.byref(count))
+    if not buf:
+        return None
+    try:
+        shape = tuple(int(dims[i]) for i in range(ndim.value))
+        arr = np.ctypeslib.as_array(buf, shape=(int(count.value),)).copy()
+    finally:
+        lib.tm_free(buf)
+    return arr.reshape(shape)
+
+
+def normalize_images(images: np.ndarray, mean: float, std: float,
+                     workers: int = 4) -> Optional[np.ndarray]:
+    """Native (x/255 - mean)/std; returns (N,28,28,1) f32 or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    flat = np.ascontiguousarray(images, np.uint8).reshape(-1)
+    out = np.empty(flat.size, np.float32)
+    lib.tm_normalize(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size, mean, std, workers,
+    )
+    return out.reshape(images.shape + (1,))
+
+
+def gather_epoch(
+    images: np.ndarray, labels: np.ndarray, index_matrix: np.ndarray,
+    workers: int = 4,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native stacked-epoch gather: images (N, ...) f32, labels (N,) i32,
+    index_matrix (S, B) -> ((S, B, ...) images, (S, B) labels), or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    images = np.ascontiguousarray(images, np.float32)
+    labels = np.ascontiguousarray(labels, np.int32)
+    idx = np.ascontiguousarray(index_matrix, np.int64).reshape(-1)
+    row = int(np.prod(images.shape[1:]))
+    out_images = np.empty((idx.size, row), np.float32)
+    out_labels = np.empty(idx.size, np.int32)
+    rc = lib.tm_gather(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idx.size, row, images.shape[0],
+        out_images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        workers,
+    )
+    if rc != 0:
+        return None
+    shape = index_matrix.shape + images.shape[1:]
+    return out_images.reshape(shape), out_labels.reshape(index_matrix.shape)

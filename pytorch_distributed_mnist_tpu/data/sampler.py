@@ -1,0 +1,88 @@
+"""Distributed shard sampler.
+
+Semantic parity with ``torch.utils.data.DistributedSampler`` as the
+reference uses it (``/root/reference/multi_proc_single_gpu.py:143-144,
+159-161``):
+
+- each of ``num_replicas`` participants gets a **disjoint** 1/num_replicas
+  shard of the dataset;
+- shards are padded (by wrapping from the front) so every replica sees the
+  same number of samples — required so every device runs the same number of
+  steps (in SPMD, a replica running an extra step would deadlock the
+  collective, the same way an extra NCCL allreduce hangs DDP);
+- per-epoch reshuffle via ``set_epoch(epoch)``: the permutation is seeded
+  with ``seed + epoch``, deterministic but different each epoch (``:159-161``
+  calls this from the job driver at ``:231``);
+- with ``shuffle=False`` the order is sequential (the reference's test
+  loader path, ``:148-149``).
+
+Pure index arithmetic over (dataset_len, num_replicas, rank) — unit-testable
+without any devices (SURVEY.md section 4 "multi-host logic").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DistributedShardSampler:
+    """Disjoint per-replica index shards with epoch-seeded reshuffle."""
+
+    def __init__(
+        self,
+        dataset_len: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ) -> None:
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_len = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = -(-dataset_len // num_replicas)  # ceil
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle for ``epoch`` (parity: sampler.set_epoch, ``:161``)."""
+        self.epoch = epoch
+
+    def indices(self) -> np.ndarray:
+        """This replica's index shard for the current epoch."""
+        return self.indices_and_mask()[0]
+
+    def indices_and_mask(self):
+        """(indices, valid) for this replica; ``valid`` is 0.0 on pad entries.
+
+        Pad entries exist when the dataset size is not divisible by
+        ``num_replicas`` (wrap-padding, torch DistributedSampler policy).
+        torch counts the duplicates in eval; the mask lets this framework
+        report exact whole-dataset metrics instead.
+        """
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(self.dataset_len)
+        else:
+            order = np.arange(self.dataset_len)
+        valid = np.ones(self.dataset_len, np.float32)
+        if self.drop_last:
+            order = order[: self.total_size]
+            valid = valid[: self.total_size]
+        elif self.total_size > self.dataset_len:
+            pad = self.total_size - self.dataset_len
+            order = np.concatenate([order, order[:pad]])
+            valid = np.concatenate([valid, np.zeros(pad, np.float32)])
+        sl = slice(self.rank, self.total_size, self.num_replicas)
+        return order[sl], valid[sl]
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,135 @@
+"""Native C++ data backend: builds from source, then must agree bit-for-bit
+with the NumPy fallback path (same contract, different engine)."""
+
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data import native
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    MNIST_MEAN,
+    MNIST_STD,
+    synthetic_dataset,
+    write_idx,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_library():
+    if not native.available():
+        if shutil.which("make") is None or shutil.which("g++") is None:
+            pytest.skip("no native toolchain")
+        import pytorch_distributed_mnist_tpu as pkg
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(pkg.__file__)))
+        subprocess.run(["make", "-C", os.path.join(root, "native")], check=True)
+        native._lib = None  # force re-probe
+    assert native.available()
+
+
+def test_version():
+    assert native._load().tm_version() == 2
+
+
+def test_parse_idx_zero_length_dim(tmp_path):
+    # (0, 28, 28): empty file must parse to an empty array, not crash.
+    arr = np.zeros((0, 28, 28), np.uint8)
+    p = str(tmp_path / "empty-idx3-ubyte")
+    write_idx(p, arr)
+    got = native.parse_idx(p)
+    assert got is not None and got.shape == (0, 28, 28)
+
+
+def test_parse_idx_truncated_payload(tmp_path):
+    # Header promises more bytes than the file holds -> clean None.
+    import struct
+
+    p = str(tmp_path / "trunc-idx3-ubyte")
+    with open(p, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", 100, 28, 28))
+        f.write(b"\x00" * 10)  # far short of 100*28*28
+    assert native.parse_idx(p) is None
+
+
+def test_parse_idx_huge_ndim_byte(tmp_path):
+    # data[3]=0xFF on a short file: must return None, not read out of bounds.
+    p = str(tmp_path / "badndim")
+    with open(p, "wb") as f:
+        f.write(b"\x00\x00\x08\xff\x01")
+    assert native.parse_idx(p) is None
+
+
+def test_parse_idx_matches_numpy(tmp_path):
+    arr = np.random.default_rng(0).integers(0, 256, (7, 28, 28)).astype(np.uint8)
+    p = str(tmp_path / "imgs-idx3-ubyte")
+    write_idx(p, arr)
+    got = native.parse_idx(p)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_parse_idx_gzip(tmp_path):
+    import gzip
+
+    arr = np.arange(256, dtype=np.uint8)
+    raw = str(tmp_path / "x-idx1-ubyte")
+    write_idx(raw, arr)
+    with open(raw, "rb") as f, gzip.open(raw + ".gz", "wb") as g:
+        g.write(f.read())
+    np.testing.assert_array_equal(native.parse_idx(raw + ".gz"), arr)
+
+
+def test_parse_idx_bad_file_returns_none(tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(b"\x01\x02garbage")
+    assert native.parse_idx(p) is None
+
+
+def test_normalize_matches_numpy():
+    images, _ = synthetic_dataset(257, seed=3)
+    got = native.normalize_images(images, MNIST_MEAN, MNIST_STD, workers=4)
+    want = (images.astype(np.float32) / 255.0 - MNIST_MEAN) / MNIST_STD
+    np.testing.assert_allclose(got, want[..., None], rtol=1e-6, atol=1e-7)
+
+
+def test_gather_matches_numpy_fancy_indexing():
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(50, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, 50).astype(np.int32)
+    idx = rng.integers(0, 50, (4, 8))
+    got_imgs, got_lbls = native.gather_epoch(images, labels, idx, workers=3)
+    np.testing.assert_array_equal(got_imgs, images[idx.reshape(-1)].reshape(4, 8, 28, 28, 1))
+    np.testing.assert_array_equal(got_lbls, labels[idx.reshape(-1)].reshape(4, 8))
+
+
+def test_gather_out_of_bounds_returns_none():
+    images = np.zeros((5, 2), np.float32)
+    labels = np.zeros(5, np.int32)
+    idx = np.array([[0, 99]])
+    assert native.gather_epoch(images, labels, idx) is None
+
+
+def test_loader_native_and_numpy_stacked_epoch_agree():
+    from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+    from pytorch_distributed_mnist_tpu.data.mnist import normalize_images
+
+    images, labels = synthetic_dataset(120, seed=5)
+    x = normalize_images(images)
+    loader = MNISTDataLoader(x, labels.astype(np.int32), batch_size=32, train=True, seed=9)
+    loader.set_sample_epoch(2)
+    ep_native = loader.stacked_epoch()
+
+    lib, native._lib = native._lib, None  # simulate missing library
+    try:
+        import unittest.mock as mock
+
+        with mock.patch.object(native, "_find_library", return_value=None):
+            ep_numpy = loader.stacked_epoch()
+    finally:
+        native._lib = lib
+    for k in ("image", "label", "mask"):
+        np.testing.assert_array_equal(ep_native[k], ep_numpy[k])
