@@ -1,0 +1,122 @@
+"""Patch-transformer (ViT) model family with pluggable attention.
+
+The reference's zoo is exactly one hard-coded ``Linear(784, 10)``
+(``/root/reference/multi_proc_single_gpu.py:119-126, 185``). This framework
+treats the model as a registry entry (SURVEY.md section 0) and carries a
+small vision transformer in addition to ``linear``/``cnn`` — it is the model
+that actually has a sequence axis, so it is the vehicle for the
+sequence-parallel machinery (``parallel/ring.py``, ``parallel/ulysses.py``):
+``tests/test_vit.py`` trains it with ring attention swapped in (gradients
+flow through shard_map + ppermute) and checks ring/dense forward parity.
+
+TPU notes: bfloat16 compute / float32 params and logits (same policy as
+``models/cnn.py``); token count is (28/patch)^2 (49 for the default patch 4) —
+tiny for MNIST, but the code path is the same one a long-context model
+takes, just with T larger and the ``seq`` axis sharded wider.
+
+``attention_fn`` is a static module field: any ``(q, k, v) -> o`` on
+``(B, T, H, D)``. Default is dense ``ops.attention.full_attention``; pass
+``partial(ring_attention, mesh=mesh)`` (or the Ulysses variant) to make
+every block's attention sequence-parallel with no other model change.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_mnist_tpu.models.registry import register_model
+from pytorch_distributed_mnist_tpu.ops.attention import full_attention
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """QKV projection -> pluggable core attention -> output projection."""
+
+    num_heads: int
+    attention_fn: Optional[Callable] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, c = x.shape
+        h = self.num_heads
+        assert c % h == 0, f"embed dim {c} not divisible by heads {h}"
+        d = c // h
+        qkv = nn.Dense(3 * c, dtype=self.compute_dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, h, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attend = self.attention_fn or full_attention
+        o = attend(q, k, v)  # (B, T, H, D)
+        o = o.reshape(b, t, c).astype(self.compute_dtype)
+        return nn.Dense(c, dtype=self.compute_dtype, name="proj")(o)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: LN -> MHSA -> residual; LN -> MLP -> residual."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    attention_fn: Optional[Callable] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln1")(x)
+        x = x + MultiHeadSelfAttention(
+            self.num_heads, self.attention_fn, self.compute_dtype, name="attn"
+        )(y)
+        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
+        y = nn.Dense(self.mlp_ratio * c, dtype=self.compute_dtype, name="mlp1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c, dtype=self.compute_dtype, name="mlp2")(y)
+        return x + y
+
+
+@register_model("vit")
+class VisionTransformer(nn.Module):
+    """Small ViT: patchify -> embed (+pos) -> blocks -> LN -> mean-pool -> head."""
+
+    num_classes: int = 10
+    patch_size: int = 4
+    embed_dim: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    attention_fn: Optional[Callable] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        del train
+        # Accept flat (B, 784), (B, 28, 28), or (B, 28, 28, 1) like the other
+        # zoo models, so the same data pipeline feeds all of them.
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        elif x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.compute_dtype)
+        p = self.patch_size
+        b, hh, ww, ch = x.shape
+        gh, gw = hh // p, ww // p
+        # (B, gh, p, gw, p, C) -> (B, gh*gw, p*p*C): non-overlapping patches.
+        x = x.reshape(b, gh, p, gw, p, ch).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(b, gh * gw, p * p * ch)
+        x = nn.Dense(self.embed_dim, dtype=self.compute_dtype, name="embed")(x)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, gh * gw, self.embed_dim),
+        )
+        x = x + pos.astype(self.compute_dtype)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                self.num_heads, self.mlp_ratio, self.attention_fn,
+                self.compute_dtype, name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="head")(x)
+        return x.astype(jnp.float32)

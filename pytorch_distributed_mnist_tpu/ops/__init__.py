@@ -1,4 +1,4 @@
-"""Numerical ops: loss functions and on-device metric accumulators."""
+"""Numerical ops: losses, on-device metric accumulators, attention kernels."""
 
 from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy, cross_entropy_per_example
 from pytorch_distributed_mnist_tpu.ops.metrics import (
@@ -8,6 +8,12 @@ from pytorch_distributed_mnist_tpu.ops.metrics import (
     metrics_init,
     metrics_update,
     metrics_merge,
+)
+from pytorch_distributed_mnist_tpu.ops.attention import (
+    full_attention,
+    online_softmax_block,
+    online_softmax_finish,
+    online_softmax_init,
 )
 
 __all__ = [
@@ -19,4 +25,8 @@ __all__ = [
     "metrics_init",
     "metrics_update",
     "metrics_merge",
+    "full_attention",
+    "online_softmax_block",
+    "online_softmax_finish",
+    "online_softmax_init",
 ]

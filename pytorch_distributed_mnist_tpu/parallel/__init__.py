@@ -15,6 +15,18 @@ from pytorch_distributed_mnist_tpu.parallel.distributed import (
     process_count,
     is_distributed,
 )
+from pytorch_distributed_mnist_tpu.parallel.ring import ring_attention, ring_attention_local
+from pytorch_distributed_mnist_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_local,
+)
+from pytorch_distributed_mnist_tpu.parallel.tensor import (
+    make_tp_eval_step,
+    make_tp_train_step,
+    shard_state,
+    state_shardings,
+    vit_tp_rules,
+)
 
 __all__ = [
     "make_mesh",
@@ -24,4 +36,13 @@ __all__ = [
     "process_index",
     "process_count",
     "is_distributed",
+    "ring_attention",
+    "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
+    "make_tp_eval_step",
+    "make_tp_train_step",
+    "shard_state",
+    "state_shardings",
+    "vit_tp_rules",
 ]

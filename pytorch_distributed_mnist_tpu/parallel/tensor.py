@@ -1,0 +1,107 @@
+"""Tensor parallelism: param-sharding rules over an N-D mesh (GSPMD).
+
+The reference's only strategy is data parallelism — DDP replicates every
+weight (``/root/reference/multi_proc_single_gpu.py:188-189``; SURVEY.md
+section 2c marks TP ABSENT). This framework keeps the mesh N-dimensional so
+TP is a ``PartitionSpec`` change, not new machinery (SURVEY.md section 2c's
+closing note): the functions here produce a sharding pytree for the whole
+``TrainState`` from a small table of path-suffix rules, and a jitted step
+factory whose in/out shardings carry it. XLA's sharding propagation then
+inserts the Megatron-pattern collectives (column-parallel matmul ->
+row-parallel matmul -> AllReduce of the partial sums) over the ``model``
+mesh axis — on TPU these ride ICI next to the data-axis gradient AllReduce.
+
+Rule matching is by the LAST TWO path keys of each leaf (e.g.
+``('qkv', 'kernel')``). Optimizer moments (Adam ``mu``/``nu``) are full
+param-tree replicas inside ``opt_state``, so their leaf paths end with the
+same two keys — one rule table shards params and both moments consistently,
+the property that makes this a ZeRO-free but layout-consistent design.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Megatron-style column->row split for the ViT transformer blocks
+# (models/attention.py): qkv/mlp1 shard their OUTPUT feature dim (column
+# parallel — activations come out head/feature-sharded), proj/mlp2 shard
+# their INPUT dim (row parallel — partial sums AllReduce back to replicated).
+def vit_tp_rules(axis: str = "model") -> Dict[Tuple[str, str], P]:
+    return {
+        ("qkv", "kernel"): P(None, axis),
+        ("qkv", "bias"): P(axis),
+        ("proj", "kernel"): P(axis, None),
+        ("mlp1", "kernel"): P(None, axis),
+        ("mlp1", "bias"): P(axis),
+        ("mlp2", "kernel"): P(axis, None),
+    }
+
+
+def _path_keys(path) -> Tuple[str, ...]:
+    keys = []
+    for entry in path:
+        key = getattr(entry, "key", getattr(entry, "name", None))
+        if key is not None:
+            keys.append(str(key))
+    return tuple(keys)
+
+
+def leaf_spec(path, rules: Dict[Tuple[str, str], P]) -> P:
+    """PartitionSpec for one leaf: match the last two path keys, default P()."""
+    keys = _path_keys(path)
+    return rules.get(tuple(keys[-2:]), P())
+
+
+def state_shardings(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
+    """NamedSharding pytree mirroring ``state`` (params AND optimizer moments).
+
+    Leaves with no matching rule — step counter, hyperparams, Adam ``count``,
+    biases of unsharded layers — replicate, which is exactly the DDP layout
+    the reference uses for everything (``:188-189``).
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, leaf_spec(path, rules)), state
+    )
+
+
+def shard_state(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
+    """Place an (unsharded) TrainState onto the mesh per the rule table."""
+    return jax.device_put(state, state_shardings(state, mesh, rules))
+
+
+def make_tp_train_step(mesh: Mesh, state_sharding, data_axis: str = "data"):
+    """Jitted DP x TP ``step(state, batch) -> (state, MetricState)``.
+
+    Same program as the pure-DP step (``train/steps.py``); only the sharding
+    pytrees differ — state leaves carry their TP layout instead of blanket
+    replication, the batch shards on ``data_axis``, metrics replicate. XLA
+    propagates the rest (column/row-parallel matmul collectives, grad
+    AllReduce over ``data_axis``).
+    """
+    from pytorch_distributed_mnist_tpu.train.steps import _train_step
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(data_axis))
+    return jax.jit(
+        _train_step,
+        donate_argnums=(0,),
+        in_shardings=(state_sharding, data),
+        out_shardings=(state_sharding, repl),
+    )
+
+
+def make_tp_eval_step(mesh: Mesh, state_sharding, data_axis: str = "data"):
+    """Jitted DP x TP ``step(state, batch) -> MetricState``."""
+    from pytorch_distributed_mnist_tpu.train.steps import _eval_step
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(data_axis))
+    return jax.jit(
+        _eval_step,
+        in_shardings=(state_sharding, data),
+        out_shardings=repl,
+    )

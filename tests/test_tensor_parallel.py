@@ -1,0 +1,99 @@
+"""Tensor parallelism on the virtual 8-device mesh.
+
+The strategy checklist (SURVEY.md section 2c) requires only DP for parity,
+but the mesh is N-dimensional by design; these tests pin the property that
+makes TP free to adopt: a DP x TP step is NUMERICALLY EQUIVALENT to the
+single-device step — layout changes, math doesn't.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_mnist_tpu.parallel.tensor import (
+    make_tp_eval_step,
+    make_tp_train_step,
+    shard_state,
+    state_shardings,
+    vit_tp_rules,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(7)
+    return {
+        "image": jnp.asarray(rng.normal(size=(16, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32),
+    }
+
+
+def _f32_vit():
+    return get_model("vit", compute_dtype=jnp.float32)
+
+
+def test_state_shardings_match_rules():
+    mesh = make_mesh(("data", "model"), shape=(4, 2))
+    state = create_train_state(_f32_vit(), jax.random.key(0))
+    sh = state_shardings(state, mesh, vit_tp_rules())
+    qkv = sh.params["params"]["block0"]["attn"]["qkv"]["kernel"]
+    assert qkv.spec == P(None, "model")
+    # Adam moments carry the SAME layout as their params.
+    mu_qkv = sh.opt_state.inner_state[0].mu["params"]["block0"]["attn"]["qkv"]["kernel"]
+    assert mu_qkv.spec == P(None, "model")
+    # Unmatched leaves replicate.
+    assert sh.step.spec == P()
+    assert sh.params["params"]["embed"]["kernel"].spec == P()
+
+
+def test_tp_step_equals_single_device_step(batch):
+    """DP(4) x TP(2) train step == single-device train step (same math).
+
+    SGD optimizer: its update is linear in the gradient, so cross-layout
+    reduction-order noise stays O(1e-7) in the params. (Adam is
+    scale-invariant — a sign flip on a ~0 gradient coordinate moves a param
+    by a full +-lr — so elementwise param equality under Adam is not a
+    meaningful layout test.)
+    """
+    model = _f32_vit()
+    state_1d = create_train_state(model, jax.random.key(0), optimizer="sgd")
+    state_tp = create_train_state(model, jax.random.key(0), optimizer="sgd")
+
+    mesh = make_mesh(("data", "model"), shape=(4, 2))
+    rules = vit_tp_rules()
+    state_tp = shard_state(state_tp, mesh, rules)
+    step_1d = make_train_step()
+    step_tp = make_tp_train_step(mesh, state_shardings(state_tp, mesh, rules))
+
+    for _ in range(3):
+        state_1d, m1 = step_1d(state_1d, batch)
+        state_tp, mt = step_tp(state_tp, batch)
+
+    np.testing.assert_allclose(float(mt.loss_sum), float(m1.loss_sum), rtol=1e-4)
+    assert int(mt.correct) == int(m1.correct)
+    p1 = jax.tree_util.tree_leaves(state_1d.params)
+    pt = jax.tree_util.tree_leaves(jax.device_get(state_tp.params))
+    for a, b in zip(p1, pt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_tp_eval_step_equals_single_device(batch):
+    model = _f32_vit()
+    state = create_train_state(model, jax.random.key(1))
+    mesh = make_mesh(("data", "model"), shape=(2, 4))
+    rules = vit_tp_rules()
+    sstate = shard_state(state, mesh, rules)
+    ev_tp = make_tp_eval_step(mesh, state_shardings(sstate, mesh, rules))
+
+    from pytorch_distributed_mnist_tpu.train.steps import make_eval_step
+
+    m1 = make_eval_step()(state, batch)
+    mt = ev_tp(sstate, batch)
+    np.testing.assert_allclose(float(mt.loss_sum), float(m1.loss_sum), rtol=1e-4)
+    assert int(mt.correct) == int(m1.correct)
