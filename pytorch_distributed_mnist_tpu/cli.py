@@ -85,9 +85,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     # TPU-framework extensions.
     p.add_argument("--model", type=str, default="cnn", choices=list_models())
+    p.add_argument("--attention", type=str, default="dense",
+                   choices=["dense", "flash"],
+                   help="core attention impl for --model vit: dense XLA "
+                        "softmax or the Pallas flash kernel (ring/ulysses "
+                        "sequence parallelism are library APIs, see "
+                        "parallel/ring.py)")
     p.add_argument("--dataset", type=str, default="mnist",
                    choices=["mnist", "fashion_mnist", "synthetic"])
-    p.add_argument("--optimizer", type=str, default="adam", choices=["adam", "sgd"])
+    p.add_argument("--optimizer", type=str, default="adam",
+                   choices=["adam", "adam_pallas", "sgd"],
+                   help="adam_pallas = fused Pallas update kernel")
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
@@ -149,7 +157,20 @@ def run(args) -> dict:
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
          f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
-    model = get_model(args.model)
+    model_kwargs = {}
+    if getattr(args, "attention", "dense") == "flash":
+        from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+
+        model_kwargs["attention_fn"] = flash_attention
+    try:
+        model = get_model(args.model, **model_kwargs)
+    except TypeError:
+        # Capability check by construction, not by model name: any registered
+        # model that takes attention_fn works with --attention flash.
+        raise SystemExit(
+            f"--attention {args.attention} not supported: model "
+            f"{args.model!r} does not accept an attention_fn"
+        )
     state = create_train_state(
         model, jax.random.key(seed), lr=args.lr,
         optimizer=args.optimizer, momentum=args.momentum,

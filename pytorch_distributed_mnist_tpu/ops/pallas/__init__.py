@@ -1,0 +1,25 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference gets its device kernels from cuDNN/cuBLAS through torch ops
+(``/root/reference/multi_proc_single_gpu.py:87-92, 216``; SURVEY.md
+section 2b "Device kernels"). On TPU, XLA compiles the jitted step — these
+hand-written kernels cover the two places a fused kernel beats stock XLA:
+
+- ``fused_adam``: the whole Adam update (moments + bias correction + step)
+  as ONE VMEM-resident pass per parameter instead of XLA's chain of
+  elementwise HLOs — one read and one write of each buffer, pure
+  HBM-bandwidth win on the optimizer, which is the memory-bound part of
+  small-model training.
+- ``flash_attention``: blockwise online-softmax attention that never
+  materializes the (T, T) score matrix in HBM — the long-context hot op;
+  same math as ``ops/attention.py``'s blockwise reference, tiled for the
+  MXU.
+
+Every kernel auto-selects interpret mode off-TPU so the whole suite runs
+hermetically on the virtual CPU mesh (tests/conftest.py).
+"""
+
+from pytorch_distributed_mnist_tpu.ops.pallas.adam import fused_adam_leaf, pallas_adam
+from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+
+__all__ = ["fused_adam_leaf", "pallas_adam", "flash_attention"]

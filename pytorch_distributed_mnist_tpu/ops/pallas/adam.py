@@ -1,0 +1,163 @@
+"""Fused Adam update as a Pallas TPU kernel, wrapped as an optax transform.
+
+The reference's optimizer is ``torch.optim.Adam`` stepped once per batch
+(``/root/reference/multi_proc_single_gpu.py:191, 92``) — a chain of
+elementwise CUDA ops, each reading and writing HBM. Here the whole update
+for a parameter leaf — moment EMAs, bias correction, epsilon-guarded scale
+— is one kernel: every buffer is read once from HBM into VMEM and written
+once, with ``input_output_aliases`` updating the moments in place. On the
+memory-bound optimizer phase this halves-or-better the HBM traffic vs an
+unfused op chain; XLA usually fuses most of it anyway, so the honest win is
+guaranteed fusion + in-place moments, not a 10x.
+
+``pallas_adam`` is a drop-in ``optax.GradientTransformation`` (same state
+shape as ``optax.adam``: count + mu/nu trees) selected by
+``--optimizer adam_pallas`` in the CLI. Off-TPU it runs the same kernel in
+interpreter mode, so CPU tests exercise the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# f32 VPU tile is (8, 128); 128 rows x 128 lanes x 4 B x 7 buffers ~ 0.5 MB
+# of VMEM per grid step — comfortably under the ~16 MB budget.
+_LANES = 128
+_BLOCK_ROWS = 128
+
+
+def _adam_kernel(h_ref, g_ref, m_ref, v_ref, delta_ref, m_out_ref, v_out_ref):
+    """One block: delta = -lr * m_hat / (sqrt(v_hat) + eps); new moments.
+
+    ``h_ref`` (SMEM) holds
+    [lr, b1, b2, eps, 1/bias_corr1, 1/bias_corr2, 1-b1, 1-b2, eps_root].
+    The bias
+    corrections are step-dependent scalars computed in the enclosing jitted
+    graph, so the kernel is step-agnostic; the complements ``1-b`` come
+    precomputed in float64 because rounding ``1 - f32(0.999)`` in-kernel
+    loses ~1e-5 relative vs optax's host-side arithmetic.
+    """
+    lr, b1, b2, eps = h_ref[0], h_ref[1], h_ref[2], h_ref[3]
+    inv_bc1, inv_bc2 = h_ref[4], h_ref[5]
+    c1, c2, eps_root = h_ref[6], h_ref[7], h_ref[8]
+    g = g_ref[:]
+    m = b1 * m_ref[:] + c1 * g
+    v = b2 * v_ref[:] + c2 * g * g
+    m_hat = m * inv_bc1
+    v_hat = v * inv_bc2
+    delta_ref[:] = -lr * m_hat / (jnp.sqrt(v_hat + eps_root) + eps)
+    m_out_ref[:] = m
+    v_out_ref[:] = v
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_adam_leaf(g, m, v, hypers, *, interpret: bool | None = None):
+    """Fused Adam for ONE parameter leaf of any shape/dtype.
+
+    ``hypers``: f32[9] = [lr, b1, b2, eps, 1/bc1, 1/bc2, 1-b1, 1-b2,
+    eps_root]. Returns
+    ``(delta, new_m, new_v)`` with ``delta`` in optax's update convention
+    (add it to the param). The leaf is flattened and zero-padded to a
+    (rows, 128) f32 layout; padded lanes compute garbage that is sliced
+    away (their moments stay zero because their gradients are zero).
+    """
+    if interpret is None:
+        interpret = _should_interpret()
+    shape, dtype = g.shape, g.dtype
+    n = g.size
+    rows = max(1, (n + _LANES - 1) // _LANES)
+    rows = ((rows + _BLOCK_ROWS - 1) // _BLOCK_ROWS) * _BLOCK_ROWS
+    padded = rows * _LANES
+
+    def prep(x):
+        flat = jnp.ravel(x).astype(jnp.float32)
+        return jnp.pad(flat, (0, padded - n)).reshape(rows, _LANES)
+
+    g2, m2, v2 = prep(g), prep(m), prep(v)
+    grid = (rows // _BLOCK_ROWS,)
+    block = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
+    delta, m_new, v_new = pl.pallas_call(
+        _adam_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # hypers, whole array
+            block, block, block,
+        ],
+        out_specs=(block, block, block),
+        out_shape=(out_shape, out_shape, out_shape),
+        input_output_aliases={2: 1, 3: 2},  # m, v updated in place
+        interpret=interpret,
+    )(hypers, g2, m2, v2)
+
+    def unprep(x):
+        return jnp.ravel(x)[:n].reshape(shape).astype(dtype)
+
+    return unprep(delta), unprep(m_new), unprep(v_new)
+
+
+def pallas_adam(
+    learning_rate: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    eps_root: float = 0.0,
+) -> optax.GradientTransformation:
+    """optax transformation: Adam with the fused Pallas update kernel.
+
+    State layout matches ``optax.scale_by_adam`` (count, mu, nu), so
+    checkpoints are interchangeable with the stock ``adam`` optimizer.
+    """
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32), mu=zeros,
+            nu=jax.tree_util.tree_map(jnp.copy, zeros),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        count = optax.safe_increment(state.count)
+        t = count.astype(jnp.float32)
+        hypers = jnp.stack([
+            jnp.asarray(learning_rate, jnp.float32),
+            jnp.asarray(b1, jnp.float32),
+            jnp.asarray(b2, jnp.float32),
+            jnp.asarray(eps, jnp.float32),
+            1.0 / (1.0 - jnp.asarray(b1, jnp.float32) ** t),
+            1.0 / (1.0 - jnp.asarray(b2, jnp.float32) ** t),
+            jnp.asarray(1.0 - b1, jnp.float32),  # complements in f64 first
+            jnp.asarray(1.0 - b2, jnp.float32),
+            jnp.asarray(eps_root, jnp.float32),
+        ])
+        flat_g, treedef = jax.tree_util.tree_flatten(updates)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        out = [fused_adam_leaf(g, m, v, hypers)
+               for g, m, v in zip(flat_g, flat_m, flat_v)]
+        deltas = treedef.unflatten([o[0] for o in out])
+        mu = treedef.unflatten([o[1] for o in out])
+        nu = treedef.unflatten([o[2] for o in out])
+        return deltas, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    # The lr is already applied inside the kernel; the trailing no-op scale
+    # makes the state pytree (ScaleByAdamState, EmptyState) structurally
+    # identical to optax.adam = chain(scale_by_adam, scale(-lr)), so
+    # checkpoints are interchangeable between the two optimizers.
+    return optax.chain(
+        optax.GradientTransformation(init, update), optax.scale(1.0)
+    )

@@ -1,0 +1,153 @@
+"""Flash attention forward kernel (Pallas TPU) with a recompute backward.
+
+Blockwise online-softmax attention: scores are computed tile-by-tile in
+VMEM and never materialized as a (T, T) matrix in HBM — the memory profile
+that makes long context viable (the same recurrence as the pure-jnp
+blockwise op in ``ops/attention.py``, which is this kernel's test oracle;
+the reference repo has no attention at all, SURVEY.md section 2c).
+
+Scope: forward pass as a kernel, tiled (block_q x block_k) with both
+matmuls on the MXU in f32 accumulation. The backward is ``jax.vjp`` of the
+dense reference — i.e. gradients recompute attention with XLA. That keeps
+training correct everywhere while the fwd kernel carries the memory win
+(eval/inference and activation-checkpointed training recompute forwards,
+which is where the kernel runs). A fused flash backward kernel is the
+natural next step and slots into the same ``custom_vjp``.
+
+Composes with the mesh machinery: ``ring_attention_local`` accepts any
+per-block attention update, and this kernel is what a production config
+uses inside each ring step for long sequences.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_mnist_tpu.ops.attention import NEG_INF, full_attention
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, block_q: int):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    t = k_ref.shape[1]
+    nk = t // block_k
+    iq = pl.program_id(1)
+
+    def body(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        if causal:
+            qi = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            ki = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o * corr + pv, m_new, l
+
+    d = q_ref.shape[-1]
+    o = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nk, body, (o, m, l))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pick_block(t: int, target: int = 128) -> int:
+    """Largest divisor of ``t`` that is <= target (tile-friendly when t is)."""
+    b = min(t, target)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float | None,
+                   interpret: bool | None):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t, h, d = q.shape
+    block_q = _pick_block(t)
+    block_k = _pick_block(t)
+    # (B, T, H, D) -> (B*H, T, D): one grid row per batch-head pair.
+    def split(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qh, kh, vh = split(q), split(k), split(v)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal,
+        scale=scale, block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    return _flash_forward(q, k, v, causal, scale, None)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, residuals, g):
+    # Recompute-based backward: differentiate the dense reference (same
+    # math; see module docstring for the tradeoff).
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda a, b_, c: full_attention(a, b_, c, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: float | None = None):
+    """Flash attention on ``(B, T, H, D)``; drop-in for ``full_attention``.
+
+    Differentiable (recompute backward); off-TPU the kernel runs in
+    interpreter mode so tests are hermetic.
+    """
+    return _flash(q, k, v, causal, scale)

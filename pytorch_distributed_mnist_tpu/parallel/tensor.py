@@ -76,32 +76,17 @@ def shard_state(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
 def make_tp_train_step(mesh: Mesh, state_sharding, data_axis: str = "data"):
     """Jitted DP x TP ``step(state, batch) -> (state, MetricState)``.
 
-    Same program as the pure-DP step (``train/steps.py``); only the sharding
-    pytrees differ — state leaves carry their TP layout instead of blanket
-    replication, the batch shards on ``data_axis``, metrics replicate. XLA
-    propagates the rest (column/row-parallel matmul collectives, grad
-    AllReduce over ``data_axis``).
+    Same program as the pure-DP step — this just forwards the TP layout to
+    the shared step factory; XLA propagates the rest (column/row-parallel
+    matmul collectives, grad AllReduce over ``data_axis``).
     """
-    from pytorch_distributed_mnist_tpu.train.steps import _train_step
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
 
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P(data_axis))
-    return jax.jit(
-        _train_step,
-        donate_argnums=(0,),
-        in_shardings=(state_sharding, data),
-        out_shardings=(state_sharding, repl),
-    )
+    return make_train_step(mesh, data_axis, state_sharding=state_sharding)
 
 
 def make_tp_eval_step(mesh: Mesh, state_sharding, data_axis: str = "data"):
     """Jitted DP x TP ``step(state, batch) -> MetricState``."""
-    from pytorch_distributed_mnist_tpu.train.steps import _eval_step
+    from pytorch_distributed_mnist_tpu.train.steps import make_eval_step
 
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P(data_axis))
-    return jax.jit(
-        _eval_step,
-        in_shardings=(state_sharding, data),
-        out_shardings=repl,
-    )
+    return make_eval_step(mesh, data_axis, state_sharding=state_sharding)

@@ -65,6 +65,12 @@ def make_optimizer(
     """
     if optimizer == "adam":
         return optax.inject_hyperparams(optax.adam)(learning_rate=lr)
+    if optimizer == "adam_pallas":
+        # Same state layout as adam (count/mu/nu) but the update is the
+        # fused Pallas kernel (ops/pallas/adam.py) — checkpoint-compatible.
+        from pytorch_distributed_mnist_tpu.ops.pallas.adam import pallas_adam
+
+        return optax.inject_hyperparams(pallas_adam)(learning_rate=lr)
     if optimizer == "sgd":
 
         def sgd_wd(learning_rate):

@@ -60,27 +60,34 @@ def _shardings(mesh: Optional[Mesh], axis: str):
     return repl, data
 
 
-def make_train_step(mesh: Optional[Mesh] = None, axis: str = "data"):
+def make_train_step(
+    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
+):
     """Jitted ``step(state, batch) -> (state, MetricState)``.
 
-    With a mesh: state replicated, batch sharded on ``axis`` — XLA's sharding
-    propagation turns the gradient reduction into an AllReduce over ICI, the
-    TPU equivalent of DDP's NCCL allreduce (``:188-189``). Without a mesh:
-    plain single-device jit (the reference's world-size-1 mode).
+    With a mesh: state replicated (or laid out per ``state_sharding`` — e.g.
+    the tensor-parallel pytree from ``parallel/tensor.py``), batch sharded
+    on ``axis`` — XLA's sharding propagation turns the gradient reduction
+    into an AllReduce over ICI, the TPU equivalent of DDP's NCCL allreduce
+    (``:188-189``). Without a mesh: plain single-device jit (the
+    reference's world-size-1 mode).
     """
     repl, data = _shardings(mesh, axis)
     if mesh is None:
         return jax.jit(_train_step, donate_argnums=(0,))
+    state_sh = repl if state_sharding is None else state_sharding
     # ``data`` is a prefix sharding: every batch leaf shards on dim 0.
     return jax.jit(
         _train_step,
         donate_argnums=(0,),
-        in_shardings=(repl, data),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data),
+        out_shardings=(state_sh, repl),
     )
 
 
-def make_eval_step(mesh: Optional[Mesh] = None, axis: str = "data"):
+def make_eval_step(
+    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
+):
     """Jitted ``step(state, batch) -> MetricState`` (no state update).
 
     Unlike the reference — where every rank redundantly evaluates the full
@@ -91,9 +98,10 @@ def make_eval_step(mesh: Optional[Mesh] = None, axis: str = "data"):
     repl, data = _shardings(mesh, axis)
     if mesh is None:
         return jax.jit(_eval_step)
+    state_sh = repl if state_sharding is None else state_sharding
     return jax.jit(
         _eval_step,
-        in_shardings=(repl, data),
+        in_shardings=(state_sh, data),
         out_shardings=repl,
     )
 
