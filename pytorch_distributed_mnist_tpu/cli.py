@@ -162,15 +162,20 @@ def run(args) -> dict:
         from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
 
         model_kwargs["attention_fn"] = flash_attention
-    try:
-        model = get_model(args.model, **model_kwargs)
-    except TypeError:
-        # Capability check by construction, not by model name: any registered
-        # model that takes attention_fn works with --attention flash.
-        raise SystemExit(
-            f"--attention {args.attention} not supported: model "
-            f"{args.model!r} does not accept an attention_fn"
-        )
+    if not model_kwargs:
+        model = get_model(args.model)
+    else:
+        try:
+            model = get_model(args.model, **model_kwargs)
+        except TypeError:
+            # Capability check by construction, not by model name: any
+            # registered model that takes attention_fn works with
+            # --attention flash. Only attention kwargs are wrapped here, so
+            # an unrelated constructor TypeError surfaces as itself.
+            raise SystemExit(
+                f"--attention {args.attention} not supported: model "
+                f"{args.model!r} does not accept an attention_fn"
+            )
     state = create_train_state(
         model, jax.random.key(seed), lr=args.lr,
         optimizer=args.optimizer, momentum=args.momentum,

@@ -10,12 +10,15 @@ required for the >=99% MNIST accuracy target (BASELINE.md north star).
 from pytorch_distributed_mnist_tpu.models.linear import LinearNet
 from pytorch_distributed_mnist_tpu.models.cnn import ConvNet
 from pytorch_distributed_mnist_tpu.models.attention import VisionTransformer
+from pytorch_distributed_mnist_tpu.models.moe import MoEClassifier, SwitchMoE
 from pytorch_distributed_mnist_tpu.models.registry import get_model, register_model, list_models
 
 __all__ = [
     "LinearNet",
     "ConvNet",
     "VisionTransformer",
+    "MoEClassifier",
+    "SwitchMoE",
     "get_model",
     "register_model",
     "list_models",

@@ -1,0 +1,86 @@
+"""Mixture-of-experts model family (expert parallelism vehicle).
+
+The reference has no experts — its model is one dense layer
+(``/root/reference/multi_proc_single_gpu.py:119-126``; SURVEY.md section 2c
+marks EP/MoE ABSENT). The framework carries a switch-style MoE layer anyway
+because expert parallelism is one of the mesh axes the N-D design supports:
+expert weights carry a leading ``num_experts`` dim that
+``moe_ep_rules`` (parallel/expert.py) shards on the ``expert`` mesh axis,
+and XLA turns the expert-summed combine einsum into an AllReduce over that
+axis — each device computes only its local experts' FLOPs.
+
+Routing is top-1 (switch) with a straight-through mask: every expert's MLP
+runs on every token algebraically, but the one-hot combine zeroes all but
+the routed expert, and under EP sharding each device only materializes its
+own experts' activations. At MNIST scale this dense-dispatch form costs
+little and keeps the math exactly reproducible across mesh shapes (the
+property the EP tests pin); a capacity-factor all_to_all dispatch is the
+long-context-scale variant and slots behind the same module interface.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_mnist_tpu.models.registry import register_model
+
+
+class SwitchMoE(nn.Module):
+    """Top-1-routed mixture of expert MLPs: (B, C) -> (B, C)."""
+
+    num_experts: int = 8
+    hidden: int = 128
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        e, h, c = self.num_experts, self.hidden, x.shape[-1]
+        router = nn.Dense(e, dtype=jnp.float32, name="router")
+        # Router math in f32: top-1 selection is a discrete decision; bf16
+        # logit noise would make routing (and therefore loss) layout-dependent.
+        probs = nn.softmax(router(x.astype(jnp.float32)), axis=-1)  # (B, E)
+        top1 = jnp.argmax(probs, axis=-1)  # (B,)
+        mask = jnp.eye(e, dtype=probs.dtype)[top1]  # (B, E) one-hot
+        gate = (probs * mask).sum(-1, keepdims=True)  # (B, 1) routed prob
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(), (e, c, h))
+        b1 = self.param("b1", nn.initializers.zeros, (e, h))
+        w2 = self.param("w2", nn.initializers.lecun_normal(), (e, h, c))
+        b2 = self.param("b2", nn.initializers.zeros, (e, c))
+        xc = x.astype(self.compute_dtype)
+        # (B, E, H): per-expert hidden; E shards on the 'expert' mesh axis.
+        hdn = nn.relu(
+            jnp.einsum("bc,ech->beh", xc, w1.astype(self.compute_dtype))
+            + b1.astype(self.compute_dtype)
+        )
+        y = (
+            jnp.einsum("beh,ehc->bec", hdn, w2.astype(self.compute_dtype))
+            + b2.astype(self.compute_dtype)
+        )  # (B, E, C)
+        # One-hot combine: the sum over E is the EP AllReduce.
+        out = jnp.einsum("bec,be->bc", y.astype(jnp.float32), mask) * gate
+        return out.astype(x.dtype)
+
+
+@register_model("moe_mlp")
+class MoEClassifier(nn.Module):
+    """flatten -> embed -> residual SwitchMoE -> head (MNIST classifier)."""
+
+    num_classes: int = 10
+    num_experts: int = 8
+    embed_dim: int = 64
+    hidden: int = 128
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        del train
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)  # (B, 784)
+        x = nn.Dense(self.embed_dim, dtype=self.compute_dtype, name="embed")(x)
+        x = nn.relu(x)
+        x = x + SwitchMoE(
+            self.num_experts, self.hidden, self.compute_dtype, name="moe"
+        )(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="head")(x)
+        return x.astype(jnp.float32)

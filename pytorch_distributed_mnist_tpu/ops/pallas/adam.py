@@ -73,10 +73,14 @@ def fused_adam_leaf(g, m, v, hypers, *, interpret: bool | None = None):
     """
     if interpret is None:
         interpret = _should_interpret()
-    shape, dtype = g.shape, g.dtype
+    shape = g.shape
     n = g.size
     rows = max(1, (n + _LANES - 1) // _LANES)
-    rows = ((rows + _BLOCK_ROWS - 1) // _BLOCK_ROWS) * _BLOCK_ROWS
+    # f32 sublane tile is 8 rows; cap the block at 128 rows but don't round
+    # small leaves up to it (a (10,) bias pads to 8x128, not 128x128).
+    rows = ((rows + 7) // 8) * 8
+    block_rows = min(rows, _BLOCK_ROWS)
+    rows = ((rows + block_rows - 1) // block_rows) * block_rows
     padded = rows * _LANES
 
     def prep(x):
@@ -84,8 +88,8 @@ def fused_adam_leaf(g, m, v, hypers, *, interpret: bool | None = None):
         return jnp.pad(flat, (0, padded - n)).reshape(rows, _LANES)
 
     g2, m2, v2 = prep(g), prep(m), prep(v)
-    grid = (rows // _BLOCK_ROWS,)
-    block = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+    grid = (rows // block_rows,)
+    block = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
     delta, m_new, v_new = pl.pallas_call(
@@ -101,10 +105,15 @@ def fused_adam_leaf(g, m, v, hypers, *, interpret: bool | None = None):
         interpret=interpret,
     )(hypers, g2, m2, v2)
 
-    def unprep(x):
+    def unprep(x, dtype):
         return jnp.ravel(x)[:n].reshape(shape).astype(dtype)
 
-    return unprep(delta), unprep(m_new), unprep(v_new)
+    # delta follows the gradient's dtype (optax update convention); moments
+    # keep THEIR dtype — bf16 grads must not demote the f32 mu/nu (the EMA
+    # increments would fall below bf16 resolution and the opt_state dtype
+    # would flip after step 1, retracing the train step).
+    return (unprep(delta, g.dtype), unprep(m_new, m.dtype),
+            unprep(v_new, v.dtype))
 
 
 def pallas_adam(

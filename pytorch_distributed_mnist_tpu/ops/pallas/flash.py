@@ -32,12 +32,17 @@ from pytorch_distributed_mnist_tpu.ops.attention import NEG_INF, full_attention
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, block_q: int):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+                  scale: float, block_q: int, t_real: int):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+
+    ``t_real``: valid sequence length; positions >= t_real are padding
+    introduced to reach a tile-friendly block multiple and are masked out.
+    """
     q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
     t = k_ref.shape[1]
     nk = t // block_k
     iq = pl.program_id(1)
+    masked = causal or t_real < t
 
     def body(j, carry):
         o, m, l = carry
@@ -47,17 +52,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        if causal:
-            qi = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
+        if masked:
             ki = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(qi >= ki, s, NEG_INF)
+            keep = ki < t_real
+            if causal:
+                qi = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                keep &= qi >= ki
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if masked:
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
@@ -75,14 +83,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _pick_block(t: int, target: int = 128) -> int:
-    """Largest divisor of ``t`` that is <= target (tile-friendly when t is)."""
-    b = min(t, target)
-    while t % b:
-        b -= 1
-    return b
-
-
 def _flash_forward(q, k, v, causal: bool, scale: float | None,
                    interpret: bool | None):
     if scale is None:
@@ -90,34 +90,46 @@ def _flash_forward(q, k, v, causal: bool, scale: float | None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, t, h, d = q.shape
-    block_q = _pick_block(t)
-    block_k = _pick_block(t)
-    # (B, T, H, D) -> (B*H, T, D): one grid row per batch-head pair.
+    # Pad T up to a tile-friendly block multiple (never shrink the block to
+    # a divisor of T — a prime T would degrade to block 1); padded K
+    # positions are masked inside the kernel, padded Q rows sliced off.
+    block = 128 if t >= 128 else ((t + 7) // 8) * 8
+    t_pad = ((t + block - 1) // block) * block
+
+    # (B, T, H, D) -> (B*H, Tp, D): one grid row per batch-head pair.
     def split(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+        return x
 
     qh, kh, vh = split(q), split(k), split(v)
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal,
-        scale=scale, block_q=block_q,
+        _flash_kernel, block_k=block, causal=causal,
+        scale=scale, block_q=block, t_real=t,
     )
+    # NOTE: each program holds the full (Tp, D) K and V in VMEM, which caps
+    # the sequence around T ~ 16k at D=64 f32 (~16 MB VMEM budget). Past
+    # that, stream K/V through a third grid dimension — the online-softmax
+    # carry already supports it; the ring (parallel/ring.py) also divides T
+    # by the seq-axis size per device before this kernel sees it.
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(b * h, t_pad // block),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, t_pad, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, t_pad, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
         interpret=interpret,
     )(qh, kh, vh)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -27,6 +27,12 @@ from pytorch_distributed_mnist_tpu.parallel.tensor import (
     state_shardings,
     vit_tp_rules,
 )
+from pytorch_distributed_mnist_tpu.parallel.expert import moe_ep_rules
+from pytorch_distributed_mnist_tpu.parallel.pipeline import (
+    pipeline_apply,
+    sequential_apply,
+    stack_stage_params,
+)
 
 __all__ = [
     "make_mesh",
@@ -45,4 +51,8 @@ __all__ = [
     "shard_state",
     "state_shardings",
     "vit_tp_rules",
+    "moe_ep_rules",
+    "pipeline_apply",
+    "sequential_apply",
+    "stack_stage_params",
 ]

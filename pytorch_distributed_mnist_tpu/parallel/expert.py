@@ -1,0 +1,33 @@
+"""Expert parallelism: sharding rules for MoE expert weights.
+
+SURVEY.md section 2c marks EP ABSENT in the reference; here it is one more
+``PartitionSpec`` table over the same machinery as tensor parallelism
+(``parallel/tensor.py``): expert weights carry a leading ``num_experts``
+dim, the rules shard it on the ``expert`` mesh axis, and the MoE combine
+einsum's sum over experts (``models/moe.py``) becomes XLA's AllReduce over
+that axis — every device computes only its local experts, which is the
+whole point of EP.
+
+Composes with DP the same way TP does: merge the rule dicts and build a
+``('data', 'expert')`` mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+
+def moe_ep_rules(axis: str = "expert") -> Dict[Tuple[str, str], P]:
+    """Path-suffix rules (see ``parallel.tensor.leaf_spec``) for SwitchMoE.
+
+    The router stays replicated — every device must route identically for
+    the one-hot combine to agree.
+    """
+    return {
+        ("moe", "w1"): P(axis, None, None),
+        ("moe", "b1"): P(axis, None),
+        ("moe", "w2"): P(axis, None, None),
+        ("moe", "b2"): P(axis, None),
+    }

@@ -126,10 +126,27 @@ def test_flash_attention_grad_matches_dense():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
-def test_flash_attention_odd_length():
-    """T with no 128-divisor still works via the single-block fallback."""
+@pytest.mark.parametrize("t", [49, 127, 200])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_unaligned_lengths(t, causal):
+    """Odd/prime T pads to a block multiple with masked tail positions."""
     ks = jax.random.split(jax.random.key(5), 3)
-    q, k, v = (jax.random.normal(kk, (1, 49, 4, 16), jnp.float32) for kk in ks)
+    q, k, v = (jax.random.normal(kk, (1, t, 4, 16), jnp.float32) for kk in ks)
     np.testing.assert_allclose(
-        flash_attention(q, k, v), full_attention(q, k, v), rtol=1e-5, atol=1e-5
+        flash_attention(q, k, v, causal=causal),
+        full_attention(q, k, v, causal=causal),
+        rtol=1e-5, atol=1e-5,
     )
+
+
+def test_fused_adam_bf16_grads_keep_f32_moments():
+    """bf16 gradients must not demote the f32 moment buffers."""
+    g = jnp.ones((10,), jnp.bfloat16)
+    m = jnp.zeros((10,), jnp.float32)
+    v = jnp.zeros((10,), jnp.float32)
+    hypers = jnp.asarray(
+        [1e-3, 0.9, 0.999, 1e-8, 10.0, 1000.0, 0.1, 0.001, 0.0], jnp.float32
+    )
+    delta, m1, v1 = fused_adam_leaf(g, m, v, hypers)
+    assert delta.dtype == jnp.bfloat16
+    assert m1.dtype == jnp.float32 and v1.dtype == jnp.float32
