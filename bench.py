@@ -1,64 +1,238 @@
-"""Benchmark: MNIST CNN training throughput, images/sec/chip.
+"""Benchmark: MNIST CNN training throughput, images/sec/chip (+ MFU).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "mfu": ..., "backend": ..., "device_kind": ..., ...}
 
-``value`` is this framework's jitted scan-epoch training throughput on the
-available accelerator(s). ``vs_baseline`` compares against the reference
-implementation's approach — a PyTorch per-batch train loop with the same CNN
-architecture and optimizer, run on the hardware the reference can use here
-(CPU; the reference repo is CUDA-only and publishes no numbers of its own,
-see BASELINE.md) — measured in-process at bench time.
+``value`` is this framework's jitted scan-epoch training throughput.
+``mfu`` is model-FLOPs utilization: (FLOPs/step x steps/sec) / chip peak
+FLOPs, with FLOPs/step taken from the compiled program's own cost analysis
+(falling back to an analytic count for the 2-conv CNN) and the peak from the
+device kind's bf16 spec (the CNN computes in bfloat16, models/cnn.py).
+
+``vs_baseline`` compares against the only baseline measurable here: the
+reference implementation's approach — a PyTorch per-batch train loop with
+the same CNN and optimizer — on the hardware the reference can use in this
+environment (CPU; the reference repo is CUDA-only and publishes no numbers
+of its own, see BASELINE.md). The ``baseline`` field names this so the ratio
+is not mistaken for a like-for-like chip comparison.
+
+Robustness (round-1 postmortem: BENCH_r01.json was rc=1/parsed=null because
+one TPU-init failure escaped as a traceback): the accelerator bench runs in
+a CHILD process with a timeout, retried with backoff; on persistent TPU
+failure it falls back to a CPU-backend run (honestly labelled
+``"backend": "cpu"`` with the TPU error attached); if even that fails the
+parent still exits 0 with an ``{"error": ...}`` JSON line.
 """
 
+from __future__ import annotations
+
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
-
 BATCH = 1024
-BENCH_STEPS = 50
 TORCH_STEPS = 8
 
+# Per-chip peak dense bf16 FLOPs by TPU generation (public spec sheets).
+_PEAK_FLOPS = [
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def bench_tpu() -> float:
+# Analytic fallback: forward FLOPs/image for models/cnn.py (2 MACs per
+# multiply-add), x3 for a training step (fwd + ~2x in bwd).
+_CNN_FWD_FLOPS = (
+    2 * 28 * 28 * 32 * 9 * 1  # conv1
+    + 2 * 28 * 28 * 64 * 9 * 32  # conv2
+    + 2 * (64 * 14 * 14) * 128  # fc1
+    + 2 * 128 * 10  # fc2
+)
+_CNN_STEP_FLOPS_PER_IMAGE = 3 * _CNN_FWD_FLOPS
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def child_bench(steps: int, reps: int) -> dict:
+    """Run the accelerator bench on whatever backend the env selects."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # Some accelerator plugins force-write jax_platforms at import time,
+        # so both the env var (before import) and the config API (after) are
+        # needed — same workaround as tests/conftest.py.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
-    import jax.numpy as jnp
 
-    from pytorch_distributed_mnist_tpu.data.mnist import normalize_images, synthetic_dataset
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_mnist_tpu.data.mnist import (
+        normalize_images,
+        synthetic_dataset,
+    )
     from pytorch_distributed_mnist_tpu.models import get_model
     from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
     from pytorch_distributed_mnist_tpu.train.state import create_train_state
-    from pytorch_distributed_mnist_tpu.train.steps import make_train_epoch
+    from pytorch_distributed_mnist_tpu.train.steps import (
+        make_train_epoch,
+        make_train_step,
+    )
 
     n_chips = jax.device_count()
+    device = jax.devices()[0]
     mesh = make_mesh(("data",)) if n_chips > 1 else None
-    model = get_model("cnn")
+    if device.platform == "cpu":
+        # Fallback mode: bf16 conv is emulated (and awful) on CPU; use f32
+        # and a smaller batch so the fallback finishes in seconds, not
+        # minutes. The TPU path keeps the bf16 MXU configuration.
+        batch = 256
+        model = get_model("cnn", compute_dtype=jnp.float32)
+    else:
+        batch = BATCH
+        model = get_model("cnn")
     state = create_train_state(model, jax.random.key(0))
 
-    images, labels = synthetic_dataset(BATCH, seed=0)
+    images, labels = synthetic_dataset(batch, seed=0)
     x = normalize_images(images)
     y = labels.astype(np.int32)
+    batches = {
+        "image": jnp.broadcast_to(x, (steps,) + x.shape),
+        "label": jnp.broadcast_to(y, (steps,) + y.shape),
+    }
 
-    def stacked(steps):
-        return {
-            "image": jnp.broadcast_to(x, (steps,) + x.shape),
-            "label": jnp.broadcast_to(y, (steps,) + y.shape),
-        }
+    if device.platform == "cpu":
+        # XLA:CPU compiles convolutions inside the scanned while-loop body
+        # to a far slower code path than top-level convs (~30x observed), so
+        # the fallback times the per-batch jitted step instead. On TPU the
+        # scan epoch is the whole point: one device program per epoch, no
+        # host round-trips through the tunnel.
+        one = {"image": jnp.asarray(x), "label": jnp.asarray(y)}
+        step_fn = make_train_step(mesh)
 
-    epoch = make_train_epoch(mesh)
-    batches = stacked(BENCH_STEPS)
-    # Warmup with the SAME shape so the timed region is compile-free.
-    state, m = epoch(state, batches)
+        def run_pass(state):
+            m = None
+            for _ in range(steps):
+                state, m = step_fn(state, one)
+            return state, m
+
+        flops_probe = step_fn.lower(state, one)
+        per_step_scale = 1.0
+    else:
+        epoch_fn = make_train_epoch(mesh)
+
+        def run_pass(state):
+            return epoch_fn(state, batches)
+
+        flops_probe = epoch_fn.lower(state, batches)
+        per_step_scale = float(steps)
+
+    flops_per_step = None
+    try:
+        cost = flops_probe.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        total = float(cost.get("flops", 0.0))
+        if total > 0:
+            flops_per_step = total / per_step_scale
+    except Exception:
+        pass
+    if not flops_per_step:
+        flops_per_step = float(_CNN_STEP_FLOPS_PER_IMAGE * batch)
+
+    # Warmup with the SAME shapes so the timed region is compile-free.
+    state, m = run_pass(state)
     float(m.count)  # full host roundtrip: remote execution definitely done
     best = float("inf")
-    for _ in range(3):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        state, m = epoch(state, batches)
-        assert float(m.count) == BATCH * BENCH_STEPS  # sync point
+        state, m = run_pass(state)
+        assert float(m.count) == batch * (1 if device.platform == "cpu" else steps)
         best = min(best, time.perf_counter() - t0)
-    return BATCH * BENCH_STEPS / best / n_chips
+
+    steps_per_sec = steps / best
+    peak = _peak_flops(device.device_kind)
+    mfu = (flops_per_step * steps_per_sec / n_chips / peak) if peak else None
+    return {
+        "ok": True,
+        "images_per_sec_per_chip": batch * steps / best / n_chips,
+        "steps_per_sec": steps_per_sec,
+        "global_batch": batch,
+        "n_chips": n_chips,
+        "backend": device.platform,
+        "device_kind": device.device_kind,
+        "flops_per_step": flops_per_step,
+        "peak_flops_per_chip": peak,
+        "mfu": mfu,
+    }
+
+
+def _run_child(env_extra: dict, steps: int, reps: int, timeout: float):
+    env = dict(os.environ, **env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             str(steps), str(reps)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"child timed out after {timeout:.0f}s"
+    child_error = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if result.get("ok"):
+                return result, None
+            if child_error is None and result.get("error"):
+                child_error = result["error"]  # the child's own diagnosis
+    if child_error is not None:
+        return None, f"rc={proc.returncode}: {child_error}"
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)
+
+
+def bench_accelerator() -> dict:
+    """TPU child with retry/backoff; CPU-backend fallback; never raises."""
+    errors = []
+    timeouts = (480.0, 720.0)
+    for attempt, timeout in enumerate(timeouts):
+        result, err = _run_child({}, steps=50, reps=3, timeout=timeout)
+        if result:
+            return result
+        errors.append(f"tpu attempt {attempt + 1}: {err}")
+        if attempt + 1 < len(timeouts):  # backoff only between retries
+            time.sleep(15 * (attempt + 1))
+    # This environment has a single host core; keep the CPU fallback tiny so
+    # it finishes inside the timeout (it exists to produce an honest number,
+    # not a fast one).
+    result, err = _run_child(
+        {"BENCH_FORCE_CPU": "1"}, steps=4, reps=2, timeout=900.0
+    )
+    if result:
+        result["tpu_error"] = "; ".join(errors)
+        return result
+    errors.append(f"cpu fallback: {err}")
+    return {"ok": False, "error": "; ".join(errors)}
 
 
 def bench_torch_reference() -> float:
@@ -106,19 +280,47 @@ def bench_torch_reference() -> float:
 
 
 def main() -> None:
-    value = bench_tpu()
+    result = bench_accelerator()
     try:
         baseline = bench_torch_reference()
-    except Exception:
+    except Exception as exc:  # noqa: BLE001 - bench must always emit JSON
         baseline = 0.0
-    vs = value / baseline if baseline > 0 else 0.0
-    print(json.dumps({
+        result.setdefault("notes", []).append(f"torch baseline failed: {exc}")
+
+    out = {
         "metric": "mnist_cnn_train_images_per_sec_per_chip",
-        "value": round(value, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 2),
-    }))
+        "baseline": "torch-CPU per-batch reference loop, same CNN (BASELINE.md)",
+    }
+    if result.get("ok"):
+        value = result["images_per_sec_per_chip"]
+        out["value"] = round(value, 1)
+        out["vs_baseline"] = round(value / baseline, 2) if baseline > 0 else 0.0
+        mfu = result.get("mfu")
+        out["mfu"] = round(mfu, 4) if mfu is not None else None
+        for key in ("backend", "device_kind", "n_chips", "global_batch",
+                    "steps_per_sec", "flops_per_step", "peak_flops_per_chip",
+                    "tpu_error", "notes"):
+            if result.get(key) is not None:
+                val = result[key]
+                out[key] = round(val, 2) if isinstance(val, float) else val
+    else:
+        out["value"] = 0.0
+        out["vs_baseline"] = 0.0
+        out["error"] = result.get("error", "unknown failure")
+    if baseline > 0:
+        out["baseline_images_per_sec"] = round(baseline, 1)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        steps = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+        reps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+        try:
+            print(json.dumps(child_bench(steps, reps)))
+        except Exception as exc:  # noqa: BLE001 - parent parses this
+            print(json.dumps({"ok": False, "error": repr(exc)}))
+            sys.exit(1)
+        sys.exit(0)
     main()

@@ -93,6 +93,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "parallel/ring.py)")
     p.add_argument("--dataset", type=str, default="mnist",
                    choices=["mnist", "fashion_mnist", "synthetic"])
+    p.add_argument("--download", action="store_true",
+                   help="fetch + verify the dataset's IDX files into --root "
+                        "when absent (reference :137-138 download=True; for "
+                        "multi-host runs, pre-download with a single-process "
+                        "run first, as the reference README does)")
     p.add_argument("--optimizer", type=str, default="adam",
                    choices=["adam", "adam_pallas", "sgd"],
                    help="adam_pallas = fused Pallas update kernel")
@@ -109,6 +114,23 @@ def build_parser() -> argparse.ArgumentParser:
 def _build_loaders(args, seed: int):
     name = "mnist" if args.dataset == "synthetic" else args.dataset
     synthesize = args.dataset == "synthetic"
+
+    if args.download and not synthesize:
+        # Every process attempts the (idempotent, atomically-published)
+        # download — correct whether hosts share a filesystem or have their
+        # own — and then all processes rendezvous, so either every host sees
+        # the real dataset or every host falls back to synthetic together.
+        # A split outcome would train on silently different data per host.
+        from pytorch_distributed_mnist_tpu.data.download import download_dataset
+
+        try:
+            download_dataset(args.root, name)
+        except (OSError, ValueError) as exc:
+            log0(f"WARNING: download of {name!r} failed: {exc}")
+        if process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpu-mnist-dataset-download")
 
     def load_split(train: bool):
         n = args.synthetic_train_size if train else args.synthetic_test_size
@@ -205,9 +227,13 @@ def run(args) -> dict:
         for epoch in range(start_epoch, args.epochs):
             train_loader.set_sample_epoch(epoch)  # per-epoch reshuffle (:231)
             trainer.state = trainer.state.with_learning_rate(lr_of(epoch))  # (:232)
-            train_loss, train_acc = trainer.train()
+            # Only the train pass is timed; trainer.train() folds metrics to
+            # host values before returning, so the measured span covers all
+            # device work for the epoch and nothing else (not eval, not the
+            # checkpoint write).
+            with timer.measure(len(train_loader) * args.batch_size):
+                train_loss, train_acc = trainer.train()
             test_loss, test_acc = trainer.evaluate()
-            timer.tick(len(train_loader) * args.batch_size)
             log0(f"Epoch: {epoch}/{args.epochs}, lr: {lr_of(epoch):g},"
                  f" train loss: {train_loss}, train acc: {train_acc},"
                  f" test loss: {test_loss}, test acc: {test_acc}")

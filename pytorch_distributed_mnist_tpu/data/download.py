@@ -1,0 +1,171 @@
+"""Dataset acquisition: fetch + verify the four IDX files.
+
+The reference gets this from torchvision's ``datasets.MNIST(root,
+download=True)`` (``/root/reference/multi_proc_single_gpu.py:137-138``;
+``README.md:42-48`` documents the world-size-1 pre-download run). This is
+the first-party equivalent: stdlib-only HTTP(S) fetch of the gzipped IDX
+files into ``root/<name>/``, checksum verification, atomic writes
+(tmp + ``os.replace``), and skip-if-present idempotence.
+
+Design notes:
+
+- ``urllib`` also serves ``file://`` URLs, so the whole path is testable
+  offline with a local mirror directory (tests/test_download.py) — the
+  no-egress analog of torchvision's mirror list.
+- Checksums are MD5 (the values every MNIST mirror publishes and
+  torchvision pins); callers can pass their own ``checksums`` for private
+  mirrors. Verification failure deletes the file and raises — a truncated
+  or tampered download never becomes load-bearing.
+- Only process 0 of a multi-host job should download (the reference gets
+  the same property manually via its world-size-1 pre-download run);
+  ``download_dataset`` takes ``process_index`` for that gate.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import urllib.error
+import urllib.request
+from typing import Dict, Iterable, Optional, Sequence
+
+_GZ_FILES = (
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+)
+
+# Public mirror lists; first reachable wins.
+MIRRORS: Dict[str, Sequence[str]] = {
+    "mnist": (
+        "https://ossci-datasets.s3.amazonaws.com/mnist/",
+        "http://yann.lecun.com/exdb/mnist/",
+    ),
+    "fashion_mnist": (
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/",
+    ),
+}
+
+# MD5 of each .gz as published by the mirrors (and pinned by torchvision).
+CHECKSUMS: Dict[str, Dict[str, str]] = {
+    "mnist": {
+        "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+        "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+        "t10k-images-idx3-ubyte.gz": "9fb629c4189551a2d022fa330f9573f3",
+        "t10k-labels-idx1-ubyte.gz": "ec29112dd5afa0611ce80d1b7f02629c",
+    },
+    "fashion_mnist": {
+        "train-images-idx3-ubyte.gz": "8d4fb7e6c68d591d4c3dfef9ec88bf0d",
+        "train-labels-idx1-ubyte.gz": "25c81989df183df01b3e8a0aad5dffbe",
+        "t10k-images-idx3-ubyte.gz": "bef4ecab320f06d8554ea6380940ec79",
+        "t10k-labels-idx1-ubyte.gz": "bb300cfdad3c16e7a12a480ee83cd310",
+    },
+}
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _looks_like_idx_gz(path: str) -> bool:
+    """Cheap sanity check used when no checksum is pinned: gunzips and has
+    an IDX magic (0x0000 08xx)."""
+    try:
+        with gzip.open(path, "rb") as f:
+            head = f.read(4)
+    except (OSError, EOFError):  # EOFError: truncated after a valid header
+        return False
+    return len(head) == 4 and head[0] == 0 and head[1] == 0 and head[2] == 8
+
+
+def _fetch(url: str, dest: str, timeout: float) -> None:
+    # pid-unique tmp: concurrent downloaders (multiple hosts sharing a
+    # filesystem) each publish atomically instead of interleaving writes.
+    tmp = f"{dest}.tmp{os.getpid()}"
+    with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+    os.replace(tmp, dest)  # atomic publish, like checkpoint writes
+
+
+def dataset_present(directory: str, files: Iterable[str] = _GZ_FILES) -> bool:
+    """True when every IDX file exists (gzipped or already decompressed)."""
+    for name in files:
+        raw = name[: -len(".gz")]
+        if not (
+            os.path.isfile(os.path.join(directory, name))
+            or os.path.isfile(os.path.join(directory, raw))
+        ):
+            return False
+    return True
+
+
+def download_dataset(
+    root: str,
+    name: str = "mnist",
+    mirrors: Optional[Sequence[str]] = None,
+    checksums: Optional[Dict[str, str]] = None,
+    timeout: float = 60.0,
+    process_index: int = 0,
+) -> str:
+    """Fetch ``name``'s four IDX .gz files into ``root/<name>/``.
+
+    Returns the directory holding the files. Idempotent: files already
+    present (and passing verification when a checksum is pinned) are kept.
+    Non-zero ``process_index`` returns immediately — one downloader per
+    filesystem, the multi-host analog of the reference's world-size-1
+    pre-download run (``README.md:42-48``).
+
+    Raises ``OSError`` when no mirror can serve a file, ``ValueError`` when
+    a fetched file fails verification.
+    """
+    directory = os.path.join(root, name)
+    if process_index != 0:
+        return directory
+    if mirrors is None:
+        mirrors = MIRRORS.get(name, ())
+    if checksums is None:
+        checksums = CHECKSUMS.get(name, {})
+    os.makedirs(directory, exist_ok=True)
+
+    for filename in _GZ_FILES:
+        dest = os.path.join(directory, filename)
+        want = checksums.get(filename)
+        if os.path.isfile(dest) and (
+            (want and _md5(dest) == want) or (not want and _looks_like_idx_gz(dest))
+        ):
+            continue
+        if os.path.isfile(os.path.join(directory, filename[: -len(".gz")])):
+            continue  # already decompressed (e.g. hand-placed raw IDX)
+        errors = []
+        for mirror in mirrors:
+            url = mirror.rstrip("/") + "/" + filename
+            try:
+                _fetch(url, dest, timeout)
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                errors.append(f"{url}: {exc}")
+                continue
+            if want and _md5(dest) != want:
+                os.remove(dest)
+                errors.append(f"{url}: checksum mismatch")
+                continue
+            if not want and not _looks_like_idx_gz(dest):
+                os.remove(dest)
+                errors.append(f"{url}: not a gzipped IDX file")
+                continue
+            break
+        else:
+            raise OSError(
+                f"could not download {filename} for {name!r}: "
+                + ("; ".join(errors) if errors else "no mirrors configured")
+            )
+    return directory

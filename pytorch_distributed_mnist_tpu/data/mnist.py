@@ -148,15 +148,32 @@ def load_dataset(
     synthetic_train_size: int = 60000,
     synthetic_test_size: int = 10000,
     seed: int = 0,
+    download: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Load (images u8 (N,28,28), labels u8) from IDX files, or synthesize.
 
-    Real files under ``root`` always win; the synthetic fallback replaces the
-    reference's ``download=True`` (``:138``) in a no-egress environment.
-    Train and test splits draw from disjoint seed streams so memorizing train
-    does not trivially solve test.
+    Real files under ``root`` always win. ``download=True`` is the analog of
+    the reference's ``datasets.MNIST(..., download=True)`` (``:137-138``):
+    fetch + checksum-verify the IDX files from the public mirrors
+    (data/download.py) when absent. The synthetic fallback remains for
+    no-egress environments. Train and test splits draw from disjoint seed
+    streams so memorizing train does not trivially solve test.
     """
     d = dataset_dir(root, name)
+    split_incomplete = not all(
+        any(os.path.isfile(os.path.join(d, f + sfx)) for sfx in ("", ".gz"))
+        for f in _FILES[train]
+    )
+    if download and split_incomplete:
+        from pytorch_distributed_mnist_tpu.data.download import download_dataset
+
+        try:
+            download_dataset(root, name)
+        except (OSError, ValueError) as exc:
+            # Fall through to the existing missing-file policy (synthesize
+            # or raise FileNotFoundError) with the cause surfaced.
+            print(f"WARNING: download of {name!r} failed: {exc}")
+        d = dataset_dir(root, name)
     img_name, lbl_name = _FILES[train]
     for suffix in ("", ".gz"):
         ip, lp = os.path.join(d, img_name + suffix), os.path.join(d, lbl_name + suffix)

@@ -25,6 +25,33 @@ import jax
 _initialized = False
 
 
+def _multiprocess_env_detected() -> bool:
+    """True when the environment indicates a multi-process launch.
+
+    These are the variables JAX's own cluster detection consumes: an
+    explicit coordinator (``JAX_COORDINATOR_ADDRESS``), a multi-worker TPU
+    pod (``TPU_WORKER_HOSTNAMES`` listing >1 hosts, or megascale
+    coordination), or a Slurm / Open MPI launcher. When any is present,
+    ``jax.distributed.initialize()`` is called with NO arguments so JAX's
+    autodetection fills in address/size/rank itself — this code never
+    second-guesses it (a previous revision gated on a nonstandard
+    ``TPU_WORKER_COUNT`` variable, which real pod runtimes do not set).
+    """
+    env = os.environ
+    if env.get("JAX_COORDINATOR_ADDRESS") or env.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h.strip()]
+    if len(hosts) > 1:
+        return True
+    for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE"):
+        try:
+            if int(env.get(var, "0")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -32,28 +59,28 @@ def initialize_distributed(
 ) -> None:
     """Initialize the multi-host runtime (idempotent).
 
-    With no arguments, auto-detects from the environment the way TPU pods
-    configure it (the analog of ``torch.distributed.launch`` injecting
-    ``--local_rank``, reference ``:319-321``). Explicit arguments mirror the
-    reference's ``--init-method`` / ``--world-size`` / ``--rank`` flags.
-    Single-process runs skip initialization entirely, like the reference's
-    world-size-1 path still calling ``init_process_group`` — except here
-    single-process needs no rendezvous at all.
+    With no arguments, auto-detects from the environment the way TPU pods /
+    cluster launchers configure it (the analog of ``torch.distributed.launch``
+    injecting ``--local_rank``, reference ``:319-321``). Explicit arguments
+    mirror the reference's ``--init-method`` / ``--world-size`` / ``--rank``
+    flags. Single-process runs skip initialization entirely, like the
+    reference's world-size-1 path still calling ``init_process_group`` —
+    except here single-process needs no rendezvous at all.
     """
     global _initialized
     if _initialized:
         return
-    want_multi = (
-        coordinator_address is not None
-        or (num_processes or 0) > 1
-        or int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
-    )
-    if want_multi:
+    explicit = coordinator_address is not None or (num_processes or 0) > 1
+    if explicit:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+    elif _multiprocess_env_detected():
+        # Let JAX's cluster autodetection (TPU pod metadata, Slurm, OMPI)
+        # work out coordinator/size/rank on its own.
+        jax.distributed.initialize()
     _initialized = True
 
 

@@ -17,7 +17,12 @@ import jax
 
 
 class StepTimer:
-    """Wall-clock throughput meter: images/sec and images/sec/chip."""
+    """Throughput meter over explicitly measured phases.
+
+    Only wall-time spent inside ``measure(...)`` blocks counts toward the
+    rate, so training throughput is not diluted by eval/checkpoint time
+    happening between measured phases (a phase-mixing bug in earlier
+    revisions of ``cli.py`` that understated images/sec)."""
 
     def __init__(self, num_chips: Optional[int] = None) -> None:
         self.num_chips = num_chips or jax.device_count()
@@ -26,15 +31,25 @@ class StepTimer:
     def reset(self) -> None:
         self.images = 0
         self.steps = 0
-        self._start = time.perf_counter()
+        self.seconds = 0.0
 
-    def tick(self, batch_size: int) -> None:
-        self.images += batch_size
-        self.steps += 1
+    @contextlib.contextmanager
+    def measure(self, images: int):
+        """Time the enclosed phase and attribute ``images`` to it.
+
+        The caller must ensure device work is complete before the block
+        exits (e.g. by folding metrics to host values inside it)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds += time.perf_counter() - t0
+            self.images += images
+            self.steps += 1
 
     @property
     def elapsed(self) -> float:
-        return time.perf_counter() - self._start
+        return self.seconds
 
     @property
     def images_per_sec(self) -> float:

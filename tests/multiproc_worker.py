@@ -1,0 +1,73 @@
+"""One rank of a real multi-process DP run, for tests/test_multiprocess.py.
+
+Run as: python multiproc_worker.py RANK NPROCS PORT CKPT_DIR
+
+Each process is one SPMD host: ``jax.distributed.initialize`` with a
+localhost coordinator (the analog of the reference's
+``mp.spawn``-per-GPU workers rendezvousing over
+``tcp://127.0.0.1:23456``, ``/root/reference/multi_proc_single_gpu.py:167-168,
+284-285``), then the FULL job driver (``cli.run``) — so the multi-host code
+paths that a single-process suite can never reach actually execute:
+``jax.make_array_from_process_local_data`` (data/loader.py), per-host
+disjoint sampler shards, cross-process metric reduction, and process-0-only
+checkpoint writes.
+
+Prints one ``SUMMARY{json}`` line for the parent test to parse.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    rank, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    port, ckpt_dir = sys.argv[3], sys.argv[4]
+
+    # Hermetic CPU backend, one local device per process (the parent strips
+    # any xla_force_host_platform_device_count flag from XLA_FLAGS).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args(
+        [
+            "--dataset", "synthetic",
+            "--model", "linear",
+            "--epochs", "1",
+            "--batch-size", "64",
+            "--synthetic-train-size", "256",
+            "--synthetic-test-size", "128",
+            "--trainer-mode", "stepwise",
+            "--seed", "0",
+            "--coordinator", f"127.0.0.1:{port}",
+            "--num-processes", str(nprocs),
+            "--process-id", str(rank),
+            "--checkpoint-dir", ckpt_dir,
+        ]
+    )
+    summary = run(args)
+
+    wrote = sorted(os.listdir(ckpt_dir)) if os.path.isdir(ckpt_dir) else []
+    print(
+        "SUMMARY"
+        + json.dumps(
+            {
+                "rank": rank,
+                "process_count": jax.process_count(),
+                "device_count": jax.device_count(),
+                "best_acc": summary["best_acc"],
+                "train_loss": summary["history"][0]["train_loss"],
+                "test_acc": summary["history"][0]["test_acc"],
+                "checkpoint_files": wrote,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
