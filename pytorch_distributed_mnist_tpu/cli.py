@@ -118,10 +118,15 @@ def _build_loaders(args, seed: int):
     if args.download and not synthesize:
         # Every process attempts the (idempotent, atomically-published)
         # download — correct whether hosts share a filesystem or have their
-        # own — and then all processes rendezvous, so either every host sees
-        # the real dataset or every host falls back to synthetic together.
-        # A split outcome would train on silently different data per host.
-        from pytorch_distributed_mnist_tpu.data.download import download_dataset
+        # own. The outcome is then AGREED across hosts: unless every host
+        # ended up with the files, all hosts fall back to synthetic
+        # together. A split outcome would train on silently different data
+        # per host — a barrier alone only synchronizes timing, not results.
+        from pytorch_distributed_mnist_tpu.data.download import (
+            dataset_present,
+            download_dataset,
+        )
+        from pytorch_distributed_mnist_tpu.data.mnist import dataset_dir
 
         try:
             download_dataset(args.root, name)
@@ -130,7 +135,19 @@ def _build_loaders(args, seed: int):
         if process_count() > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("tpu-mnist-dataset-download")
+            have = dataset_present(dataset_dir(args.root, name))
+            everyone = multihost_utils.process_allgather(
+                np.asarray([have], dtype=np.bool_)
+            )
+            if not bool(np.all(everyone)):
+                log0(
+                    f"WARNING: {name!r} is not present on every host "
+                    f"({int(np.sum(everyone))}/{everyone.size} have it); "
+                    "all hosts will use the synthetic fallback so training "
+                    "data stays consistent across the job"
+                )
+                synthesize = True
+                name = "mnist"
 
     def load_split(train: bool):
         n = args.synthetic_train_size if train else args.synthetic_test_size

@@ -88,13 +88,17 @@ def _fetch(url: str, dest: str, timeout: float) -> None:
     # pid-unique tmp: concurrent downloaders (multiple hosts sharing a
     # filesystem) each publish atomically instead of interleaving writes.
     tmp = f"{dest}.tmp{os.getpid()}"
-    with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
-        while True:
-            chunk = r.read(1 << 20)
-            if not chunk:
-                break
-            f.write(chunk)
-    os.replace(tmp, dest)  # atomic publish, like checkpoint writes
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(tmp, dest)  # atomic publish, like checkpoint writes
+    finally:
+        if os.path.exists(tmp):  # mid-stream failure: no orphan partials
+            os.remove(tmp)
 
 
 def dataset_present(directory: str, files: Iterable[str] = _GZ_FILES) -> bool:

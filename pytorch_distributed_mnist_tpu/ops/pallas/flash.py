@@ -1,22 +1,32 @@
-"""Flash attention forward kernel (Pallas TPU) with a recompute backward.
+"""Flash attention (Pallas TPU): fused forward AND backward kernels.
 
 Blockwise online-softmax attention: scores are computed tile-by-tile in
-VMEM and never materialized as a (T, T) matrix in HBM — the memory profile
-that makes long context viable (the same recurrence as the pure-jnp
-blockwise op in ``ops/attention.py``, which is this kernel's test oracle;
-the reference repo has no attention at all, SURVEY.md section 2c).
+VMEM and never materialized as a (T, T) matrix in HBM — in either pass.
+The forward kernel additionally emits the per-row logsumexp; the backward
+is the standard two-pass flash recipe over that residual:
 
-Scope: forward pass as a kernel, tiled (block_q x block_k) with both
-matmuls on the MXU in f32 accumulation. The backward is ``jax.vjp`` of the
-dense reference — i.e. gradients recompute attention with XLA. That keeps
-training correct everywhere while the fwd kernel carries the memory win
-(eval/inference and activation-checkpointed training recompute forwards,
-which is where the kernel runs). A fused flash backward kernel is the
-natural next step and slots into the same ``custom_vjp``.
+  delta_i = rowsum(dO_i * O_i)                       (tiny elementwise, XLA)
+  P_ij    = exp(scale * q_i.k_j - lse_i)             (recomputed per tile)
+  dV_j    = sum_i P_ij^T dO_i
+  dS_ij   = P_ij * (dO_i.V_j - delta_i)
+  dQ_i    = scale * sum_j dS_ij K_j                  (kernel 1: grid over i)
+  dK_j    = scale * sum_i dS_ij^T Q_i                (kernel 2: grid over j)
 
-Composes with the mesh machinery: ``ring_attention_local`` accepts any
-per-block attention update, and this kernel is what a production config
-uses inside each ring step for long sequences.
+so gradients also run at flash memory cost — no ``jax.vjp`` of a dense
+reference anywhere (earlier revisions recomputed a (T, T) matrix in the
+backward, which forfeited the memory win for training). Oracle for all
+three kernels: ``full_attention`` under ``jax.vjp``, asserted in interpret
+mode by tests/test_pallas_kernels.py.
+
+The reference repo has no attention at all
+(``/root/reference/multi_proc_single_gpu.py:119-126``; SURVEY.md section 2c
+marks every sequence strategy ABSENT) — this op family exists because
+long-context is first-class in the TPU design: ``ring_attention_local``
+(parallel/ring.py) accepts any per-block attention update, and this kernel
+is what a production config uses inside each ring step.
+
+Layout: ``(B, T, H, D)``; kernels run per (batch*head) with both matmuls
+per tile on the MXU in f32 accumulation.
 """
 
 from __future__ import annotations
@@ -28,15 +38,37 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from pytorch_distributed_mnist_tpu.ops.attention import NEG_INF, full_attention
+from pytorch_distributed_mnist_tpu.ops.attention import NEG_INF
+
+__all__ = ["flash_attention"]
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, block_q: int, t_real: int):
+def _keep_mask(iq, jk, block_q, block_k, t_real, causal):
+    """(BQ, BK) validity: in-range q row, in-range k col, causal triangle.
+
+    The causal form is start-aligned (qi >= ki), identical to the dense
+    oracle's end-aligned tril only when Tq == Tk — which ``flash_attention``
+    asserts, since the same residuals/padding already require it."""
+    qi = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    ki = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    keep = (qi < t_real) & (ki < t_real)
+    if causal:
+        keep &= qi >= ki
+    return keep
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float, block_q: int, t_real: int):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
-    ``t_real``: valid sequence length; positions >= t_real are padding
-    introduced to reach a tile-friendly block multiple and are masked out.
+    Emits both the normalized output block and the row logsumexp
+    ``lse = m + log(l)`` — the single residual the backward kernels need to
+    reconstruct any P tile.
     """
     q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
     t = k_ref.shape[1]
@@ -53,16 +85,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
         if masked:
-            ki = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+            s = jnp.where(
+                _keep_mask(iq, j, block_q, block_k, t_real, causal), s, NEG_INF
             )
-            keep = ki < t_real
-            if causal:
-                qi = iq * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                keep &= qi >= ki
-            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         if masked:
@@ -81,31 +106,40 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     l = jnp.zeros((block_q, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, nk, body, (o, m, l))
     o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)),
+                    NEG_INF)
+    lse_ref[0] = lse
 
 
-def _flash_forward(q, k, v, causal: bool, scale: float | None,
-                   interpret: bool | None):
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    b, t, h, d = q.shape
+def _block_sizes(t: int):
     # Pad T up to a tile-friendly block multiple (never shrink the block to
     # a divisor of T — a prime T would degrade to block 1); padded K
-    # positions are masked inside the kernel, padded Q rows sliced off.
+    # positions are masked inside the kernels, padded Q rows sliced off.
     block = 128 if t >= 128 else ((t + 7) // 8) * 8
     t_pad = ((t + block - 1) // block) * block
+    return block, t_pad
 
-    # (B, T, H, D) -> (B*H, Tp, D): one grid row per batch-head pair.
-    def split(x):
-        x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        if t_pad != t:
-            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
-        return x
 
-    qh, kh, vh = split(q), split(k), split(v)
+def _to_heads(x, b, t, h, d, t_pad):
+    """(B, T, H, D) -> (B*H, Tp, D): one grid row per batch-head pair."""
+    x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    if t_pad != t:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    return x
+
+
+def _from_heads(x, b, t, h, d):
+    return x[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, interpret: bool):
+    b, t, h, d = q.shape
+    block, t_pad = _block_sizes(t)
+    qh = _to_heads(q, b, t, h, d, t_pad)
+    kh = _to_heads(k, b, t, h, d, t_pad)
+    vh = _to_heads(v, b, t, h, d, t_pad)
     kernel = functools.partial(
-        _flash_kernel, block_k=block, causal=causal,
+        _fwd_kernel, block_k=block, causal=causal,
         scale=scale, block_q=block, t_real=t,
     )
     # NOTE: each program holds the full (Tp, D) K and V in VMEM, which caps
@@ -113,7 +147,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float | None,
     # that, stream K/V through a third grid dimension — the online-softmax
     # carry already supports it; the ring (parallel/ring.py) also divides T
     # by the seq-axis size per device before this kernel sees it.
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t_pad // block),
         in_specs=[
@@ -124,32 +158,185 @@ def _flash_forward(q, k, v, causal: bool, scale: float | None,
             pl.BlockSpec((1, t_pad, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_pad), jnp.float32),
+        ),
         interpret=interpret,
     )(qh, kh, vh)
-    return out[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return _from_heads(out, b, t, h, d), out, lse
+
+
+# --------------------------------------------------------------------------
+# Backward
+# --------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, *,
+               block_k: int, causal: bool, scale: float, block_q: int,
+               t_real: int):
+    """Grid (B*H, q-block): stream K/V, accumulate this q-block's dQ."""
+    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)        # (BQ, D)
+    lse = lse_ref[0][:, None]                 # (BQ, 1)
+    delta = delta_ref[0][:, None]             # (BQ, 1)
+    t = k_ref.shape[1]
+    nk = t // block_k
+    iq = pl.program_id(1)
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        keep = _keep_mask(iq, j, block_q, block_k, t_real, causal)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (scale * dq).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_k: int, causal: bool, scale: float,
+                block_q: int, t_real: int):
+    """Grid (B*H, k-block): stream Q/dO rows, accumulate dK and dV."""
+    k_blk = k_ref[0].astype(jnp.float32)      # (BK, D)
+    v_blk = v_ref[0].astype(jnp.float32)      # (BK, D)
+    t = q_ref.shape[1]
+    nq = t // block_q
+    jk = pl.program_id(1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        keep = _keep_mask(i, jk, block_q, block_k, t_real, causal)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BK, D)
+        return dk, dv
+
+    d = k_ref.shape[-1]
+    zero = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (zero, zero))
+    dk_ref[0] = (scale * dk).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o_heads, lse, g, causal: bool, scale: float,
+                    interpret: bool):
+    b, t, h, d = q.shape
+    block, t_pad = _block_sizes(t)
+    qh = _to_heads(q, b, t, h, d, t_pad)
+    kh = _to_heads(k, b, t, h, d, t_pad)
+    vh = _to_heads(v, b, t, h, d, t_pad)
+    doh = _to_heads(g, b, t, h, d, t_pad)
+    # delta = rowsum(dO * O): tiny elementwise op, fine in XLA. o_heads is
+    # the forward kernel's padded (B*H, Tp, D) output, reused as-is.
+    delta = jnp.sum(doh * o_heads.astype(jnp.float32), axis=-1)  # (B*H, Tp)
+
+    common = dict(block_k=block, causal=causal, scale=scale,
+                  block_q=block, t_real=t)
+    seq_spec = pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
+                            memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, block), lambda i, j: (i, j),
+                            memory_space=pltpu.VMEM)
+    full_spec = pl.BlockSpec((1, t_pad, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    full_row = pl.BlockSpec((1, t_pad), lambda i, j: (i, 0),
+                            memory_space=pltpu.VMEM)
+    grid = (b * h, t_pad // block)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=grid,
+        in_specs=[seq_spec, seq_spec, row_spec, row_spec, full_spec, full_spec],
+        out_specs=seq_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+        interpret=interpret,
+    )(qh, doh, lse, delta, kh, vh)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=grid,
+        in_specs=[seq_spec, seq_spec, full_spec, full_spec, full_row, full_row],
+        out_specs=(seq_spec, seq_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t_pad, d), v.dtype),
+        ),
+        interpret=interpret,
+    )(kh, vh, qh, doh, lse, delta)
+
+    return (
+        _from_heads(dq, b, t, h, d),
+        _from_heads(dk, b, t, h, d),
+        _from_heads(dv, b, t, h, d),
+    )
+
+
+# --------------------------------------------------------------------------
+# custom_vjp plumbing
+# --------------------------------------------------------------------------
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
-    return _flash_forward(q, k, v, causal, scale, None)
+    out, _, _ = _flash_forward(q, k, v, causal, scale, _interpret_default())
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash(q, k, v, causal, scale), (q, k, v)
+    out, o_heads, lse = _flash_forward(
+        q, k, v, causal, scale, _interpret_default()
+    )
+    return out, (q, k, v, o_heads, lse)
 
 
 def _flash_bwd(causal, scale, residuals, g):
-    # Recompute-based backward: differentiate the dense reference (same
-    # math; see module docstring for the tradeoff).
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda a, b_, c: full_attention(a, b_, c, causal=causal, scale=scale),
-        q, k, v,
+    q, k, v, o_heads, lse = residuals
+    return _flash_backward(
+        q, k, v, o_heads, lse, g, causal, scale, _interpret_default()
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -159,7 +346,18 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: float | None = None):
     """Flash attention on ``(B, T, H, D)``; drop-in for ``full_attention``.
 
-    Differentiable (recompute backward); off-TPU the kernel runs in
-    interpreter mode so tests are hermetic.
+    Fully differentiable with fused Pallas forward and backward kernels
+    (no (T, T) materialization in either pass); off-TPU the kernels run in
+    interpreter mode so tests are hermetic. Self-attention shapes only:
+    Tq must equal Tk (the kernel's start-aligned causal mask and the dense
+    oracle's end-aligned mask agree exactly there).
     """
-    return _flash(q, k, v, causal, scale)
+    if q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"flash_attention requires Tq == Tk (self-attention); got "
+            f"Tq={q.shape[1]}, Tk={k.shape[1]} — use full_attention for "
+            f"cross-attention shapes"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, causal, float(scale))

@@ -110,20 +110,68 @@ def test_flash_attention_matches_dense(causal, t):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
-def test_flash_attention_grad_matches_dense():
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t", [64, 256])
+def test_flash_attention_grad_matches_dense(causal, t):
+    """Fused Pallas backward (dq/dk/dv kernels) == vjp of the dense oracle."""
     ks = jax.random.split(jax.random.key(4), 3)
-    q, k, v = (jax.random.normal(kk, (1, 64, 2, 16), jnp.float32) for kk in ks)
+    q, k, v = (jax.random.normal(kk, (1, t, 2, 16), jnp.float32) for kk in ks)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
 
     def loss_dense(q, k, v):
-        return jnp.sum(full_attention(q, k, v) ** 2)
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("t", [49, 200])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grad_unaligned_lengths(t, causal):
+    """Backward kernels mask padded rows/cols exactly like the forward."""
+    ks = jax.random.split(jax.random.key(6), 3)
+    q, k, v = (jax.random.normal(kk, (1, t, 2, 16), jnp.float32) for kk in ks)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_bwd_never_materializes_scores():
+    """No (T, T) intermediate anywhere in the grad program.
+
+    With T=256 and 128-blocks, a dense-recompute backward would carry a
+    (..., 256, 256) score matrix; the fused kernels only ever hold
+    (128, 128) tiles. Checked on the whole grad jaxpr."""
+    ks = jax.random.split(jax.random.key(7), 3)
+    t = 256
+    q, k, v = (jax.random.normal(kk, (1, t, 2, 16), jnp.float32) for kk in ks)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    assert f"{t},{t}" not in str(jaxpr)
+
+
+def test_flash_attention_rejects_cross_attention_shapes():
+    """Tq != Tk raises: the kernel's causal mask alignment assumes Tq == Tk."""
+    k1, k2 = jax.random.split(jax.random.key(8))
+    q = jax.random.normal(k1, (1, 32, 2, 16), jnp.float32)
+    k = v = jax.random.normal(k2, (1, 64, 2, 16), jnp.float32)
+    with pytest.raises(ValueError, match="Tq == Tk"):
+        flash_attention(q, k, v)
 
 
 @pytest.mark.parametrize("t", [49, 127, 200])
