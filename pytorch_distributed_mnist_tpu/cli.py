@@ -101,6 +101,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", type=str, default="adam",
                    choices=["adam", "adam_pallas", "sgd"],
                    help="adam_pallas = fused Pallas update kernel")
+    p.add_argument("--optimizer-sharding", type=str, default="none",
+                   choices=["none", "zero1"],
+                   help="zero1 = shard Adam moments over the data axis "
+                        "(ZeRO-1; parallel/zero.py). Params stay "
+                        "replicated, XLA turns the grad AllReduce into "
+                        "ReduceScatter + AllGather")
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
@@ -227,8 +233,15 @@ def run(args) -> dict:
         # the --start-epoch flag; the flag only applies to fresh runs.
         start_epoch = args.start_epoch
 
+    state_sharding = None
+    if getattr(args, "optimizer_sharding", "none") == "zero1":
+        from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero1
+
+        state, state_sharding = shard_state_zero1(state, mesh)
+
     train_loader, test_loader = _build_loaders(args, seed)
-    trainer = Trainer(state, train_loader, test_loader, mesh=mesh, mode=args.trainer_mode)
+    trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
+                      mode=args.trainer_mode, state_sharding=state_sharding)
     lr_of = step_decay_schedule(args.lr)
 
     if args.evaluate:

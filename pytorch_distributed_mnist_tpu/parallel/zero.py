@@ -1,0 +1,86 @@
+"""ZeRO-1: optimizer-state sharding over the data axis (GSPMD).
+
+The reference's optimizer keeps full Adam moments on every rank
+(``/root/reference/multi_proc_single_gpu.py:191``; SURVEY.md section 2c
+marks ZeRO/FSDP ABSENT). Here ZeRO-1 is exactly what the N-D-mesh design
+promised it would be (SURVEY.md section 2c closing note): a
+``PartitionSpec`` change, not new machinery. Adam's ``mu``/``nu`` pytrees
+get sharded along the ``data`` mesh axis; params, step counter, and
+hyperparams stay replicated (the DDP layout). XLA's sharding propagation
+then materializes the ZeRO communication pattern itself — the gradient
+AllReduce becomes a ReduceScatter into the moment shards plus an AllGather
+of the parameter update — with no hand-written collectives.
+
+Per-leaf placement: moments are sharded along each leaf's LARGEST
+axis-size-divisible dimension (conv kernels are small on dim 0 — e.g.
+``(3, 3, 1, 32)`` — so a dim-0-only rule would shard almost nothing of a
+CNN). Leaves with no divisible dimension, and leaves a TP rule already
+lays out (TP moments must share the param layout), replicate/keep as-is.
+
+Composes with the tensor-parallel rule table (``parallel/tensor.py``):
+pass its ``rules`` and the base layout is applied first, ZeRO sharding
+only claims dimensions TP left unsharded on moment leaves it skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.parallel.tensor import leaf_spec, _path_keys
+
+
+def _is_moment_path(path) -> bool:
+    return any(k in ("mu", "nu") for k in _path_keys(path))
+
+
+def _zero_spec(shape: Tuple[int, ...], axis_size: int, axis: str, base: P) -> P:
+    """Shard the largest dimension divisible by ``axis_size`` that ``base``
+    leaves unsharded; return ``base`` unchanged if none qualifies."""
+    entries = list(base) + [None] * (len(shape) - len(base))
+    candidates = [
+        d for d in range(len(shape))
+        if entries[d] is None and shape[d] >= axis_size and shape[d] % axis_size == 0
+    ]
+    if not candidates:
+        return base
+    best = max(candidates, key=lambda d: shape[d])
+    entries[best] = axis
+    return P(*entries)
+
+
+def zero1_state_sharding(
+    state,
+    mesh: Mesh,
+    data_axis: str = "data",
+    rules: Optional[Dict[Tuple[str, str], P]] = None,
+):
+    """NamedSharding pytree for a TrainState with ZeRO-1 moment sharding.
+
+    ``rules`` is an optional TP rule table (``parallel/tensor.py``); leaves
+    it matches keep the TP layout everywhere (params AND moments — TP
+    moments must mirror their params), and ZeRO sharding applies to the
+    remaining moment leaves only.
+    """
+    rules = rules or {}
+    axis_size = mesh.shape[data_axis]
+
+    def spec_for(path, leaf):
+        base = leaf_spec(path, rules)
+        if not _is_moment_path(path):
+            return NamedSharding(mesh, base)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if base != P():
+            return NamedSharding(mesh, base)  # TP-ruled moment: keep layout
+        return NamedSharding(mesh, _zero_spec(shape, axis_size, data_axis, base))
+
+    return jax.tree_util.tree_map_with_path(spec_for, state)
+
+
+def shard_state_zero1(state, mesh: Mesh, data_axis: str = "data",
+                      rules: Optional[Dict[Tuple[str, str], P]] = None):
+    """Place a TrainState onto the mesh with ZeRO-1 moment sharding."""
+    sharding = zero1_state_sharding(state, mesh, data_axis, rules)
+    return jax.device_put(state, sharding), sharding

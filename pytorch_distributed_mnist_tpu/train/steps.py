@@ -106,13 +106,17 @@ def make_eval_step(
     )
 
 
-def make_train_epoch(mesh: Optional[Mesh] = None, axis: str = "data"):
+def make_train_epoch(
+    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
+):
     """Jitted ``epoch(state, batches) -> (state, MetricState)`` via lax.scan.
 
     ``batches`` is a dict of arrays with a leading steps axis:
     ``image: (S, B, ...)``, ``label: (S, B)``; the batch axis B is sharded on
     the mesh. The whole epoch runs as one XLA program — S fused train steps
     with on-device metric accumulation, one host sync at the end.
+    ``state_sharding`` overrides the replicated state layout (TP tables from
+    ``parallel/tensor.py``, ZeRO-1 from ``parallel/zero.py``).
     """
 
     def epoch(state, batches):
@@ -132,16 +136,19 @@ def make_train_epoch(mesh: Optional[Mesh] = None, axis: str = "data"):
     repl, _ = _shardings(mesh, axis)
     if mesh is None:
         return jax.jit(epoch, donate_argnums=(0,))
+    state_sh = repl if state_sharding is None else state_sharding
     batch_shard = NamedSharding(mesh, P(None, axis))  # (steps, batch, ...) prefix
     return jax.jit(
         epoch,
         donate_argnums=(0,),
-        in_shardings=(repl, batch_shard),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, batch_shard),
+        out_shardings=(state_sh, repl),
     )
 
 
-def make_eval_epoch(mesh: Optional[Mesh] = None, axis: str = "data"):
+def make_eval_epoch(
+    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
+):
     """Jitted ``epoch(state, batches) -> MetricState`` via lax.scan."""
 
     def epoch(state, batches):
@@ -162,9 +169,10 @@ def make_eval_epoch(mesh: Optional[Mesh] = None, axis: str = "data"):
     repl, _ = _shardings(mesh, axis)
     if mesh is None:
         return jax.jit(epoch)
+    state_sh = repl if state_sharding is None else state_sharding
     batch_shard = NamedSharding(mesh, P(None, axis))
     return jax.jit(
         epoch,
-        in_shardings=(repl, batch_shard),
+        in_shardings=(state_sh, batch_shard),
         out_shardings=repl,
     )

@@ -62,9 +62,12 @@ class Trainer:
         test_loader: MNISTDataLoader,
         mesh: Optional[Mesh] = None,
         mode: str = "scan",
+        state_sharding=None,
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
+        if state_sharding is not None and mesh is None:
+            raise ValueError("state_sharding requires a mesh")
         self.state = state
         self.train_loader = train_loader
         self.test_loader = test_loader
@@ -73,12 +76,23 @@ class Trainer:
         if mode == "explicit":
             if mesh is None:
                 raise ValueError("mode='explicit' requires a mesh")
+            if state_sharding is not None:
+                raise ValueError(
+                    "mode='explicit' is the replicated-DP shard_map path; "
+                    "use scan/stepwise with a sharded state"
+                )
             self._train_step = make_explicit_dp_train_step(mesh)
         else:
-            self._train_step = make_train_step(mesh)
-        self._eval_step = make_eval_step(mesh)
-        self._train_epoch = make_train_epoch(mesh) if mode == "scan" else None
-        self._eval_epoch = make_eval_epoch(mesh) if mode == "scan" else None
+            self._train_step = make_train_step(mesh, state_sharding=state_sharding)
+        self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
+        self._train_epoch = (
+            make_train_epoch(mesh, state_sharding=state_sharding)
+            if mode == "scan" else None
+        )
+        self._eval_epoch = (
+            make_eval_epoch(mesh, state_sharding=state_sharding)
+            if mode == "scan" else None
+        )
 
     def train(self) -> Tuple[Average, Accuracy]:
         """One training epoch; returns (loss meter, accuracy meter).
