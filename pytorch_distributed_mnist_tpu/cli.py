@@ -235,6 +235,14 @@ def run(args) -> dict:
 
     state_sharding = None
     if getattr(args, "optimizer_sharding", "none") == "zero1":
+        if args.optimizer not in ("adam", "adam_pallas"):
+            # ZeRO-1 shards Adam's mu/nu moment trees; SGD has no moment
+            # leaves, so the request would silently do nothing.
+            raise SystemExit(
+                f"--optimizer-sharding zero1 requires an Adam optimizer "
+                f"(got --optimizer {args.optimizer}: no mu/nu moment state "
+                f"to shard)"
+            )
         from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero1
 
         state, state_sharding = shard_state_zero1(state, mesh)

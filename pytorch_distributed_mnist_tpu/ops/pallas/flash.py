@@ -106,9 +106,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     l = jnp.zeros((block_q, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, nk, body, (o, m, l))
     o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)),
-                    NEG_INF)
-    lse_ref[0] = lse
+    # lse is carried as (BQ, 1): Mosaic requires the last two block dims be
+    # (8, 128)-tile friendly or equal to the array dims, which a flat (1, BQ)
+    # row block violates on real TPU (BQ lands in the sublane slot).
+    lse_ref[0] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
 
 
 def _block_sizes(t: int):
@@ -161,12 +162,12 @@ def _flash_forward(q, k, v, causal: bool, scale: float, interpret: bool):
         out_specs=(
             pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block), lambda i, j: (i, j),
+            pl.BlockSpec((1, block, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t_pad, 1), jnp.float32),
         ),
         interpret=interpret,
     )(qh, kh, vh)
@@ -184,8 +185,8 @@ def _dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, *,
     """Grid (B*H, q-block): stream K/V, accumulate this q-block's dQ."""
     q = q_ref[0].astype(jnp.float32)          # (BQ, D)
     do = do_ref[0].astype(jnp.float32)        # (BQ, D)
-    lse = lse_ref[0][:, None]                 # (BQ, 1)
-    delta = delta_ref[0][:, None]             # (BQ, 1)
+    lse = lse_ref[0]                          # (BQ, 1)
+    delta = delta_ref[0]                      # (BQ, 1)
     t = k_ref.shape[1]
     nk = t // block_k
     iq = pl.program_id(1)
@@ -228,8 +229,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]      # (BQ, 1)
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]  # (BQ, 1)
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -267,18 +268,20 @@ def _flash_backward(q, k, v, o_heads, lse, g, causal: bool, scale: float,
     vh = _to_heads(v, b, t, h, d, t_pad)
     doh = _to_heads(g, b, t, h, d, t_pad)
     # delta = rowsum(dO * O): tiny elementwise op, fine in XLA. o_heads is
-    # the forward kernel's padded (B*H, Tp, D) output, reused as-is.
-    delta = jnp.sum(doh * o_heads.astype(jnp.float32), axis=-1)  # (B*H, Tp)
+    # the forward kernel's padded (B*H, Tp, D) output, reused as-is. Kept
+    # as (B*H, Tp, 1) like lse so row blocks are Mosaic-tileable.
+    delta = jnp.sum(doh * o_heads.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # (B*H, Tp, 1)
 
     common = dict(block_k=block, causal=causal, scale=scale,
                   block_q=block, t_real=t)
     seq_spec = pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0),
                             memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, block), lambda i, j: (i, j),
+    row_spec = pl.BlockSpec((1, block, 1), lambda i, j: (i, j, 0),
                             memory_space=pltpu.VMEM)
     full_spec = pl.BlockSpec((1, t_pad, d), lambda i, j: (i, 0, 0),
                              memory_space=pltpu.VMEM)
-    full_row = pl.BlockSpec((1, t_pad), lambda i, j: (i, 0),
+    full_row = pl.BlockSpec((1, t_pad, 1), lambda i, j: (i, 0, 0),
                             memory_space=pltpu.VMEM)
     grid = (b * h, t_pad // block)
 

@@ -120,8 +120,6 @@ def test_zero1_scan_epoch_matches_replicated(mesh8):
 
 def test_zero1_respects_tp_rules(mesh8):
     """Moment leaves a TP rule lays out keep the TP layout (not re-sharded)."""
-    import pytest
-
     try:
         from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
 
@@ -156,3 +154,20 @@ def test_cli_zero1_end_to_end(tmp_path):
     summary = run(args)
     assert summary["epochs_run"] == 1
     assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_zero1_rejects_momentless_optimizer(tmp_path):
+    """sgd has no mu/nu leaves, so zero1 would be a silent no-op; the CLI
+    must reject the combination instead of quietly training replicated."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--optimizer", "sgd",
+        "--optimizer-sharding", "zero1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="zero1 requires an Adam"):
+        run(args)

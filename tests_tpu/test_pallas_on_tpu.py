@@ -1,0 +1,72 @@
+"""Pallas kernels on REAL TPU: Mosaic compile + numerics vs XLA oracles.
+
+The hermetic suite (tests/test_pallas_kernels.py) pins the same numerics in
+interpret mode; this suite is the hardware half the advisor asked for —
+it catches Mosaic-only failures (block tiling rules, SMEM refs, lane
+alignment for the ViT head dims D=16/32) that interpret mode cannot see.
+
+Oracle comparisons run under ``jax_default_matmul_precision=highest``
+because the dense oracle's MXU matmuls otherwise run bf16 passes and the
+~5e-3 "error" would be the oracle's, not the kernel's.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from pytorch_distributed_mnist_tpu.ops.attention import full_attention
+from pytorch_distributed_mnist_tpu.ops.pallas.adam import pallas_adam
+from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+
+
+@pytest.fixture(autouse=True)
+def _highest_precision():
+    with jax.default_matmul_precision("highest"):
+        yield
+
+
+# ViT head dim D=16 (sub-128-lane, the flagged Mosaic hazard) and a ragged
+# T requiring pad+mask. Kept to two shapes: each case costs several real
+# Mosaic compiles through the chip tunnel (~30s each); the full 4-shape
+# sweep lives in the commit history (all passed 2026-07-29).
+SHAPES = [(2, 64, 4, 16), (1, 200, 2, 32)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_backward_on_tpu(shape, causal):
+    b, t, h, d = shape
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(k1, shape, jnp.float32)
+    k = jax.random.normal(k2, shape, jnp.float32)
+    v = jax.random.normal(k3, shape, jnp.float32)
+
+    def loss(f):
+        return lambda *a: jnp.sum(jnp.sin(f(*a, causal=causal)))
+
+    out = flash_attention(q, k, v, causal=causal)
+    ref = full_attention(q, k, v, causal=causal)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+    grads = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    grads_ref = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    for g, gr in zip(grads, grads_ref):
+        assert float(jnp.max(jnp.abs(g - gr))) < 2e-3
+
+
+def test_fused_adam_on_tpu_matches_optax():
+    params = {
+        "w": jnp.ones((3, 3, 1, 32)),
+        "b": jnp.zeros((10,)),
+        "fc": jnp.ones((12544, 128)),
+        "s": jnp.ones((1,)),
+    }
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.1), params)
+    opt_a, opt_b = pallas_adam(1e-3), optax.adam(1e-3)
+    sa, sb = opt_a.init(params), opt_b.init(params)
+    for _ in range(3):
+        ua, sa = opt_a.update(grads, sa)
+        ub, sb = opt_b.update(grads, sb)
+        for x, y in zip(jax.tree.leaves(ua), jax.tree.leaves(ub)):
+            assert float(jnp.max(jnp.abs(x - y))) < 1e-6
