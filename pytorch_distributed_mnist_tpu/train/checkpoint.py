@@ -22,6 +22,22 @@ Format: ``.npz`` (zip of npy arrays) + a JSON sidecar inside the archive —
 no pickle, no framework-versioned opaque bytes; leaves are matched to a
 *template* state at restore time, the same contract as
 ``load_state_dict`` needing a constructed model (``:209``).
+
+Two layouts, chosen automatically at save time:
+
+- **npz file** (``checkpoint_{e}.npz``) when every leaf is fully
+  addressable from this process — single-host runs, and multi-host DP
+  where params/moments are replicated. One process-0 write, as the
+  reference does (``:248-249``).
+- **sharded directory** (``checkpoint_{e}.ckpt/``) when any leaf spans
+  non-addressable devices (multi-host TP/EP/ZeRO states, where
+  ``np.asarray(leaf)`` would raise): every process writes only the shards
+  it owns (``shard.replica_id == 0`` de-dupes replicas) into its own
+  ``shards_p{pid}.npz`` + slice-index JSON, process 0 writes the global
+  ``meta.json``, and the directory is atomically published after a
+  cross-host barrier. Restore stitches the global array from the slice
+  index and redistributes onto the template's shardings — so the layout
+  round-trips across different mesh shapes, same as the npz path.
 """
 
 from __future__ import annotations
@@ -43,6 +59,27 @@ def _leaves_with_names(tree: Any):
     return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
 
 
+def _state_tree(state) -> Dict[str, Any]:
+    return {"params": state.params, "opt_state": state.opt_state,
+            "step": state.step}
+
+
+def _npz_saveable(leaf: Any) -> bool:
+    """True when ``np.asarray(leaf)`` works on this process: the leaf is
+    fully addressable (single host) or fully replicated (multi-host DP —
+    every host holds the whole value). Only genuinely cross-host-sharded
+    leaves (multi-host TP/EP/ZeRO) need the sharded directory layout."""
+    return bool(getattr(leaf, "is_fully_addressable", True)
+                or getattr(leaf, "is_fully_replicated", False))
+
+
+def _barrier(name: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def save_checkpoint(
     state,
     *,
@@ -51,19 +88,31 @@ def save_checkpoint(
     is_best: bool,
     directory: str = CHECKPOINT_DIR,
     process_index: Optional[int] = None,
+    layout: Optional[str] = None,
 ) -> Optional[str]:
     """Write ``checkpoint_{epoch}.npz`` (+ best copy); returns the path.
 
     ``epoch`` is stored as ``epoch + 1`` — the reference's convention
     (``:251``) so resume continues at the *next* epoch (``:204``). Only
-    process 0 writes (``:248-249``); other processes return None.
+    process 0 writes (``:248-249``); other processes return None — except
+    when a leaf spans non-addressable devices (multi-host sharded state),
+    where every process contributes its own shards to a ``.ckpt``
+    directory instead.
     """
+    if layout not in (None, "npz", "sharded"):
+        raise ValueError(f"unknown checkpoint layout {layout!r}")
     pid = jax.process_index() if process_index is None else process_index
+    named = _leaves_with_names(_state_tree(state))
+    if layout == "sharded" or (
+        layout is None and not all(_npz_saveable(v) for _, v in named)
+    ):
+        return _save_sharded(
+            named, epoch=epoch, best_acc=best_acc, is_best=is_best,
+            directory=directory, pid=pid,
+        )
     if pid != 0:
         return None
     os.makedirs(directory, exist_ok=True)
-    named = _leaves_with_names({"params": state.params, "opt_state": state.opt_state,
-                               "step": state.step})
     payload: Dict[str, np.ndarray] = {f"leaf_{i}": np.asarray(v) for i, (_, v) in enumerate(named)}
     meta = {
         "epoch": epoch + 1,
@@ -85,45 +134,225 @@ def save_checkpoint(
     return path
 
 
+def _shard_slices(leaf, shard) -> Tuple[list, list]:
+    """Normalize a shard's index into explicit [start], [stop] lists."""
+    starts, stops = [], []
+    for sl, dim in zip(shard.index, leaf.shape):
+        a, b, _ = sl.indices(dim)
+        starts.append(int(a))
+        stops.append(int(b))
+    return starts, stops
+
+
+def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
+                  directory: str, pid: int) -> str:
+    """Every process writes its owned shards; process 0 publishes the dir.
+
+    Ownership = ``shard.replica_id == 0``: exactly one device globally
+    holds replica 0 of each distinct shard, so replicated leaves (and the
+    replicated dims of partially-sharded ones) are written once, not
+    once per host.
+
+    ``directory`` must be a filesystem shared by all hosts (the same
+    assumption the reference makes for every rank loading rank 0's file,
+    ``:202``); process 0 verifies that after the write barrier by checking
+    every host's index file is visible before publishing.
+    """
+    final = os.path.join(directory, f"checkpoint_{epoch}.ckpt")
+    tmp = final + ".tmp"  # same deterministic name on every process
+    if pid == 0:
+        # A crashed earlier attempt may have left stale shard files here;
+        # publishing those alongside fresh ones would silently corrupt the
+        # restore (stale index records overwrite freshly-stitched regions).
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+    _barrier(f"ckpt_tmp_clean_{epoch}")  # nobody writes into a dir being rm'd
+    os.makedirs(tmp, exist_ok=True)
+
+    payload: Dict[str, np.ndarray] = {}
+    index = []
+    for i, (_, leaf) in enumerate(named):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:  # plain host array (e.g. python scalar leaf)
+            if pid == 0:
+                key = f"leaf{i}_s0"
+                arr = np.asarray(leaf)
+                payload[key] = arr
+                index.append({"leaf": i, "key": key,
+                              "start": [0] * arr.ndim,
+                              "stop": list(arr.shape)})
+            continue
+        for j, shard in enumerate(shards):
+            if shard.replica_id != 0:
+                continue
+            key = f"leaf{i}_s{j}"
+            payload[key] = np.asarray(shard.data)
+            starts, stops = _shard_slices(leaf, shard)
+            index.append({"leaf": i, "key": key, "start": starts,
+                          "stop": stops})
+
+    shard_file = f"shards_p{pid:05d}.npz"
+    if payload:
+        with open(os.path.join(tmp, shard_file), "wb") as f:
+            np.savez(f, **payload)
+    with open(os.path.join(tmp, f"index_p{pid:05d}.json"), "w") as f:
+        json.dump({"file": shard_file if payload else None,
+                   "shards": index}, f)
+    if pid == 0:
+        meta = {
+            "epoch": epoch + 1,
+            "best_acc": float(best_acc),
+            "leaf_names": [k for k, _ in named],
+            "global_shapes": [list(np.shape(v)) for _, v in named],
+            "dtypes": [np.dtype(getattr(v, "dtype", np.float32)).name
+                       for _, v in named],
+            "format_version": 2,
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    _barrier(f"ckpt_save_{epoch}")  # all shard files are on disk
+    if pid == 0:
+        # Shared-filesystem check: every host's index file must be visible
+        # here, or the published checkpoint would be missing their shards
+        # (and resume would diverge: host 0 errors, others start fresh).
+        missing = [
+            p for p in range(jax.process_count())
+            if not os.path.isfile(os.path.join(tmp, f"index_p{p:05d}.json"))
+        ]
+        if missing:
+            raise RuntimeError(
+                f"sharded checkpoint save: index files from processes "
+                f"{missing} are not visible in {tmp} — --checkpoint-dir "
+                f"must be a filesystem shared by all hosts"
+            )
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)  # atomic publish of the complete directory
+        if is_best:
+            best = os.path.join(directory, "model_best.ckpt")
+            best_tmp = best + ".copy_tmp"
+            if os.path.isdir(best_tmp):
+                shutil.rmtree(best_tmp)
+            shutil.copytree(final, best_tmp)
+            if os.path.isdir(best):
+                shutil.rmtree(best)
+            os.replace(best_tmp, best)
+    _barrier(f"ckpt_publish_{epoch}")  # no reader races a half-published dir
+    return final
+
+
+def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
+    """Stitch global arrays from the shard index, redistribute to ``state``.
+
+    Mesh-shape agnostic by construction: the global array is assembled on
+    the host and handed to ``jax.make_array_from_callback`` with the
+    template leaf's sharding, so a state saved from a ``(4, 2)`` mesh
+    restores onto an ``(8,)`` mesh (or a single device) unchanged.
+    """
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    n_leaves = len(meta["leaf_names"])
+    globals_np = [
+        np.zeros(shape, dtype=np.dtype(dt))
+        for shape, dt in zip(meta["global_shapes"], meta["dtypes"])
+    ]
+    filled = [0] * n_leaves
+    for idx_name in sorted(os.listdir(path)):
+        if not idx_name.startswith("index_p"):
+            continue
+        with open(os.path.join(path, idx_name)) as f:
+            idx = json.load(f)
+        if idx["file"] is None:
+            continue
+        shard_path = os.path.join(path, idx["file"])
+        if not os.path.isfile(shard_path):
+            continue  # the filled-element check below reports what's missing
+        with np.load(shard_path) as z:
+            for rec in idx["shards"]:
+                i = rec["leaf"]
+                region = tuple(
+                    slice(a, b) for a, b in zip(rec["start"], rec["stop"])
+                )
+                data = z[rec["key"]]
+                globals_np[i][region] = data.reshape(globals_np[i][region].shape)
+                filled[i] += data.size
+    for i, (total, arr) in enumerate(zip(filled, globals_np)):
+        if total < arr.size:
+            raise ValueError(
+                f"{path}: leaf {meta['leaf_names'][i]} is missing shards "
+                f"({total}/{arr.size} elements present) — incomplete save?"
+            )
+
+    new_state = _restore_onto_template(
+        path, meta["leaf_names"], globals_np, state
+    )
+    return new_state, int(meta["epoch"]), float(meta["best_acc"])
+
+
+def _restore_onto_template(path, leaf_names, arrays, state):
+    """Map saved host arrays onto the template state's leaves/shardings.
+
+    Shared by both layouts: shape/count validation, dtype restore, and
+    placement — ``device_put`` locally, ``make_array_from_callback`` when
+    the template leaf spans non-addressable devices (each host supplies
+    its own shards from the full host copy; no cross-host transfers).
+    """
+    flat, treedef = jax.tree_util.tree_flatten(_state_tree(state))
+    if len(flat) != len(arrays):
+        raise ValueError(
+            f"{path}: checkpoint has {len(arrays)} leaves, current state "
+            f"has {len(flat)} — model/optimizer mismatch"
+        )
+    restored = []
+    for i, (tmpl, arr) in enumerate(zip(flat, arrays)):
+        if tuple(np.shape(tmpl)) != arr.shape:
+            raise ValueError(
+                f"{path}: leaf {leaf_names[i]} shape {arr.shape} != "
+                f"expected {tuple(np.shape(tmpl))}"
+            )
+        if hasattr(tmpl, "dtype"):
+            arr = arr.astype(tmpl.dtype)
+        sharding = getattr(tmpl, "sharding", None)
+        if sharding is not None and not getattr(
+            tmpl, "is_fully_addressable", True
+        ):
+            restored.append(jax.make_array_from_callback(
+                arr.shape, sharding, lambda region, a=arr: a[region]
+            ))
+        elif sharding is not None:
+            restored.append(jax.device_put(arr, sharding))
+        else:
+            restored.append(arr)
+    tree = jax.tree_util.tree_unflatten(treedef, restored)
+    return state.replace(
+        params=tree["params"], opt_state=tree["opt_state"], step=tree["step"]
+    )
+
+
 def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
     """Restore ``(state, start_epoch, best_acc)`` from ``path`` onto ``state``'s shardings.
 
     ``state`` is the freshly-constructed template (model + optimizer built
     exactly as at save time — the ``load_state_dict`` contract, ``:209-210``).
     Each saved leaf is ``device_put`` with the template leaf's sharding:
-    restore-time resharding across mesh shapes.
+    restore-time resharding across mesh shapes. Directory paths are the
+    sharded layout; files are the npz layout.
     """
+    if os.path.isdir(path):
+        return _load_sharded(path, state)
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         saved = [z[f"leaf_{i}"] for i in range(len(meta["leaf_names"]))]
-    tmpl_tree = {"params": state.params, "opt_state": state.opt_state, "step": state.step}
-    flat, treedef = jax.tree_util.tree_flatten(tmpl_tree)
-    if len(flat) != len(saved):
-        raise ValueError(
-            f"{path}: checkpoint has {len(saved)} leaves, current state has "
-            f"{len(flat)} — model/optimizer mismatch"
-        )
-    restored = []
-    for i, (tmpl, arr) in enumerate(zip(flat, saved)):
-        if tuple(np.shape(tmpl)) != arr.shape:
-            raise ValueError(
-                f"{path}: leaf {meta['leaf_names'][i]} shape {arr.shape} != "
-                f"expected {tuple(np.shape(tmpl))}"
-            )
-        arr = arr.astype(np.asarray(tmpl).dtype) if hasattr(tmpl, "dtype") else arr
-        sharding = getattr(tmpl, "sharding", None)
-        restored.append(jax.device_put(arr, sharding) if sharding is not None else arr)
-    tree = jax.tree_util.tree_unflatten(treedef, restored)
-    new_state = state.replace(
-        params=tree["params"], opt_state=tree["opt_state"], step=tree["step"]
-    )
+    new_state = _restore_onto_template(path, meta["leaf_names"], saved, state)
     return new_state, int(meta["epoch"]), float(meta["best_acc"])
 
 
 def try_resume(path: str, state) -> Tuple[Any, int, float]:
     """Reference resume policy (``:197-214``): load if the file exists, else
     warn and continue fresh with ``(state, 0, 0.0)``."""
-    if path and os.path.isfile(path):
+    if path and (os.path.isfile(path) or os.path.isdir(path)):
         state, start_epoch, best_acc = load_checkpoint(path, state)
         print(f"=> loaded checkpoint '{path}' (epoch {start_epoch})")
         return state, start_epoch, best_acc

@@ -1,6 +1,6 @@
 """One rank of a real multi-process DP run, for tests/test_multiprocess.py.
 
-Run as: python multiproc_worker.py RANK NPROCS PORT CKPT_DIR
+Run as: python multiproc_worker.py RANK NPROCS PORT CKPT_DIR [extra CLI args]
 
 Each process is one SPMD host: ``jax.distributed.initialize`` with a
 localhost coordinator (the analog of the reference's
@@ -23,6 +23,7 @@ import sys
 def main() -> None:
     rank, nprocs = int(sys.argv[1]), int(sys.argv[2])
     port, ckpt_dir = sys.argv[3], sys.argv[4]
+    extra = sys.argv[5:]
 
     # Hermetic CPU backend, one local device per process (the parent strips
     # any xla_force_host_platform_device_count flag from XLA_FLAGS).
@@ -48,6 +49,7 @@ def main() -> None:
             "--process-id", str(rank),
             "--checkpoint-dir", ckpt_dir,
         ]
+        + extra
     )
     summary = run(args)
 

@@ -96,3 +96,80 @@ def test_shape_mismatch_raises(tmp_path):
     cnn_state = create_train_state(model, jax.random.key(0))
     with pytest.raises(ValueError):
         load_checkpoint(path, cnn_state)
+
+
+# ---------------------------------------------------------------------------
+# Sharded directory layout (multi-host TP/EP/ZeRO states; VERDICT item 8)
+# ---------------------------------------------------------------------------
+
+
+def _zero1_state_on(mesh):
+    from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero1
+
+    state = fresh_state()
+    state, _ = shard_state_zero1(state, mesh)
+    return state
+
+
+def test_sharded_round_trip_across_mesh_shapes(tmp_path, mesh8):
+    """ZeRO-sharded state -> .ckpt dir -> restore on a DIFFERENT mesh,
+    bitwise equal. This is the save path a multi-host non-addressable
+    state takes (here forced via layout='sharded' since a single-process
+    suite is always fully addressable)."""
+    from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+
+    state = _zero1_state_on(mesh8)
+    path = save_checkpoint(state, epoch=4, best_acc=0.7, is_best=True,
+                           directory=str(tmp_path), process_index=0,
+                           layout="sharded")
+    assert path.endswith("checkpoint_4.ckpt") and os.path.isdir(path)
+    assert os.path.isdir(tmp_path / "model_best.ckpt")
+    assert not os.path.exists(path + ".tmp")  # atomically published
+
+    mesh42 = make_mesh(("data", "model"), shape=(4, 2))
+    template = _zero1_state_on(mesh42)
+    restored, start_epoch, best_acc = load_checkpoint(path, template)
+    assert (start_epoch, best_acc) == (5, 0.7)
+    for a, b in zip(jax.tree.leaves(state.opt_state),
+                    jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored leaves live on the TEMPLATE's (4,2)-mesh shardings
+    leaf = jax.tree.leaves(restored.opt_state)[0]
+    assert dict(leaf.sharding.mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_sharded_try_resume_accepts_directory(tmp_path, mesh8):
+    state = _zero1_state_on(mesh8)
+    path = save_checkpoint(state, epoch=0, best_acc=0.3, is_best=False,
+                           directory=str(tmp_path), process_index=0,
+                           layout="sharded")
+    _, epoch, best = try_resume(path, _zero1_state_on(mesh8))
+    assert (epoch, best) == (1, 0.3)
+
+
+def test_sharded_missing_shard_raises(tmp_path, mesh8):
+    state = _zero1_state_on(mesh8)
+    path = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                           directory=str(tmp_path), process_index=0,
+                           layout="sharded")
+    # simulate a lost per-process shard file
+    for name in os.listdir(path):
+        if name.startswith("shards_"):
+            os.unlink(os.path.join(path, name))
+    with pytest.raises(ValueError, match="missing shards"):
+        load_checkpoint(path, _zero1_state_on(mesh8))
+
+
+def test_sharded_and_npz_round_trips_agree(tmp_path, mesh8):
+    """The two layouts must restore identical states from the same save."""
+    state = _zero1_state_on(mesh8)
+    p_npz = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                            directory=str(tmp_path / "a"), process_index=0,
+                            layout="npz")
+    p_dir = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                            directory=str(tmp_path / "b"), process_index=0,
+                            layout="sharded")
+    ra, _, _ = load_checkpoint(p_npz, fresh_state(seed=1))
+    rb, _, _ = load_checkpoint(p_dir, fresh_state(seed=2))
+    for a, b in zip(jax.tree.leaves(ra.params), jax.tree.leaves(rb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

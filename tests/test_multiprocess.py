@@ -132,3 +132,64 @@ def test_env_detection(monkeypatch, var, value, expect):
         monkeypatch.delenv(v, raising=False)
     monkeypatch.setenv(var, value)
     assert _multiprocess_env_detected() is expect
+
+
+@pytest.mark.slow
+def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path):
+    """Multi-host ZeRO-1: moments sharded ACROSS processes -> the npz path
+    cannot save them (np.asarray would raise on non-addressable leaves);
+    the sharded .ckpt directory must be written by BOTH processes and
+    restore in a second 2-process run. This executes the exact crash path
+    from the round-2 review finding (checkpoint.py + multi-host zero1)."""
+
+    def spawn(extra):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, _WORKER, str(rank), "2", str(port),
+                 str(tmp_path / "ckpts")] + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=_child_env(), cwd=_REPO,
+            )
+            for rank in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=600)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        summaries = []
+        for out in outs:
+            lines = [l for l in out.splitlines() if l.startswith("SUMMARY")]
+            assert lines, f"no SUMMARY line in:\n{out[-4000:]}"
+            summaries.append(json.loads(lines[-1][len("SUMMARY"):]))
+        return summaries
+
+    first = spawn(["--optimizer-sharding", "zero1"])
+    ckpt_dir = tmp_path / "ckpts"
+    # the sharded DIRECTORY layout was chosen automatically, and both
+    # processes contributed shard files
+    assert (ckpt_dir / "checkpoint_0.ckpt").is_dir()
+    names = sorted(os.listdir(ckpt_dir / "checkpoint_0.ckpt"))
+    assert "meta.json" in names
+    assert "index_p00000.json" in names and "index_p00001.json" in names
+    assert any(n.startswith("shards_p00000") for n in names)
+    assert any(n.startswith("shards_p00001") for n in names)
+
+    second = spawn([
+        "--optimizer-sharding", "zero1", "--epochs", "2",
+        "--resume", str(ckpt_dir / "checkpoint_0.ckpt"),
+    ])
+    # the resumed world restored across hosts and continued training;
+    # replicated metrics still agree bit-for-bit
+    assert second[0]["train_loss"] == pytest.approx(
+        second[1]["train_loss"], abs=0.0)
+    # resume continued at epoch 1, so the resumed run improves on (or at
+    # least evolves from) the first epoch's loss deterministically
+    assert second[0]["train_loss"] != first[0]["train_loss"]
