@@ -41,7 +41,7 @@ import numpy as np
 
 from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
 from pytorch_distributed_mnist_tpu.data.mnist import load_dataset, normalize_images
-from pytorch_distributed_mnist_tpu.models import get_model, list_models
+from pytorch_distributed_mnist_tpu.models import get_model, list_models, model_accepts
 from pytorch_distributed_mnist_tpu.parallel.distributed import (
     initialize_distributed,
     process_count,
@@ -101,6 +101,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", type=str, default="adam",
                    choices=["adam", "adam_pallas", "sgd"],
                    help="adam_pallas = fused Pallas update kernel")
+    p.add_argument("--pipeline-stages", type=int, default=1,
+                   help="pipeline-parallel stages for --model vit (GPipe "
+                        "over a 'stage' mesh axis; devices are split "
+                        "data x stage, vit depth must divide evenly)")
     p.add_argument("--optimizer-sharding", type=str, default="none",
                    choices=["none", "zero1"],
                    help="zero1 = shard Adam moments over the data axis "
@@ -198,34 +202,61 @@ def run(args) -> dict:
         random.seed(args.seed)
         np.random.seed(args.seed)
 
-    mesh = make_mesh(("data",))
+    pp = getattr(args, "pipeline_stages", 1)
+    if pp > 1:
+        if args.model != "vit":
+            raise SystemExit(
+                f"--pipeline-stages requires --model vit (the pipelined "
+                f"architecture is embed -> N transformer blocks -> head); "
+                f"got --model {args.model}"
+            )
+        if getattr(args, "optimizer_sharding", "none") != "none":
+            raise SystemExit(
+                "--pipeline-stages does not compose with "
+                "--optimizer-sharding yet"
+            )
+        if jax.device_count() % pp:
+            raise SystemExit(
+                f"--pipeline-stages {pp} does not divide the "
+                f"{jax.device_count()} available devices"
+            )
+        mesh = make_mesh(("data", "stage"),
+                         shape=(jax.device_count() // pp, pp))
+    else:
+        mesh = make_mesh(("data",))
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
          f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     model_kwargs = {}
     if getattr(args, "attention", "dense") == "flash":
-        from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
-
-        model_kwargs["attention_fn"] = flash_attention
-    if not model_kwargs:
-        model = get_model(args.model)
-    else:
-        try:
-            model = get_model(args.model, **model_kwargs)
-        except TypeError:
-            # Capability check by construction, not by model name: any
-            # registered model that takes attention_fn works with
-            # --attention flash. Only attention kwargs are wrapped here, so
-            # an unrelated constructor TypeError surfaces as itself.
+        # Explicit capability probe (not except TypeError, which would
+        # swallow genuine constructor bugs as a flag error).
+        if not model_accepts(args.model, "attention_fn"):
             raise SystemExit(
                 f"--attention {args.attention} not supported: model "
                 f"{args.model!r} does not accept an attention_fn"
             )
-    state = create_train_state(
-        model, jax.random.key(seed), lr=args.lr,
-        optimizer=args.optimizer, momentum=args.momentum,
-        weight_decay=args.weight_decay,
-    )
+        from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+
+        model_kwargs["attention_fn"] = flash_attention
+    model = get_model(args.model, **model_kwargs)
+    pp_sharding = None
+    if pp > 1:
+        from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+            create_pipelined_vit_state,
+        )
+
+        state, pp_sharding = create_pipelined_vit_state(
+            model, jax.random.key(seed), mesh, data_axis="data",
+            lr=args.lr, optimizer=args.optimizer, momentum=args.momentum,
+            weight_decay=args.weight_decay,
+        )
+    else:
+        state = create_train_state(
+            model, jax.random.key(seed), lr=args.lr,
+            optimizer=args.optimizer, momentum=args.momentum,
+            weight_decay=args.weight_decay,
+        )
     state, start_epoch, best_acc = try_resume(args.resume, state)
     resumed = args.resume and start_epoch > 0
     if not resumed:
@@ -233,7 +264,7 @@ def run(args) -> dict:
         # the --start-epoch flag; the flag only applies to fresh runs.
         start_epoch = args.start_epoch
 
-    state_sharding = None
+    state_sharding = pp_sharding
     if getattr(args, "optimizer_sharding", "none") == "zero1":
         if args.optimizer not in ("adam", "adam_pallas"):
             # ZeRO-1 shards Adam's mu/nu moment trees; SGD has no moment

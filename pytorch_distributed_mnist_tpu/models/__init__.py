@@ -11,7 +11,7 @@ from pytorch_distributed_mnist_tpu.models.linear import LinearNet
 from pytorch_distributed_mnist_tpu.models.cnn import ConvNet
 from pytorch_distributed_mnist_tpu.models.attention import VisionTransformer
 from pytorch_distributed_mnist_tpu.models.moe import MoEClassifier, SwitchMoE
-from pytorch_distributed_mnist_tpu.models.registry import get_model, register_model, list_models
+from pytorch_distributed_mnist_tpu.models.registry import get_model, register_model, list_models, model_accepts
 
 __all__ = [
     "LinearNet",
@@ -22,4 +22,5 @@ __all__ = [
     "get_model",
     "register_model",
     "list_models",
+    "model_accepts",
 ]

@@ -31,6 +31,26 @@ from pytorch_distributed_mnist_tpu.models.registry import register_model
 from pytorch_distributed_mnist_tpu.ops.attention import full_attention
 
 
+def patchify(x: jnp.ndarray, patch_size: int, compute_dtype) -> jnp.ndarray:
+    """(B, 784) / (B, 28, 28) / (B, 28, 28, C) -> (B, T, p*p*C) patches.
+
+    Shared by the sequential ViT below and the pipeline-parallel assembly
+    (parallel/pipeline_vit.py) so the two paths cannot drift; the
+    forward-parity test in tests/test_pipeline_vit.py pins them equal.
+    """
+    if x.ndim == 2:
+        x = x.reshape((x.shape[0], 28, 28, 1))
+    elif x.ndim == 3:
+        x = x[..., None]
+    x = x.astype(compute_dtype)
+    p = patch_size
+    b, hh, ww, ch = x.shape
+    gh, gw = hh // p, ww // p
+    # (B, gh, p, gw, p, C) -> (B, gh*gw, p*p*C): non-overlapping patches.
+    x = x.reshape(b, gh, p, gw, p, ch).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, p * p * ch)
+
+
 class MultiHeadSelfAttention(nn.Module):
     """QKV projection -> pluggable core attention -> output projection."""
 
@@ -93,22 +113,12 @@ class VisionTransformer(nn.Module):
         del train
         # Accept flat (B, 784), (B, 28, 28), or (B, 28, 28, 1) like the other
         # zoo models, so the same data pipeline feeds all of them.
-        if x.ndim == 2:
-            x = x.reshape((x.shape[0], 28, 28, 1))
-        elif x.ndim == 3:
-            x = x[..., None]
-        x = x.astype(self.compute_dtype)
-        p = self.patch_size
-        b, hh, ww, ch = x.shape
-        gh, gw = hh // p, ww // p
-        # (B, gh, p, gw, p, C) -> (B, gh*gw, p*p*C): non-overlapping patches.
-        x = x.reshape(b, gh, p, gw, p, ch).transpose(0, 1, 3, 2, 4, 5)
-        x = x.reshape(b, gh * gw, p * p * ch)
+        x = patchify(x, self.patch_size, self.compute_dtype)
         x = nn.Dense(self.embed_dim, dtype=self.compute_dtype, name="embed")(x)
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(stddev=0.02),
-            (1, gh * gw, self.embed_dim),
+            (1, x.shape[1], self.embed_dim),
         )
         x = x + pos.astype(self.compute_dtype)
         for i in range(self.depth):

@@ -23,16 +23,38 @@ def register_model(name: str) -> Callable:
     return wrap
 
 
-def get_model(name: str, **kwargs):
-    """Instantiate a registered model by name."""
+def _lookup(name: str) -> Callable:
     try:
-        ctor = _REGISTRY[name]
+        return _REGISTRY[name]
     except KeyError:
         raise ValueError(
             f"unknown model {name!r}; available: {sorted(_REGISTRY)}"
         ) from None
-    return ctor(**kwargs)
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a registered model by name."""
+    return _lookup(name)(**kwargs)
 
 
 def list_models():
     return sorted(_REGISTRY)
+
+
+def model_accepts(name: str, field: str) -> bool:
+    """True if the registered model's constructor takes ``field``.
+
+    Capability probe for CLI flags (e.g. ``--attention`` needs a model
+    with an ``attention_fn`` field) — an explicit check, so a genuine
+    TypeError from a model constructor is never mistaken for a
+    capability mismatch."""
+    import dataclasses
+    import inspect
+
+    ctor = _lookup(name)
+    if dataclasses.is_dataclass(ctor):
+        return field in {f.name for f in dataclasses.fields(ctor)}
+    try:
+        return field in inspect.signature(ctor).parameters
+    except (TypeError, ValueError):
+        return False

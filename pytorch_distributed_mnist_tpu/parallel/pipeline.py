@@ -15,15 +15,20 @@ Differentiable end to end (``scan`` + ``ppermute`` have transposes), so a
 jitted train step backprops through the pipeline with the reverse
 communication pattern — no hand-written backward schedule.
 
-Restrictions (v1): every stage has the same pytree structure and the same
-activation shape in and out; number of stages == size of the ``stage``
-axis; microbatch count must divide the batch.
+Restrictions: every *pipelined* stage has the same pytree structure and
+the same activation shape in and out — which fits any repeated-block
+architecture (each stage = ``depth // S`` transformer blocks; see
+``parallel/pipeline_vit.py`` for the full embed -> blocks -> head model,
+where the ragged-shape embed/head run replicated outside the pipe);
+number of stages == size of the ``stage`` axis; microbatch count must
+divide the (per-dataslice) batch. ``data_axis`` composes DP x PP on one
+mesh: the batch stays sharded on ``data`` while stages ride ``stage``.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,20 +50,32 @@ def pipeline_apply(
     *,
     mesh: Mesh,
     axis: str = "stage",
-    num_microbatches: int = None,
+    num_microbatches: Optional[int] = None,
+    data_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Run ``x`` through S pipelined stages: ``y = f_S(... f_1(x))``.
 
     ``stage_fn(params, h) -> h`` with identical in/out shape;
     ``stage_params`` leaves have leading dim S (use ``stack_stage_params``),
     sharded on ``axis``. ``x`` is the (global) batch, microbatched on dim 0.
-    Returns the full-batch output, replicated over ``axis``.
+    With ``data_axis`` the batch dim stays sharded on that mesh axis (each
+    data slice runs its own pipeline flow over the same stage weights);
+    microbatching then applies to the per-slice batch. Returns the
+    full-batch output, replicated over ``axis``.
     """
     n_stages = mesh.shape[axis]
     m = num_microbatches or n_stages
-    batch = x.shape[0]
+    data_size = mesh.shape[data_axis] if data_axis else 1
+    if x.shape[0] % data_size:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by data axis "
+            f"{data_axis}={data_size}"
+        )
+    batch = x.shape[0] // data_size
     if batch % m:
-        raise ValueError(f"batch {batch} not divisible by microbatches {m}")
+        raise ValueError(
+            f"per-dataslice batch {batch} not divisible by microbatches {m}"
+        )
 
     def body(params_local, xg):
         s = lax.axis_index(axis)
@@ -103,11 +120,12 @@ def pipeline_apply(
     spec_params = jax.tree_util.tree_map(
         lambda _: P(axis), stage_params
     )
+    x_spec = P(data_axis) if data_axis else P()
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec_params, P()),
-        out_specs=P(),
+        in_specs=(spec_params, x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )(stage_params, x)
 

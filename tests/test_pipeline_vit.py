@@ -1,0 +1,167 @@
+"""Pipeline-parallel ViT (parallel/pipeline_vit.py): a REAL model through
+the GPipe machinery — forward parity vs the sequential flax module,
+train-step parity vs the non-pipelined step, the CLI path, and the
+layout's error surface.
+
+The reference has no PP at all (SURVEY.md section 2c); the bar here is
+self-consistency: the pipelined program must be numerically the same model
+as ``VisionTransformer.apply``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+    create_pipelined_vit_state,
+    make_pipelined_vit_apply,
+    merge_vit_params,
+    pipelined_state_sharding,
+    split_vit_params,
+)
+
+
+def _model(depth=4):
+    return get_model("vit", compute_dtype=jnp.float32, depth=depth)
+
+
+def _params(model, seed=0):
+    return model.init(jax.random.key(seed), jnp.zeros((1, 28, 28, 1)))
+
+
+def test_split_merge_round_trip():
+    model = _model()
+    params = _params(model)
+    merged = merge_vit_params(split_vit_params(params))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(merged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize(
+    "mesh_axes,shape,data_axis,depth",
+    [
+        (("data", "stage"), (2, 4), "data", 4),   # DP x PP, 1 block/stage
+        (("data", "stage"), (4, 2), "data", 4),   # DP x PP, 2 blocks/stage
+        (("stage",), (8,), None, 8),              # pure PP
+    ],
+)
+def test_pipelined_forward_matches_sequential(mesh_axes, shape, data_axis,
+                                              depth):
+    model = _model(depth)
+    params = _params(model)
+    x = jax.random.normal(jax.random.key(1), (16, 28, 28, 1))
+    ref = model.apply(params, x)
+    mesh = make_mesh(mesh_axes, shape=shape)
+    apply_fn = make_pipelined_vit_apply(model, mesh, data_axis=data_axis)
+    out = apply_fn(split_vit_params(params), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipelined_train_step_matches_unpipelined(tiny_data):
+    """One optimizer step through the pipeline == one step of the plain
+    model (same init, same batch): gradients flow correctly through
+    scan + ppermute + the replicated embed/head."""
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+    model = _model(depth=4)
+    images, labels = tiny_data
+    batch = {"image": jnp.asarray(images[:32]),
+             "label": jnp.asarray(labels[:32])}
+
+    ref_state = create_train_state(model, jax.random.key(0))
+    ref_step = make_train_step()
+    ref_state, ref_m = ref_step(ref_state, batch)
+
+    mesh = make_mesh(("data", "stage"), shape=(2, 4))
+    pp_state, pp_sharding = create_pipelined_vit_state(
+        model, jax.random.key(0), mesh, data_axis="data"
+    )
+    pp_step = make_train_step(mesh, state_sharding=pp_sharding)
+    from pytorch_distributed_mnist_tpu.data.loader import make_global_batch
+
+    pp_state, pp_m = pp_step(pp_state, make_global_batch(
+        {k: np.asarray(v) for k, v in batch.items()}, mesh))
+
+    assert float(pp_m.loss_sum) == pytest.approx(float(ref_m.loss_sum),
+                                                 rel=1e-5)
+    # Compare GRADIENTS, not post-Adam params: leaves whose true gradient
+    # is ~0 (e.g. the k-bias inside qkv — softmax is shift-invariant) get
+    # an Adam update of sign(noise) * lr, so the params would differ by a
+    # full lr from microbatch-summation noise while the model is exact.
+    from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
+
+    def grads_of(apply_fn, params):
+        def loss_fn(p):
+            return cross_entropy(apply_fn(p, batch["image"], train=True),
+                                 batch["label"])
+        return jax.grad(loss_fn)(params)
+
+    ref_grads = grads_of(model.apply, create_train_state(
+        model, jax.random.key(0)).params)
+    pp_grads = merge_vit_params(grads_of(
+        pp_state.apply_fn,
+        create_pipelined_vit_state(model, jax.random.key(0), mesh,
+                                   data_axis="data")[0].params,
+    ))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(pp_grads)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )
+
+
+def test_blocks_actually_sharded_on_stage(mesh8):
+    model = _model(depth=4)
+    mesh = make_mesh(("data", "stage"), shape=(2, 4))
+    state, _ = create_pipelined_vit_state(model, jax.random.key(0), mesh,
+                                          data_axis="data")
+    qkv = state.params["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv.shape[0] == 4  # leading depth dim
+    assert qkv.sharding.spec == jax.sharding.PartitionSpec("stage")
+    # moments mirror the layout
+    mu = jax.tree.leaves(state.opt_state.inner_state[0].mu["blocks"])[0]
+    assert mu.sharding.spec == jax.sharding.PartitionSpec("stage")
+
+
+def test_depth_not_divisible_raises():
+    model = _model(depth=3)
+    mesh = make_mesh(("data", "stage"), shape=(4, 2))
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipelined_vit_apply(model, mesh)
+
+
+def test_cli_pipeline_end_to_end(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit",
+        "--pipeline-stages", "2", "--epochs", "1", "--batch-size", "64",
+        "--synthetic-train-size", "256", "--synthetic-test-size", "128",
+        "--seed", "0",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    summary = run(args)
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_pipeline_rejects_non_vit(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "cnn",
+        "--pipeline-stages", "2", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="requires --model vit"):
+        run(args)
