@@ -111,6 +111,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ZeRO-1; parallel/zero.py). Params stay "
                         "replicated, XLA turns the grad AllReduce into "
                         "ReduceScatter + AllGather")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans: every jitted step re-runs "
+                        "un-jitted on a NaN/Inf result and raises at the "
+                        "producing primitive (SURVEY.md section 5: the SPMD "
+                        "design removes the reference's shared-mutable-state "
+                        "race class; numeric blowups are the remaining "
+                        "debug target). Slow - debugging only")
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
@@ -196,6 +203,10 @@ def run(args) -> dict:
     # jax.process_index in log0) — jax.distributed.initialize refuses to run
     # after backend init, the analog of init_process_group-before-CUDA order.
     initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+    # Set unconditionally: run() is re-entrant within one process (tests,
+    # benchmarks), and the flag is process-global — a previous debug run
+    # must not leak NaN-trapping into a run that didn't ask for it.
+    jax.config.update("jax_debug_nans", bool(getattr(args, "debug_nans", False)))
     log0(args)  # startup args print parity (:337)
     seed = args.seed if args.seed is not None else 0
     if args.seed is not None:

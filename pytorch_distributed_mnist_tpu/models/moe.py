@@ -9,21 +9,40 @@ expert weights carry a leading ``num_experts`` dim that
 and XLA turns the expert-summed combine einsum into an AllReduce over that
 axis — each device computes only its local experts' FLOPs.
 
-Routing is top-1 (switch) with a straight-through mask: every expert's MLP
-runs on every token algebraically, but the one-hot combine zeroes all but
-the routed expert, and under EP sharding each device only materializes its
-own experts' activations. At MNIST scale this dense-dispatch form costs
-little and keeps the math exactly reproducible across mesh shapes (the
-property the EP tests pin); a capacity-factor all_to_all dispatch is the
-long-context-scale variant and slots behind the same module interface.
+Routing is top-1 (switch). Two dispatch modes behind one interface:
+
+- ``dispatch='dense'`` (default): every expert's MLP runs on every token
+  algebraically, the one-hot combine zeroes all but the routed expert, and
+  under EP sharding each device only materializes its own experts'
+  activations. Layout-independent math — the property the EP equivalence
+  tests pin — and cheap at MNIST scale.
+- ``dispatch='capacity'``: GShard/switch-transformer physical dispatch
+  (parallel/moe_dispatch.py) — tokens go to one expert buffer bounded by
+  ``capacity_factor``, crossing the ``expert`` mesh axis via
+  ``lax.all_to_all``; over-capacity tokens drop (the classifier's residual
+  carries them). Equal to dense dispatch when nothing drops.
+
+Both modes sow the switch load-balancing auxiliary loss under
+``intermediates/aux_loss`` (E * sum_e f_e p_e; 1.0 = uniform): top-1
+routing can collapse onto one expert under real training, so trainers that
+optimize the MoE for accuracy should add ``aux_weight * aux_loss`` to the
+objective (pull it out with ``capture_intermediates``).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from pytorch_distributed_mnist_tpu.models.registry import register_model
+from pytorch_distributed_mnist_tpu.parallel.moe_dispatch import (
+    load_balance_loss,
+    moe_capacity_forward,
+    top1_mask_gate,
+)
 
 
 class SwitchMoE(nn.Module):
@@ -32,6 +51,11 @@ class SwitchMoE(nn.Module):
     num_experts: int = 8
     hidden: int = 128
     compute_dtype: jnp.dtype = jnp.float32
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
+    mesh: Optional[Mesh] = None
+    expert_axis: str = "expert"
+    data_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -40,14 +64,26 @@ class SwitchMoE(nn.Module):
         # Router math in f32: top-1 selection is a discrete decision; bf16
         # logit noise would make routing (and therefore loss) layout-dependent.
         probs = nn.softmax(router(x.astype(jnp.float32)), axis=-1)  # (B, E)
-        top1 = jnp.argmax(probs, axis=-1)  # (B,)
-        mask = jnp.eye(e, dtype=probs.dtype)[top1]  # (B, E) one-hot
-        gate = (probs * mask).sum(-1, keepdims=True)  # (B, 1) routed prob
+        self.sow("intermediates", "aux_loss", load_balance_loss(probs))
 
         w1 = self.param("w1", nn.initializers.lecun_normal(), (e, c, h))
         b1 = self.param("b1", nn.initializers.zeros, (e, h))
         w2 = self.param("w2", nn.initializers.lecun_normal(), (e, h, c))
         b2 = self.param("b2", nn.initializers.zeros, (e, c))
+
+        if self.dispatch == "capacity":
+            out = moe_capacity_forward(
+                x.astype(self.compute_dtype), probs, w1, b1, w2, b2,
+                capacity_factor=self.capacity_factor,
+                compute_dtype=self.compute_dtype, mesh=self.mesh,
+                expert_axis=self.expert_axis, data_axis=self.data_axis,
+            )
+            return out.astype(x.dtype)
+        if self.dispatch != "dense":
+            raise ValueError(f"unknown dispatch {self.dispatch!r}")
+
+        mask, gate = top1_mask_gate(probs)  # (B, E) one-hot, (B,) prob
+        gate = gate[:, None]
         xc = x.astype(self.compute_dtype)
         # (B, E, H): per-expert hidden; E shards on the 'expert' mesh axis.
         hdn = nn.relu(
@@ -72,6 +108,11 @@ class MoEClassifier(nn.Module):
     embed_dim: int = 64
     hidden: int = 128
     compute_dtype: jnp.dtype = jnp.float32
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
+    mesh: Optional[Mesh] = None
+    expert_axis: str = "expert"
+    data_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -80,7 +121,10 @@ class MoEClassifier(nn.Module):
         x = nn.Dense(self.embed_dim, dtype=self.compute_dtype, name="embed")(x)
         x = nn.relu(x)
         x = x + SwitchMoE(
-            self.num_experts, self.hidden, self.compute_dtype, name="moe"
+            self.num_experts, self.hidden, self.compute_dtype,
+            dispatch=self.dispatch, capacity_factor=self.capacity_factor,
+            mesh=self.mesh, expert_axis=self.expert_axis,
+            data_axis=self.data_axis, name="moe",
         )(x)
         x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="head")(x)
         return x.astype(jnp.float32)

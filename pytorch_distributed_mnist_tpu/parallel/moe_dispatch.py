@@ -1,0 +1,175 @@
+"""Capacity-factor MoE dispatch: all_to_all token routing (GShard-style).
+
+The dense-dispatch ``SwitchMoE`` (models/moe.py) runs every expert's FLOPs
+on every token algebraically and lets EP sharding recover the per-device
+FLOPs; that keeps the math layout-independent but moves the full (B, E, H)
+activation through HBM. This module is the scale formulation the docstring
+there promises: each token is physically dispatched to ONE expert's buffer,
+bounded by a capacity factor, and tokens cross the ``expert`` mesh axis as
+one ``lax.all_to_all`` each way — the XLA collective that rides ICI, the
+TPU analog of the reference stack's NCCL alltoall in DeepSpeed-style MoE
+(the reference itself has no experts at all:
+``/root/reference/multi_proc_single_gpu.py:119-126``, SURVEY.md section 2c
+EP ABSENT).
+
+Shape walk (per device, inside shard_map over the ``expert`` axis):
+
+    x_loc (Bg, M) --dispatch one-hot--> (E, Cap, M)        local einsum
+      --all_to_all(expert)-->           (G, E_loc, Cap, M) tokens to owners
+      --expert MLP (local weights)-->   (G, E_loc, Cap, M)
+      --all_to_all back-->              (E, Cap, M)
+      --combine one-hot * gate-->       (Bg, M)
+
+Tokens beyond an expert's capacity ``ceil(Bg * cf / E)`` are dropped (their
+combine weight is zero — the residual connection in ``MoEClassifier``
+carries them through unchanged), the standard switch-transformer contract.
+With no oversubscription the result equals dense dispatch exactly, which
+is what tests/test_moe_dispatch.py pins.
+
+Routing/dispatch tensors are built in f32 (top-1 is a discrete decision;
+bf16 logit noise would make the routing layout-dependent).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "top1_mask_gate",
+    "build_dispatch",
+    "moe_capacity_forward",
+    "load_balance_loss",
+]
+
+
+def top1_mask_gate(probs: jnp.ndarray):
+    """(B, E) router probs -> (one-hot mask (B, E), routed prob gate (B,)).
+
+    THE routing decision, shared by dense dispatch (models/moe.py),
+    capacity dispatch, and the aux loss — one implementation so
+    tie-breaking/dtype changes can never make them disagree (the
+    dense == capacity equivalence tests assume identical routing).
+    """
+    e = probs.shape[-1]
+    mask = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e, dtype=probs.dtype)
+    gate = jnp.sum(probs * mask, axis=-1)
+    return mask, gate
+
+
+def build_dispatch(probs: jnp.ndarray, capacity: int):
+    """(B, E) router probs -> one-hot dispatch/combine (B, E, Cap).
+
+    Top-1 routing with in-order capacity assignment: the k-th token routed
+    to expert e takes slot k; tokens with k >= capacity are dropped (both
+    tensors zero for them).
+    """
+    mask, gate = top1_mask_gate(probs)
+    # 0-indexed arrival position of each token within its expert's queue.
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    keep = mask * (pos < capacity)
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=probs.dtype
+    )  # (B, E, Cap)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def load_balance_loss(probs: jnp.ndarray) -> jnp.ndarray:
+    """Switch-transformer auxiliary loss: ``E * sum_e f_e * p_e``.
+
+    ``f_e`` = fraction of tokens top-1-routed to expert e, ``p_e`` = mean
+    router probability of e. Equals 1.0 under perfectly uniform routing;
+    grows as routing collapses onto few experts. Differentiable through
+    ``p_e`` (the ``f_e`` factor is piecewise constant), which is exactly
+    the gradient the switch paper uses to spread the router.
+    """
+    e = probs.shape[-1]
+    mask, _ = top1_mask_gate(probs)
+    f = jnp.mean(mask, axis=0)
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p)
+
+
+def _expert_mlp(ei, w1, b1, w2, b2, compute_dtype):
+    """(..., E, Cap, M) tokens through per-expert two-layer MLPs."""
+    ei = ei.astype(compute_dtype)
+    h = jax.nn.relu(
+        jnp.einsum("...ecm,emh->...ech", ei, w1.astype(compute_dtype))
+        + b1.astype(compute_dtype)[..., :, None, :]
+    )
+    return (
+        jnp.einsum("...ech,ehm->...ecm", h, w2.astype(compute_dtype))
+        + b2.astype(compute_dtype)[..., :, None, :]
+    )
+
+
+def moe_capacity_forward(
+    x: jnp.ndarray,
+    probs: jnp.ndarray,
+    w1: jnp.ndarray,  # (E, M, H)
+    b1: jnp.ndarray,  # (E, H)
+    w2: jnp.ndarray,  # (E, H, M)
+    b2: jnp.ndarray,  # (E, M)
+    *,
+    capacity_factor: float = 1.25,
+    compute_dtype=jnp.float32,
+    mesh: Optional[Mesh] = None,
+    expert_axis: str = "expert",
+    data_axis: Optional[str] = "data",
+) -> jnp.ndarray:
+    """Capacity-dispatched switch layer: (B, M) -> (B, M).
+
+    Without a mesh (or with a 1-sized expert axis) this is the pure local
+    program — same math, no collectives — used by tests as the oracle for
+    the distributed path. With a mesh, tokens are grouped over
+    ``(data_axis, expert_axis)`` and experts over ``expert_axis``; the two
+    ``all_to_all``s exchange token buffers with expert owners.
+    """
+    e = w1.shape[0]
+
+    def local_forward(x_loc, probs_loc, w1_l, b1_l, w2_l, b2_l, n_groups):
+        bg = x_loc.shape[0]
+        capacity = max(1, math.ceil(bg * capacity_factor / e))
+        dispatch, combine = build_dispatch(probs_loc.astype(jnp.float32),
+                                           capacity)
+        ei = jnp.einsum("bec,bm->ecm", dispatch.astype(x_loc.dtype), x_loc)
+        if n_groups == 1:
+            y = _expert_mlp(ei, w1_l, b1_l, w2_l, b2_l, compute_dtype)
+        else:
+            e_loc = e // n_groups
+            ei = ei.reshape((n_groups, e_loc) + ei.shape[1:])
+            # (G, E_loc, Cap, M): dim 0 becomes the sender-group index.
+            ei = lax.all_to_all(ei, expert_axis, split_axis=0, concat_axis=0)
+            y = _expert_mlp(ei, w1_l, b1_l, w2_l, b2_l, compute_dtype)
+            y = lax.all_to_all(y, expert_axis, split_axis=0, concat_axis=0)
+            y = y.reshape((e,) + y.shape[2:])
+        return jnp.einsum(
+            "ecm,bec->bm", y.astype(jnp.float32), combine
+        ).astype(x_loc.dtype)
+
+    if mesh is None or mesh.shape.get(expert_axis, 1) == 1:
+        return local_forward(x, probs, w1, b1, w2, b2, 1)
+
+    n = mesh.shape[expert_axis]
+    if e % n:
+        raise ValueError(f"{e} experts not divisible by {expert_axis}={n}")
+    token_axes = (
+        (data_axis, expert_axis)
+        if data_axis and mesh.shape.get(data_axis, 1) > 1
+        else (expert_axis,)
+    )
+    tok = P(token_axes)
+    ex = P(expert_axis)
+    return jax.shard_map(
+        lambda *a: local_forward(*a, n),
+        mesh=mesh,
+        in_specs=(tok, tok, ex, ex, ex, ex),
+        out_specs=tok,
+        check_vma=False,
+    )(x, probs, w1, b1, w2, b2)

@@ -74,3 +74,30 @@ def test_fashion_mnist_dataset_flag(tmp_path):
     # (BASELINE config 5's dataset swap-in is a flag, not a code edit).
     out = run(make_args(tmp_path, dataset="fashion_mnist", epochs=1))
     assert out["epochs_run"] == 1
+
+
+def test_debug_nans_flag(tmp_path):
+    """--debug-nans wires jax_debug_nans: a healthy run still passes, and a
+    poisoned loss raises FloatingPointError at the producing op (SURVEY.md
+    section 5's NaN-debug subsystem)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "128",
+        "--synthetic-test-size", "64", "--debug-nans",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    try:
+        summary = run(args)
+        assert jnp.isfinite(summary["history"][0]["train_loss"])
+        # the flag is active process-wide: a NaN-producing jitted op raises
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.zeros(4) - 1.0).block_until_ready()
+    finally:
+        jax.config.update("jax_debug_nans", False)
