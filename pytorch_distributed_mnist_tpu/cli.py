@@ -166,7 +166,10 @@ def _build_loaders(args, seed: int):
                 synthesize = True
                 name = "mnist"
 
+    used_synthetic = synthesize
+
     def load_split(train: bool):
+        nonlocal used_synthetic
         n = args.synthetic_train_size if train else args.synthetic_test_size
         if not synthesize:
             try:
@@ -176,6 +179,7 @@ def _build_loaders(args, seed: int):
                 split = "train" if train else "test"
                 log0(f"WARNING: no {name} {split}-split IDX files under "
                      f"{args.root!r}; using the synthetic fallback dataset")
+                used_synthetic = True
         return load_dataset(args.root, name, train=train,
                             synthetic_train_size=n, synthetic_test_size=n,
                             seed=seed)
@@ -194,19 +198,31 @@ def _build_loaders(args, seed: int):
         num_replicas=nproc, rank=pid, seed=seed, workers=args.workers,
         shard=nproc > 1,
     )
-    return train_loader, test_loader
+    return train_loader, test_loader, used_synthetic
 
 
-def run(args) -> dict:
-    """Per-process SPMD lifecycle; returns a summary dict for tests/benchmarks."""
+def run(args, epoch_callback=None) -> dict:
+    """Per-process SPMD lifecycle; returns a summary dict for tests/benchmarks.
+
+    ``epoch_callback(epoch, history_row) -> bool`` (optional) fires after
+    each epoch's train+eval+checkpoint; returning True stops the loop early
+    (tools/northstar.py uses this to stop at the target accuracy).
+    """
     # Must run before ANY jax call that initializes the backend (including
     # jax.process_index in log0) — jax.distributed.initialize refuses to run
     # after backend init, the analog of init_process_group-before-CUDA order.
     initialize_distributed(args.coordinator, args.num_processes, args.process_id)
-    # Set unconditionally: run() is re-entrant within one process (tests,
-    # benchmarks), and the flag is process-global — a previous debug run
-    # must not leak NaN-trapping into a run that didn't ask for it.
-    jax.config.update("jax_debug_nans", bool(getattr(args, "debug_nans", False)))
+    # run() is re-entrant within one process (tests, benchmarks) and the
+    # flag is process-global, so a previous debug run must not leak
+    # NaN-trapping into a later run that didn't ask for it — but a user's
+    # own JAX_DEBUG_NANS env (the standard JAX switch, honored at import)
+    # must not be clobbered by the flag's default either.
+    import os as _os
+
+    debug_nans = bool(getattr(args, "debug_nans", False)) or bool(
+        _os.environ.get("JAX_DEBUG_NANS")
+    )
+    jax.config.update("jax_debug_nans", debug_nans)
     log0(args)  # startup args print parity (:337)
     seed = args.seed if args.seed is not None else 0
     if args.seed is not None:
@@ -289,7 +305,7 @@ def run(args) -> dict:
 
         state, state_sharding = shard_state_zero1(state, mesh)
 
-    train_loader, test_loader = _build_loaders(args, seed)
+    train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
                       mode=args.trainer_mode, state_sharding=state_sharding)
     lr_of = step_decay_schedule(args.lr)
@@ -327,12 +343,15 @@ def run(args) -> dict:
                             "train_acc": train_acc.accuracy,
                             "test_loss": test_loss.average,
                             "test_acc": test_acc.accuracy})
+            if epoch_callback is not None and epoch_callback(epoch, history[-1]):
+                break
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
          f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")
     return {"best_acc": best_acc, "history": history,
             "images_per_sec": ips,
             "images_per_sec_per_chip": timer.images_per_sec_per_chip,
+            "dataset_synthesized": dataset_synthesized,
             "epochs_run": len(history)}
 
 

@@ -164,6 +164,15 @@ def moe_capacity_forward(
         if data_axis and mesh.shape.get(data_axis, 1) > 1
         else (expert_axis,)
     )
+    n_groups = 1
+    for a in token_axes:
+        n_groups *= mesh.shape[a]
+    if x.shape[0] % n_groups:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by the {n_groups} token "
+            f"groups of mesh axes {token_axes} (capacity dispatch shards "
+            f"tokens over them)"
+        )
     tok = P(token_axes)
     ex = P(expert_axis)
     return jax.shard_map(
