@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline-parallel stages for --model vit (GPipe "
                         "over a 'stage' mesh axis; devices are split "
                         "data x stage, vit depth must divide evenly)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="tensor-parallel width for --model vit (Megatron "
+                        "column/row rules over a 'model' mesh axis; "
+                        "devices are split data x model; composes with "
+                        "--optimizer-sharding zero1)")
     p.add_argument("--optimizer-sharding", type=str, default="none",
                    choices=["none", "zero1"],
                    help="zero1 = shard Adam moments over the data axis "
@@ -230,6 +235,12 @@ def run(args, epoch_callback=None) -> dict:
         np.random.seed(args.seed)
 
     pp = getattr(args, "pipeline_stages", 1)
+    tp = getattr(args, "tensor_parallel", 1)
+    if pp > 1 and tp > 1:
+        raise SystemExit(
+            "--pipeline-stages and --tensor-parallel do not compose yet; "
+            "pick one model-sharding axis"
+        )
     if pp > 1:
         if args.model != "vit":
             raise SystemExit(
@@ -249,6 +260,27 @@ def run(args, epoch_callback=None) -> dict:
             )
         mesh = make_mesh(("data", "stage"),
                          shape=(jax.device_count() // pp, pp))
+    elif tp > 1:
+        if args.model != "vit":
+            raise SystemExit(
+                f"--tensor-parallel requires --model vit (the Megatron "
+                f"rule table targets its qkv/proj/mlp blocks; a model "
+                f"without them would silently stay replicated); got "
+                f"--model {args.model}"
+            )
+        if getattr(args, "attention", "dense") == "flash":
+            raise SystemExit(
+                "--tensor-parallel requires --attention dense: the Pallas "
+                "flash kernel is not SPMD-partitionable by GSPMD (the "
+                "ring/Ulysses library APIs are the sequence-sharded path)"
+            )
+        if jax.device_count() % tp:
+            raise SystemExit(
+                f"--tensor-parallel {tp} does not divide the "
+                f"{jax.device_count()} available devices"
+            )
+        mesh = make_mesh(("data", "model"),
+                         shape=(jax.device_count() // tp, tp))
     else:
         mesh = make_mesh(("data",))
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
@@ -292,7 +324,22 @@ def run(args, epoch_callback=None) -> dict:
         start_epoch = args.start_epoch
 
     state_sharding = pp_sharding
-    if getattr(args, "optimizer_sharding", "none") == "zero1":
+    tp_rules = None
+    zero1 = getattr(args, "optimizer_sharding", "none") == "zero1"
+    if tp > 1:
+        from pytorch_distributed_mnist_tpu.parallel.tensor import (
+            shard_state,
+            state_shardings,
+            vit_tp_rules,
+        )
+
+        tp_rules = vit_tp_rules("model")
+        if not zero1:
+            # With zero1, shard_state_zero1 below applies the TP rules
+            # itself — placing here too would move the whole state twice.
+            state = shard_state(state, mesh, tp_rules)
+            state_sharding = state_shardings(state, mesh, tp_rules)
+    if zero1:
         if args.optimizer not in ("adam", "adam_pallas"):
             # ZeRO-1 shards Adam's mu/nu moment trees; SGD has no moment
             # leaves, so the request would silently do nothing.
@@ -303,7 +350,9 @@ def run(args, epoch_callback=None) -> dict:
             )
         from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero1
 
-        state, state_sharding = shard_state_zero1(state, mesh)
+        # With --tensor-parallel, the TP rule table composes: TP-ruled
+        # leaves keep their layout, ZeRO claims the rest of the moments.
+        state, state_sharding = shard_state_zero1(state, mesh, rules=tp_rules)
 
     train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,

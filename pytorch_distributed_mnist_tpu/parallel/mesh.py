@@ -51,3 +51,27 @@ def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for params/opt state: fully replicated (DDP-style weights)."""
     return NamedSharding(mesh, P())
+
+
+def place_state(state, sharding_tree):
+    """Place a pytree onto a sharding tree, multi-host safe.
+
+    Single process: plain ``jax.device_put``. Multi-host: ``device_put`` of
+    a committed per-host array onto a cross-host sharding demands backend
+    cross-host transfer support, but every caller here holds the FULL value
+    on every host (fresh replicated init, or a checkpoint stitched on each
+    host), so each host just materializes its own shards from its host copy
+    via ``make_array_from_callback`` — no bytes cross the network. Shared by
+    the TP/EP (``parallel.tensor.shard_state``) and ZeRO
+    (``parallel.zero.shard_state_zero1``) placement paths.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(state, sharding_tree)
+
+    def place(leaf, sh):
+        host = np.asarray(leaf)  # replicated/addressable on every host
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx, a=host: a[idx]
+        )
+
+    return jax.tree_util.tree_map(place, state, sharding_tree)

@@ -69,8 +69,12 @@ def state_shardings(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
 
 
 def shard_state(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
-    """Place an (unsharded) TrainState onto the mesh per the rule table."""
-    return jax.device_put(state, state_shardings(state, mesh, rules))
+    """Place an (unsharded) TrainState onto the mesh per the rule table.
+
+    Multi-host safe (see ``parallel.mesh.place_state``)."""
+    from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
+
+    return place_state(state, state_shardings(state, mesh, rules))
 
 
 def make_tp_train_step(mesh: Mesh, state_sharding, data_axis: str = "data"):

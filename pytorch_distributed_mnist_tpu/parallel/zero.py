@@ -27,7 +27,6 @@ from __future__ import annotations
 from typing import Dict, Optional, Tuple
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_mnist_tpu.parallel.tensor import leaf_spec, _path_keys
@@ -84,23 +83,11 @@ def shard_state_zero1(state, mesh: Mesh, data_axis: str = "data",
                       rules: Optional[Dict[Tuple[str, str], P]] = None):
     """Place a TrainState onto the mesh with ZeRO-1 moment sharding.
 
-    On a multi-host mesh, placement cannot be ``jax.device_put``: moving an
-    already-committed array (a fresh per-host init, or a leaf restored from
-    a checkpoint) onto a cross-host sharding demands backend cross-host
-    transfer support. But every caller of this function holds the FULL
-    value on every host (replicated DP state / stitched checkpoint), so
-    each host can just materialize its own shards from its host copy via
-    ``make_array_from_callback`` — zero bytes cross the network.
+    Multi-host placement goes through ``parallel.mesh.place_state`` (each
+    host materializes its shards from its full host copy; ``device_put``
+    of committed arrays onto cross-host shardings is unsupported).
     """
-    sharding = zero1_state_sharding(state, mesh, data_axis, rules)
-    if jax.process_count() > 1:
-        def place(leaf, sh):
-            host = np.asarray(leaf)  # replicated/addressable on every host
-            return jax.make_array_from_callback(
-                host.shape, sh, lambda idx, a=host: a[idx]
-            )
+    from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
 
-        placed = jax.tree_util.tree_map(place, state, sharding)
-    else:
-        placed = jax.device_put(state, sharding)
-    return placed, sharding
+    sharding = zero1_state_sharding(state, mesh, data_axis, rules)
+    return place_state(state, sharding), sharding

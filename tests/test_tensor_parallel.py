@@ -97,3 +97,54 @@ def test_tp_eval_step_equals_single_device(batch):
     mt = ev_tp(sstate, batch)
     np.testing.assert_allclose(float(mt.loss_sum), float(m1.loss_sum), rtol=1e-4)
     assert int(mt.correct) == int(m1.correct)
+
+
+def test_cli_tensor_parallel_end_to_end(tmp_path):
+    """--tensor-parallel 2 trains the ViT through the full driver on a
+    data x model mesh, matching the plain-DP run's metrics (TP is a layout
+    change, not a math change)."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    base = [
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0",
+        "--root", str(tmp_path / "data"),
+    ]
+    tp_summary = run(build_parser().parse_args(
+        base + ["--tensor-parallel", "2",
+                "--checkpoint-dir", str(tmp_path / "ckpt_tp")]))
+    dp_summary = run(build_parser().parse_args(
+        base + ["--checkpoint-dir", str(tmp_path / "ckpt_dp")]))
+    assert tp_summary["history"][0]["train_loss"] == pytest.approx(
+        dp_summary["history"][0]["train_loss"], rel=1e-4)
+    assert tp_summary["history"][0]["test_acc"] == pytest.approx(
+        dp_summary["history"][0]["test_acc"], abs=1e-6)
+
+
+def test_cli_tensor_parallel_composes_with_zero1(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    summary = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0",
+        "--tensor-parallel", "2", "--optimizer-sharding", "zero1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ]))
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_tensor_parallel_rejects_non_vit(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "cnn", "--epochs", "1",
+        "--tensor-parallel", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="requires --model vit"):
+        run(args)
