@@ -109,7 +109,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel width for --model vit (Megatron "
                         "column/row rules over a 'model' mesh axis; "
                         "devices are split data x model; composes with "
-                        "--optimizer-sharding zero1)")
+                        "--optimizer-sharding zero1 and "
+                        "--sequence-parallel)")
+    p.add_argument("--sequence-parallel", type=int, default=1,
+                   help="sequence-parallel width for --model vit: the token "
+                        "axis is sharded over a 'seq' mesh axis and every "
+                        "block's attention runs as ring attention "
+                        "(neighbor ppermute over ICI, parallel/ring.py). "
+                        "Token count (28/patch)^2 must divide evenly — "
+                        "e.g. --patch-size 7 gives 16 tokens")
+    p.add_argument("--patch-size", type=int, default=4,
+                   help="ViT patch size (28 must divide evenly; tokens = "
+                        "(28/patch)^2)")
     p.add_argument("--optimizer-sharding", type=str, default="none",
                    choices=["none", "zero1"],
                    help="zero1 = shard Adam moments over the data axis "
@@ -236,10 +247,17 @@ def run(args, epoch_callback=None) -> dict:
 
     pp = getattr(args, "pipeline_stages", 1)
     tp = getattr(args, "tensor_parallel", 1)
-    if pp > 1 and tp > 1:
+    sp = getattr(args, "sequence_parallel", 1)
+    patch = getattr(args, "patch_size", 4)
+    if patch < 1 or 28 % patch:
         raise SystemExit(
-            "--pipeline-stages and --tensor-parallel do not compose yet; "
-            "pick one model-sharding axis"
+            f"--patch-size {patch}: 28 must divide evenly into patches "
+            f"(try 2, 4, 7, or 14)"
+        )
+    if pp > 1 and (tp > 1 or sp > 1):
+        raise SystemExit(
+            "--pipeline-stages does not compose with --tensor-parallel/"
+            "--sequence-parallel yet; pick pipeline or the TP/SP mesh"
         )
     if pp > 1:
         if args.model != "vit":
@@ -260,27 +278,50 @@ def run(args, epoch_callback=None) -> dict:
             )
         mesh = make_mesh(("data", "stage"),
                          shape=(jax.device_count() // pp, pp))
-    elif tp > 1:
+    elif tp > 1 or sp > 1:
         if args.model != "vit":
             raise SystemExit(
-                f"--tensor-parallel requires --model vit (the Megatron "
-                f"rule table targets its qkv/proj/mlp blocks; a model "
-                f"without them would silently stay replicated); got "
-                f"--model {args.model}"
+                f"--tensor-parallel/--sequence-parallel require --model "
+                f"vit (the Megatron rule table and the ring attention "
+                f"target its blocks; other models would silently stay "
+                f"replicated); got --model {args.model}"
             )
         if getattr(args, "attention", "dense") == "flash":
             raise SystemExit(
-                "--tensor-parallel requires --attention dense: the Pallas "
-                "flash kernel is not SPMD-partitionable by GSPMD (the "
-                "ring/Ulysses library APIs are the sequence-sharded path)"
+                "--tensor-parallel/--sequence-parallel require "
+                "--attention dense: the Pallas flash kernel is not "
+                "GSPMD-partitionable, and the ring supplies its own "
+                "blockwise attention"
             )
-        if jax.device_count() % tp:
+        if jax.device_count() % (tp * sp):
             raise SystemExit(
-                f"--tensor-parallel {tp} does not divide the "
-                f"{jax.device_count()} available devices"
+                f"--tensor-parallel {tp} x --sequence-parallel {sp} does "
+                f"not divide the {jax.device_count()} available devices"
             )
-        mesh = make_mesh(("data", "model"),
-                         shape=(jax.device_count() // tp, tp))
+        if sp > 1:
+            tokens = (28 // patch) ** 2
+            if tokens % sp:
+                raise SystemExit(
+                    f"--sequence-parallel {sp} needs the token count "
+                    f"(28/patch)^2 divisible by it; --patch-size {patch} "
+                    f"gives {tokens} tokens — try --patch-size 7 "
+                    f"(16 tokens)"
+                )
+            if args.trainer_mode == "explicit":
+                raise SystemExit(
+                    "--sequence-parallel does not compose with "
+                    "--trainer-mode explicit (the ring's shard_map cannot "
+                    "nest inside the explicit-DP shard_map); use scan or "
+                    "stepwise"
+                )
+            if tp > 1 and 4 % tp:  # ViT num_heads is 4
+                raise SystemExit(
+                    f"--tensor-parallel {tp} with --sequence-parallel: the "
+                    f"ring shards the ViT's 4 attention heads exactly over "
+                    f"the model axis, so the width must divide 4"
+                )
+        mesh = make_mesh(("data", "model", "seq"),
+                         shape=(jax.device_count() // (tp * sp), tp, sp))
     else:
         mesh = make_mesh(("data",))
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
@@ -298,6 +339,28 @@ def run(args, epoch_callback=None) -> dict:
         from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
 
         model_kwargs["attention_fn"] = flash_attention
+    if patch != 4:
+        if not model_accepts(args.model, "patch_size"):
+            raise SystemExit(
+                f"--patch-size only applies to models with patches; "
+                f"{args.model!r} does not accept one"
+            )
+        model_kwargs["patch_size"] = patch
+    init_model = None  # a dense-attention twin when the real apply can't init
+    if sp > 1:
+        from functools import partial as _partial
+
+        from pytorch_distributed_mnist_tpu.parallel.ring import ring_attention
+
+        # Params are attention-impl-independent; init must use the dense
+        # twin (the batch-1 init trace can't satisfy the ring's data-axis
+        # sharding), then the sequence-parallel apply_fn is swapped in —
+        # the same pattern the dryrun's DP x TP x SP phase uses.
+        init_model = get_model(args.model, **model_kwargs)
+        model_kwargs["attention_fn"] = _partial(
+            ring_attention, mesh=mesh, axis="seq", batch_axis="data",
+            head_axis="model" if tp > 1 else None,
+        )
     model = get_model(args.model, **model_kwargs)
     pp_sharding = None
     if pp > 1:
@@ -312,10 +375,12 @@ def run(args, epoch_callback=None) -> dict:
         )
     else:
         state = create_train_state(
-            model, jax.random.key(seed), lr=args.lr,
+            init_model or model, jax.random.key(seed), lr=args.lr,
             optimizer=args.optimizer, momentum=args.momentum,
             weight_decay=args.weight_decay,
         )
+        if init_model is not None:
+            state = state.replace(apply_fn=model.apply)
     state, start_epoch, best_acc = try_resume(args.resume, state)
     resumed = args.resume and start_epoch > 0
     if not resumed:
@@ -329,7 +394,6 @@ def run(args, epoch_callback=None) -> dict:
     if tp > 1:
         from pytorch_distributed_mnist_tpu.parallel.tensor import (
             shard_state,
-            state_shardings,
             vit_tp_rules,
         )
 
@@ -337,8 +401,7 @@ def run(args, epoch_callback=None) -> dict:
         if not zero1:
             # With zero1, shard_state_zero1 below applies the TP rules
             # itself — placing here too would move the whole state twice.
-            state = shard_state(state, mesh, tp_rules)
-            state_sharding = state_shardings(state, mesh, tp_rules)
+            state, state_sharding = shard_state(state, mesh, tp_rules)
     if zero1:
         if args.optimizer not in ("adam", "adam_pallas"):
             # ZeRO-1 shards Adam's mu/nu moment trees; SGD has no moment

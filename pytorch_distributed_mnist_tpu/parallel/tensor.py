@@ -71,10 +71,14 @@ def state_shardings(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
 def shard_state(state, mesh: Mesh, rules: Dict[Tuple[str, str], P]):
     """Place an (unsharded) TrainState onto the mesh per the rule table.
 
-    Multi-host safe (see ``parallel.mesh.place_state``)."""
+    Returns ``(placed_state, sharding_tree)`` — the same pair contract as
+    ``shard_state_zero1`` and ``create_pipelined_vit_state``, so callers
+    never recompute the tree. Multi-host safe
+    (see ``parallel.mesh.place_state``)."""
     from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
 
-    return place_state(state, state_shardings(state, mesh, rules))
+    sharding = state_shardings(state, mesh, rules)
+    return place_state(state, sharding), sharding
 
 
 def make_tp_train_step(mesh: Mesh, state_sharding, data_axis: str = "data"):

@@ -125,7 +125,6 @@ def test_moe_classifier_capacity_trains(mesh8, tiny_data):
     from pytorch_distributed_mnist_tpu.parallel.tensor import (
         make_tp_train_step,
         shard_state,
-        state_shardings,
     )
     from pytorch_distributed_mnist_tpu.train.state import create_train_state
     from pytorch_distributed_mnist_tpu.data.loader import make_global_batch
@@ -139,8 +138,8 @@ def test_moe_classifier_capacity_trains(mesh8, tiny_data):
     state = create_train_state(get_model("moe_mlp"), jax.random.key(0))
     state = state.replace(apply_fn=model.apply)
     rules = moe_ep_rules("expert")
-    state = shard_state(state, mesh, rules)
-    step = make_tp_train_step(mesh, state_shardings(state, mesh, rules))
+    state, sharding = shard_state(state, mesh, rules)
+    step = make_tp_train_step(mesh, sharding)
     images, labels = tiny_data
     batch = make_global_batch(
         {"image": np.asarray(images[:32]), "label": np.asarray(labels[:32])},

@@ -67,7 +67,7 @@ def test_tp_step_equals_single_device_step(batch):
 
     mesh = make_mesh(("data", "model"), shape=(4, 2))
     rules = vit_tp_rules()
-    state_tp = shard_state(state_tp, mesh, rules)
+    state_tp, _ = shard_state(state_tp, mesh, rules)
     step_1d = make_train_step()
     step_tp = make_tp_train_step(mesh, state_shardings(state_tp, mesh, rules))
 
@@ -88,7 +88,7 @@ def test_tp_eval_step_equals_single_device(batch):
     state = create_train_state(model, jax.random.key(1))
     mesh = make_mesh(("data", "model"), shape=(2, 4))
     rules = vit_tp_rules()
-    sstate = shard_state(state, mesh, rules)
+    sstate, _ = shard_state(state, mesh, rules)
     ev_tp = make_tp_eval_step(mesh, state_shardings(sstate, mesh, rules))
 
     from pytorch_distributed_mnist_tpu.train.steps import make_eval_step
@@ -146,5 +146,56 @@ def test_cli_tensor_parallel_rejects_non_vit(tmp_path):
         "--checkpoint-dir", str(tmp_path / "ckpt"),
         "--root", str(tmp_path / "data"),
     ])
-    with pytest.raises(SystemExit, match="requires --model vit"):
+    with pytest.raises(SystemExit, match="require --model vit"):
+        run(args)
+
+
+def test_cli_sequence_parallel_matches_dense(tmp_path):
+    """--sequence-parallel 2 (ring attention) matches the dense-attention
+    run's metrics: the ring is the same softmax, blockwise."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    base = [
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0", "--patch-size", "7",
+        "--root", str(tmp_path / "data"),
+    ]
+    sp = run(build_parser().parse_args(
+        base + ["--sequence-parallel", "2",
+                "--checkpoint-dir", str(tmp_path / "ckpt_sp")]))
+    dense = run(build_parser().parse_args(
+        base + ["--checkpoint-dir", str(tmp_path / "ckpt_d")]))
+    assert sp["history"][0]["train_loss"] == pytest.approx(
+        dense["history"][0]["train_loss"], rel=1e-4)
+    assert sp["history"][0]["test_acc"] == pytest.approx(
+        dense["history"][0]["test_acc"], abs=1e-6)
+
+
+def test_cli_dp_tp_sp_composed(tmp_path):
+    """The full 3-axis mesh (data x model x seq) trains from the CLI."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    summary = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0", "--patch-size", "7",
+        "--sequence-parallel", "2", "--tensor-parallel", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ]))
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_sequence_parallel_rejects_indivisible_tokens(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--sequence-parallel", "2",  # default patch 4 -> 49 tokens
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="patch-size 7"):
         run(args)
