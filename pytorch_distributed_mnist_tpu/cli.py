@@ -122,11 +122,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ViT patch size (28 must divide evenly; tokens = "
                         "(28/patch)^2)")
     p.add_argument("--optimizer-sharding", type=str, default="none",
-                   choices=["none", "zero1"],
+                   choices=["none", "zero1", "zero3"],
                    help="zero1 = shard Adam moments over the data axis "
                         "(ZeRO-1; parallel/zero.py). Params stay "
                         "replicated, XLA turns the grad AllReduce into "
-                        "ReduceScatter + AllGather")
+                        "ReduceScatter + AllGather. zero3 = shard params "
+                        "too (FSDP-style: each host stores 1/N of the "
+                        "model between steps, AllGather on use)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans: every jitted step re-runs "
                         "un-jitted on a NaN/Inf result and raises at the "
@@ -314,11 +316,22 @@ def run(args, epoch_callback=None) -> dict:
                     "nest inside the explicit-DP shard_map); use scan or "
                     "stepwise"
                 )
-            if tp > 1 and 4 % tp:  # ViT num_heads is 4
+            import dataclasses as _dc
+
+            from pytorch_distributed_mnist_tpu.models.attention import (
+                VisionTransformer,
+            )
+
+            num_heads = next(
+                f.default for f in _dc.fields(VisionTransformer)
+                if f.name == "num_heads"
+            )
+            if tp > 1 and num_heads % tp:
                 raise SystemExit(
                     f"--tensor-parallel {tp} with --sequence-parallel: the "
-                    f"ring shards the ViT's 4 attention heads exactly over "
-                    f"the model axis, so the width must divide 4"
+                    f"ring shards the ViT's {num_heads} attention heads "
+                    f"exactly over the model axis, so the width must "
+                    f"divide {num_heads}"
                 )
         mesh = make_mesh(("data", "model", "seq"),
                          shape=(jax.device_count() // (tp * sp), tp, sp))
@@ -390,7 +403,7 @@ def run(args, epoch_callback=None) -> dict:
 
     state_sharding = pp_sharding
     tp_rules = None
-    zero1 = getattr(args, "optimizer_sharding", "none") == "zero1"
+    zero = getattr(args, "optimizer_sharding", "none")
     if tp > 1:
         from pytorch_distributed_mnist_tpu.parallel.tensor import (
             shard_state,
@@ -398,24 +411,28 @@ def run(args, epoch_callback=None) -> dict:
         )
 
         tp_rules = vit_tp_rules("model")
-        if not zero1:
-            # With zero1, shard_state_zero1 below applies the TP rules
-            # itself — placing here too would move the whole state twice.
+        if zero == "none":
+            # With zero sharding, shard_state_zero below applies the TP
+            # rules itself — placing here too would move the state twice.
             state, state_sharding = shard_state(state, mesh, tp_rules)
-    if zero1:
-        if args.optimizer not in ("adam", "adam_pallas"):
+    if zero != "none":
+        if zero == "zero1" and args.optimizer not in ("adam", "adam_pallas"):
             # ZeRO-1 shards Adam's mu/nu moment trees; SGD has no moment
-            # leaves, so the request would silently do nothing.
+            # leaves, so the request would silently do nothing. (zero3
+            # shards params too, which every optimizer has.)
             raise SystemExit(
                 f"--optimizer-sharding zero1 requires an Adam optimizer "
                 f"(got --optimizer {args.optimizer}: no mu/nu moment state "
                 f"to shard)"
             )
-        from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero1
+        from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
 
         # With --tensor-parallel, the TP rule table composes: TP-ruled
-        # leaves keep their layout, ZeRO claims the rest of the moments.
-        state, state_sharding = shard_state_zero1(state, mesh, rules=tp_rules)
+        # leaves keep their layout, ZeRO claims the rest.
+        state, state_sharding = shard_state_zero(
+            state, mesh, rules=tp_rules,
+            level=3 if zero == "zero3" else 1,
+        )
 
     train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,

@@ -51,37 +51,66 @@ def _zero_spec(shape: Tuple[int, ...], axis_size: int, axis: str, base: P) -> P:
     return P(*entries)
 
 
+def _is_param_path(path) -> bool:
+    keys = _path_keys(path)
+    return bool(keys) and keys[0] == "params"
+
+
+def zero_state_sharding(
+    state,
+    mesh: Mesh,
+    data_axis: str = "data",
+    rules: Optional[Dict[Tuple[str, str], P]] = None,
+    level: int = 1,
+):
+    """NamedSharding pytree for a TrainState with ZeRO-style sharding.
+
+    ``level=1``: Adam ``mu``/``nu`` sharded over ``data_axis``, params
+    replicated (the classic optimizer-state partition). ``level=3``:
+    params sharded the same way too (FSDP-style) — XLA's sharding
+    propagation inserts the AllGather before each use in forward/backward
+    and a ReduceScatter for the gradients, so between steps every host
+    stores only its 1/N param shard.
+
+    ``rules`` is an optional TP rule table (``parallel/tensor.py``); leaves
+    it matches keep the TP layout everywhere (params AND moments — TP
+    moments must mirror their params), and ZeRO sharding applies to the
+    remaining leaves only.
+    """
+    if level not in (1, 3):
+        raise ValueError(f"zero level must be 1 or 3, got {level}")
+    rules = rules or {}
+    axis_size = mesh.shape[data_axis]
+
+    def spec_for(path, leaf):
+        base = leaf_spec(path, rules)
+        claimed = _is_moment_path(path) or (
+            level == 3 and _is_param_path(path)
+        )
+        if not claimed:
+            return NamedSharding(mesh, base)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if base != P():
+            return NamedSharding(mesh, base)  # TP-ruled leaf: keep layout
+        return NamedSharding(mesh, _zero_spec(shape, axis_size, data_axis, base))
+
+    return jax.tree_util.tree_map_with_path(spec_for, state)
+
+
 def zero1_state_sharding(
     state,
     mesh: Mesh,
     data_axis: str = "data",
     rules: Optional[Dict[Tuple[str, str], P]] = None,
 ):
-    """NamedSharding pytree for a TrainState with ZeRO-1 moment sharding.
-
-    ``rules`` is an optional TP rule table (``parallel/tensor.py``); leaves
-    it matches keep the TP layout everywhere (params AND moments — TP
-    moments must mirror their params), and ZeRO sharding applies to the
-    remaining moment leaves only.
-    """
-    rules = rules or {}
-    axis_size = mesh.shape[data_axis]
-
-    def spec_for(path, leaf):
-        base = leaf_spec(path, rules)
-        if not _is_moment_path(path):
-            return NamedSharding(mesh, base)
-        shape = tuple(getattr(leaf, "shape", ()) or ())
-        if base != P():
-            return NamedSharding(mesh, base)  # TP-ruled moment: keep layout
-        return NamedSharding(mesh, _zero_spec(shape, axis_size, data_axis, base))
-
-    return jax.tree_util.tree_map_with_path(spec_for, state)
+    """ZeRO-1 sharding tree (see ``zero_state_sharding``, level 1)."""
+    return zero_state_sharding(state, mesh, data_axis, rules, level=1)
 
 
-def shard_state_zero1(state, mesh: Mesh, data_axis: str = "data",
-                      rules: Optional[Dict[Tuple[str, str], P]] = None):
-    """Place a TrainState onto the mesh with ZeRO-1 moment sharding.
+def shard_state_zero(state, mesh: Mesh, data_axis: str = "data",
+                     rules: Optional[Dict[Tuple[str, str], P]] = None,
+                     level: int = 1):
+    """Place a TrainState onto the mesh with ZeRO-``level`` sharding.
 
     Multi-host placement goes through ``parallel.mesh.place_state`` (each
     host materializes its shards from its full host copy; ``device_put``
@@ -89,5 +118,11 @@ def shard_state_zero1(state, mesh: Mesh, data_axis: str = "data",
     """
     from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
 
-    sharding = zero1_state_sharding(state, mesh, data_axis, rules)
+    sharding = zero_state_sharding(state, mesh, data_axis, rules, level)
     return place_state(state, sharding), sharding
+
+
+def shard_state_zero1(state, mesh: Mesh, data_axis: str = "data",
+                      rules: Optional[Dict[Tuple[str, str], P]] = None):
+    """ZeRO-1 placement (see ``shard_state_zero``)."""
+    return shard_state_zero(state, mesh, data_axis, rules, level=1)

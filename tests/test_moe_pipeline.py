@@ -67,9 +67,9 @@ def test_ep_step_equals_single_device_step(batch):
     s2 = create_train_state(model, jax.random.key(0), optimizer="sgd")
     mesh = make_mesh(("data", "expert"), shape=(2, 4))
     rules = moe_ep_rules()
-    s2, _ = shard_state(s2, mesh, rules)
+    s2, s2_sharding = shard_state(s2, mesh, rules)
     step1 = make_train_step()
-    step2 = make_train_step(mesh, state_sharding=state_shardings(s2, mesh, rules))
+    step2 = make_train_step(mesh, state_sharding=s2_sharding)
     for _ in range(3):
         s1, m1 = step1(s1, batch)
         s2, m2 = step2(s2, batch)

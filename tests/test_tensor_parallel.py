@@ -67,9 +67,9 @@ def test_tp_step_equals_single_device_step(batch):
 
     mesh = make_mesh(("data", "model"), shape=(4, 2))
     rules = vit_tp_rules()
-    state_tp, _ = shard_state(state_tp, mesh, rules)
+    state_tp, tp_sharding = shard_state(state_tp, mesh, rules)
     step_1d = make_train_step()
-    step_tp = make_tp_train_step(mesh, state_shardings(state_tp, mesh, rules))
+    step_tp = make_tp_train_step(mesh, tp_sharding)
 
     for _ in range(3):
         state_1d, m1 = step_1d(state_1d, batch)
@@ -88,8 +88,8 @@ def test_tp_eval_step_equals_single_device(batch):
     state = create_train_state(model, jax.random.key(1))
     mesh = make_mesh(("data", "model"), shape=(2, 4))
     rules = vit_tp_rules()
-    sstate, _ = shard_state(state, mesh, rules)
-    ev_tp = make_tp_eval_step(mesh, state_shardings(sstate, mesh, rules))
+    sstate, s_sharding = shard_state(state, mesh, rules)
+    ev_tp = make_tp_eval_step(mesh, s_sharding)
 
     from pytorch_distributed_mnist_tpu.train.steps import make_eval_step
 
