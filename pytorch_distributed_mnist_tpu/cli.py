@@ -118,6 +118,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(neighbor ppermute over ICI, parallel/ring.py). "
                         "Token count (28/patch)^2 must divide evenly — "
                         "e.g. --patch-size 7 gives 16 tokens")
+    p.add_argument("--sequence-parallel-impl", type=str, default="ring",
+                   choices=["ring", "ulysses"],
+                   help="ring = blockwise online-softmax with neighbor "
+                        "ppermute (parallel/ring.py); ulysses = all_to_all "
+                        "head re-sharding (parallel/ulysses.py; head count "
+                        "must divide by the seq width, and it does not "
+                        "compose with --tensor-parallel since Ulysses "
+                        "re-shards heads itself)")
     p.add_argument("--patch-size", type=int, default=4,
                    help="ViT patch size (28 must divide evenly; tokens = "
                         "(28/patch)^2)")
@@ -141,6 +149,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--metrics-file", type=str, default=None,
+                   help="append one JSON line per epoch (process 0 only): "
+                        "epoch, losses, accuracies, lr, images/sec — the "
+                        "optional metrics file SURVEY.md section 5 notes "
+                        "the reference lacks (prints only, :238-242)")
     p.add_argument("--synthetic-train-size", type=int, default=60000)
     p.add_argument("--synthetic-test-size", type=int, default=10000)
     return p
@@ -333,6 +346,20 @@ def run(args, epoch_callback=None) -> dict:
                     f"exactly over the model axis, so the width must "
                     f"divide {num_heads}"
                 )
+            sp_impl = getattr(args, "sequence_parallel_impl", "ring")
+            if sp_impl == "ulysses":
+                if tp > 1:
+                    raise SystemExit(
+                        "--sequence-parallel-impl ulysses does not compose "
+                        "with --tensor-parallel: Ulysses re-shards the "
+                        "head axis itself (all_to_all)"
+                    )
+                if num_heads % sp:
+                    raise SystemExit(
+                        f"--sequence-parallel-impl ulysses shards the "
+                        f"{num_heads} heads over the seq axis; "
+                        f"--sequence-parallel {sp} must divide {num_heads}"
+                    )
         mesh = make_mesh(("data", "model", "seq"),
                          shape=(jax.device_count() // (tp * sp), tp, sp))
     else:
@@ -363,17 +390,28 @@ def run(args, epoch_callback=None) -> dict:
     if sp > 1:
         from functools import partial as _partial
 
-        from pytorch_distributed_mnist_tpu.parallel.ring import ring_attention
-
         # Params are attention-impl-independent; init must use the dense
-        # twin (the batch-1 init trace can't satisfy the ring's data-axis
+        # twin (the batch-1 init trace can't satisfy the SP data-axis
         # sharding), then the sequence-parallel apply_fn is swapped in —
         # the same pattern the dryrun's DP x TP x SP phase uses.
         init_model = get_model(args.model, **model_kwargs)
-        model_kwargs["attention_fn"] = _partial(
-            ring_attention, mesh=mesh, axis="seq", batch_axis="data",
-            head_axis="model" if tp > 1 else None,
-        )
+        if getattr(args, "sequence_parallel_impl", "ring") == "ulysses":
+            from pytorch_distributed_mnist_tpu.parallel.ulysses import (
+                ulysses_attention,
+            )
+
+            model_kwargs["attention_fn"] = _partial(
+                ulysses_attention, mesh=mesh, axis="seq", batch_axis="data",
+            )
+        else:
+            from pytorch_distributed_mnist_tpu.parallel.ring import (
+                ring_attention,
+            )
+
+            model_kwargs["attention_fn"] = _partial(
+                ring_attention, mesh=mesh, axis="seq", batch_axis="data",
+                head_axis="model" if tp > 1 else None,
+            )
     model = get_model(args.model, **model_kwargs)
     pp_sharding = None
     if pp > 1:
@@ -472,6 +510,21 @@ def run(args, epoch_callback=None) -> dict:
                             "train_acc": train_acc.accuracy,
                             "test_loss": test_loss.average,
                             "test_acc": test_acc.accuracy})
+            if getattr(args, "metrics_file", None) and process_index() == 0:
+                import json
+                import os
+
+                parent = os.path.dirname(args.metrics_file)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                with open(args.metrics_file, "a") as f:
+                    f.write(json.dumps({
+                        **history[-1], "lr": lr_of(epoch),
+                        "best_acc": best_acc,
+                        # THIS epoch's train rate, not the cumulative
+                        # average (epoch 0's compile would drag it down).
+                        "images_per_sec": timer.last_images_per_sec,
+                    }) + "\n")
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break
     ips = timer.images_per_sec

@@ -32,6 +32,8 @@ class StepTimer:
         self.images = 0
         self.steps = 0
         self.seconds = 0.0
+        self.last_images = 0
+        self.last_seconds = 0.0
 
     @contextlib.contextmanager
     def measure(self, images: int):
@@ -43,7 +45,9 @@ class StepTimer:
         try:
             yield
         finally:
-            self.seconds += time.perf_counter() - t0
+            self.last_seconds = time.perf_counter() - t0
+            self.last_images = images
+            self.seconds += self.last_seconds
             self.images += images
             self.steps += 1
 
@@ -58,6 +62,12 @@ class StepTimer:
     @property
     def images_per_sec_per_chip(self) -> float:
         return self.images_per_sec / self.num_chips
+
+    @property
+    def last_images_per_sec(self) -> float:
+        """Rate of the most recent measured phase only — per-epoch
+        throughput unpolluted by earlier epochs' compile time."""
+        return self.last_images / max(self.last_seconds, 1e-9)
 
     @property
     def steps_per_sec(self) -> float:

@@ -101,3 +101,27 @@ def test_debug_nans_flag(tmp_path):
             jax.jit(lambda x: jnp.log(x))(jnp.zeros(4) - 1.0).block_until_ready()
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_metrics_file(tmp_path):
+    """--metrics-file appends one JSON line per epoch (SURVEY section 5)."""
+    import json
+
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    mf = tmp_path / "metrics.jsonl"
+    run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear", "--epochs", "2",
+        "--batch-size", "64", "--synthetic-train-size", "128",
+        "--synthetic-test-size", "64", "--seed", "0",
+        "--metrics-file", str(mf),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ]))
+    lines = [json.loads(l) for l in mf.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["epoch"] == 0 and lines[1]["epoch"] == 1
+    for row in lines:
+        for key in ("train_loss", "test_acc", "lr", "best_acc",
+                    "images_per_sec"):
+            assert key in row

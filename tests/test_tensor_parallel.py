@@ -199,3 +199,40 @@ def test_cli_sequence_parallel_rejects_indivisible_tokens(tmp_path):
     ])
     with pytest.raises(SystemExit, match="patch-size 7"):
         run(args)
+
+
+def test_cli_ulysses_matches_dense(tmp_path):
+    """--sequence-parallel-impl ulysses (all_to_all head sharding) matches
+    the dense run's metrics, same contract as the ring."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    base = [
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0", "--patch-size", "7",
+        "--root", str(tmp_path / "data"),
+    ]
+    uly = run(build_parser().parse_args(
+        base + ["--sequence-parallel", "2",
+                "--sequence-parallel-impl", "ulysses",
+                "--checkpoint-dir", str(tmp_path / "ckpt_u")]))
+    dense = run(build_parser().parse_args(
+        base + ["--checkpoint-dir", str(tmp_path / "ckpt_d")]))
+    assert uly["history"][0]["train_loss"] == pytest.approx(
+        dense["history"][0]["train_loss"], rel=1e-4)
+    assert uly["history"][0]["test_acc"] == pytest.approx(
+        dense["history"][0]["test_acc"], abs=1e-6)
+
+
+def test_cli_ulysses_rejects_tp(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--patch-size", "7", "--sequence-parallel", "2",
+        "--sequence-parallel-impl", "ulysses", "--tensor-parallel", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="re-shards the"):
+        run(args)
