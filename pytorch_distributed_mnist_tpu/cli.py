@@ -144,6 +144,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "design removes the reference's shared-mutable-state "
                         "race class; numeric blowups are the remaining "
                         "debug target). Slow - debugging only")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="gradient-accumulation micro-batches per optimizer "
+                        "step: the global batch splits N ways, grads "
+                        "accumulate in a scan, one Adam step applies the "
+                        "exact full-batch gradient (~N x lower activation "
+                        "memory)")
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
@@ -264,11 +270,25 @@ def run(args, epoch_callback=None) -> dict:
     tp = getattr(args, "tensor_parallel", 1)
     sp = getattr(args, "sequence_parallel", 1)
     patch = getattr(args, "patch_size", 4)
+    grad_accum = getattr(args, "grad_accum", 1)
     if patch < 1 or 28 % patch:
         raise SystemExit(
             f"--patch-size {patch}: 28 must divide evenly into patches "
             f"(try 2, 4, 7, or 14)"
         )
+    if grad_accum < 1:
+        raise SystemExit(f"--grad-accum must be >= 1, got {grad_accum}")
+    if grad_accum > 1:
+        if args.trainer_mode == "explicit":
+            raise SystemExit(
+                "--grad-accum does not compose with --trainer-mode "
+                "explicit; use scan or stepwise"
+            )
+        if args.batch_size % grad_accum:
+            raise SystemExit(
+                f"--grad-accum {grad_accum} must divide --batch-size "
+                f"{args.batch_size}"
+            )
     if pp > 1 and (tp > 1 or sp > 1):
         raise SystemExit(
             "--pipeline-stages does not compose with --tensor-parallel/"
@@ -474,7 +494,8 @@ def run(args, epoch_callback=None) -> dict:
 
     train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
-                      mode=args.trainer_mode, state_sharding=state_sharding)
+                      mode=args.trainer_mode, state_sharding=state_sharding,
+                      grad_accum=grad_accum)
     lr_of = step_decay_schedule(args.lr)
 
     if args.evaluate:
@@ -486,6 +507,16 @@ def run(args, epoch_callback=None) -> dict:
 
     timer = StepTimer()
     history = []
+    metrics_file = getattr(args, "metrics_file", None)
+    if metrics_file and process_index() == 0:
+        import json as _json
+        import os as _os2
+
+        parent = _os2.path.dirname(metrics_file)
+        if parent:
+            _os2.makedirs(parent, exist_ok=True)
+    else:
+        metrics_file = None
     with profile_trace(args.profile_dir):
         for epoch in range(start_epoch, args.epochs):
             train_loader.set_sample_epoch(epoch)  # per-epoch reshuffle (:231)
@@ -510,15 +541,9 @@ def run(args, epoch_callback=None) -> dict:
                             "train_acc": train_acc.accuracy,
                             "test_loss": test_loss.average,
                             "test_acc": test_acc.accuracy})
-            if getattr(args, "metrics_file", None) and process_index() == 0:
-                import json
-                import os
-
-                parent = os.path.dirname(args.metrics_file)
-                if parent:
-                    os.makedirs(parent, exist_ok=True)
-                with open(args.metrics_file, "a") as f:
-                    f.write(json.dumps({
+            if metrics_file:
+                with open(metrics_file, "a") as f:
+                    f.write(_json.dumps({
                         **history[-1], "lr": lr_of(epoch),
                         "best_acc": best_acc,
                         # THIS epoch's train rate, not the cumulative

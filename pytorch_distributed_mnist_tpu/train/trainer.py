@@ -63,6 +63,7 @@ class Trainer:
         mesh: Optional[Mesh] = None,
         mode: str = "scan",
         state_sharding=None,
+        grad_accum: int = 1,
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
@@ -81,12 +82,20 @@ class Trainer:
                     "mode='explicit' is the replicated-DP shard_map path; "
                     "use scan/stepwise with a sharded state"
                 )
+            if grad_accum > 1:
+                raise ValueError(
+                    "mode='explicit' does not support grad_accum; use "
+                    "scan/stepwise"
+                )
             self._train_step = make_explicit_dp_train_step(mesh)
         else:
-            self._train_step = make_train_step(mesh, state_sharding=state_sharding)
+            self._train_step = make_train_step(
+                mesh, state_sharding=state_sharding, grad_accum=grad_accum
+            )
         self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
         self._train_epoch = (
-            make_train_epoch(mesh, state_sharding=state_sharding)
+            make_train_epoch(mesh, state_sharding=state_sharding,
+                             grad_accum=grad_accum)
             if mode == "scan" else None
         )
         self._eval_epoch = (

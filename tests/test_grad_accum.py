@@ -1,0 +1,101 @@
+"""Gradient accumulation (train/steps.py make_accum_train_step_fn):
+N-way accumulated step == the full-batch step, on one device and on the
+mesh, plus the CLI flag.
+
+The reference steps the optimizer once per loader batch
+(``/root/reference/multi_proc_single_gpu.py:90-92``); accumulation keeps
+that cadence while splitting the forward/backward into micro-batches, so
+the equivalence contract is exact gradient equality (up to f32 summation
+order).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import (
+    make_train_step,
+)
+
+
+def _batch(tiny_data, n=64):
+    images, labels = tiny_data
+    return {"image": jnp.asarray(images[:n]), "label": jnp.asarray(labels[:n])}
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_accum_step_matches_full_batch(tiny_data, accum):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    batch = _batch(tiny_data)
+
+    ref = create_train_state(model, jax.random.key(0))
+    ref, ref_m = make_train_step()(ref, batch)
+
+    acc = create_train_state(model, jax.random.key(0))
+    acc, acc_m = make_train_step(grad_accum=accum)(acc, batch)
+
+    assert float(acc_m.loss_sum) == pytest.approx(float(ref_m.loss_sum),
+                                                  rel=1e-6)
+    assert int(acc_m.count) == int(ref_m.count) == 64
+    for a, b in zip(jax.tree.leaves(ref.params), jax.tree.leaves(acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_accum_on_mesh_matches_replicated(mesh8, tiny_data):
+    from pytorch_distributed_mnist_tpu.data.loader import make_global_batch
+
+    model = get_model("linear", compute_dtype=jnp.float32)
+    batch = _batch(tiny_data)
+
+    ref = create_train_state(model, jax.random.key(0))
+    ref, ref_m = make_train_step()(ref, batch)
+
+    acc = create_train_state(model, jax.random.key(0))
+    step = make_train_step(mesh8, grad_accum=2)
+    gbatch = make_global_batch(
+        {k: np.asarray(v) for k, v in batch.items()}, mesh8
+    )
+    acc, acc_m = step(acc, gbatch)
+
+    assert float(acc_m.loss_sum) == pytest.approx(float(ref_m.loss_sum),
+                                                  rel=1e-5)
+    for a, b in zip(jax.tree.leaves(ref.params), jax.tree.leaves(acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_indivisible_batch_raises(tiny_data):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    batch = _batch(tiny_data, n=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_train_step(grad_accum=4)(state, batch)
+
+
+def test_cli_grad_accum_end_to_end(tmp_path):
+    """--grad-accum through the full driver (scan mode: accumulation scan
+    nested inside the epoch scan), same metrics as the plain run."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    base = [
+        "--dataset", "synthetic", "--model", "linear", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0",
+        "--root", str(tmp_path / "data"),
+    ]
+    acc = run(build_parser().parse_args(
+        base + ["--grad-accum", "4",
+                "--checkpoint-dir", str(tmp_path / "ckpt_a")]))
+    ref = run(build_parser().parse_args(
+        base + ["--checkpoint-dir", str(tmp_path / "ckpt_r")]))
+    # rel 1e-3: the CLI models compute in bf16, where micro-batch summation
+    # order shifts the loss ~1e-4; exact f32 equality is pinned by the unit
+    # tests above.
+    assert acc["history"][0]["train_loss"] == pytest.approx(
+        ref["history"][0]["train_loss"], rel=1e-3)
+    assert acc["history"][0]["test_acc"] == pytest.approx(
+        ref["history"][0]["test_acc"], abs=1e-6)
