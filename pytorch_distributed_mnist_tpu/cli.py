@@ -289,6 +289,20 @@ def run(args, epoch_callback=None) -> dict:
                 f"--grad-accum {grad_accum} must divide --batch-size "
                 f"{args.batch_size}"
             )
+        if pp > 1:
+            # Each accumulation micro-batch feeds the pipeline, which
+            # divides it again: per-dataslice size must still split into
+            # the pipeline's own microbatches (== stages by default).
+            dp_size = max(1, jax.device_count() // pp)
+            per_slice = args.batch_size // grad_accum // dp_size
+            if (args.batch_size // grad_accum) % dp_size or per_slice % pp:
+                raise SystemExit(
+                    f"--grad-accum {grad_accum} with --pipeline-stages "
+                    f"{pp}: each accumulation micro-batch "
+                    f"({args.batch_size // grad_accum}) must split over "
+                    f"{dp_size} data slices into a per-slice batch "
+                    f"divisible by {pp} pipeline microbatches"
+                )
     if pp > 1 and (tp > 1 or sp > 1):
         raise SystemExit(
             "--pipeline-stages does not compose with --tensor-parallel/"

@@ -69,21 +69,21 @@ def make_accum_train_step_fn(accum: int):
         def body(carry, mb):
             g_acc, m_acc = carry
             mask = mb.get("mask")
+            n = (jnp.sum(mask.astype(jnp.float32)) if mask is not None
+                 else jnp.asarray(float(mb["label"].shape[0])))
 
             def loss_fn(params):
                 logits = state.apply_fn(params, mb["image"], train=True)
-                n = (jnp.sum(mask.astype(jnp.float32)) if mask is not None
-                     else jnp.asarray(float(mb["label"].shape[0])))
                 # per-example SUM: micro-means weighted by real count so
                 # the accumulated gradient equals the full-batch gradient
                 # even when eval-style masks straddle micro-batches.
                 return cross_entropy(logits, mb["label"], mask) * n, logits
 
-            (_, logits), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
-            )
+            (loss_sum_mb, logits), g = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
             g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-            loss_mean = cross_entropy(logits, mb["label"], mask)
+            loss_mean = loss_sum_mb / jnp.maximum(n, 1.0)
             m_acc = metrics_update(m_acc, loss_mean, logits, mb["label"], mask)
             return (g_acc, m_acc), None
 

@@ -10,17 +10,28 @@ Run with:  python -m pytest tests_tpu/ -q
 Skips cleanly (doesn't fail) when no TPU backend is reachable.
 """
 
+import subprocess
+import sys
+
 import pytest
+
+_PROBE = (
+    "import jax; assert jax.default_backend() == 'tpu' or any("
+    "d.platform == 'tpu' for d in jax.devices())"
+)
 
 
 def _tpu_available() -> bool:
+    # Probe in a CHILD with a hard timeout: when the chip tunnel is wedged,
+    # backend init HANGS rather than failing, and an in-process probe would
+    # hang collection (and poison this process's jax backend state even on
+    # success-after-wait).
     try:
-        import jax
-
-        return jax.default_backend() == "tpu" or any(
-            d.platform == "tpu" for d in jax.devices()
-        )
-    except Exception:
+        return subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True, timeout=60,
+        ).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
         return False
 
 

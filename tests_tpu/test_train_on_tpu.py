@@ -1,0 +1,30 @@
+"""On-hardware training smoke: the full driver on the real chip.
+
+The hermetic suite proves correctness on virtual CPU devices; this proves
+the same driver actually runs on TPU silicon — bf16 convs on the MXU, the
+scan-epoch program, checkpoint write — and that throughput is in the
+expected range for the device (a tunnel/backend regression would show up
+as an order-of-magnitude drop).
+"""
+
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+
+def test_cnn_trains_on_tpu(tmp_path):
+    summary = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "cnn", "--epochs", "2",
+        "--batch-size", "512", "--synthetic-train-size", "4096",
+        "--synthetic-test-size", "1024", "--seed", "1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ]))
+    assert summary["epochs_run"] == 2
+    # learns: accuracy well above chance by epoch 1
+    assert summary["history"][-1]["test_acc"] > 0.5
+    # chip-scale throughput: even through the tunnel the v5e does
+    # hundreds of thousands of images/sec; 10k is a generous floor that
+    # still catches a silent CPU fallback (~10-1000 img/s).
+    assert summary["images_per_sec_per_chip"] > 10_000
+    assert (tmp_path / "ckpt" / "model_best.npz").exists()
