@@ -2,8 +2,10 @@
 
 Replaces the reference's two launch modes selected by editing source
 (``/root/reference/multi_proc_single_gpu.py:353-359``, ``README.md:10-35``):
-on TPU the runtime is already one process per host, so there is nothing to
-spawn and no ``--local_rank`` to inject.
+on a real TPU pod the runtime is already one process per host, so nothing
+needs spawning and no ``--local_rank`` is injected; ``--spawn N``
+(parallel/launcher.py) provides the reference's ``mp.spawn`` mode as a flag
+for local N-host simulation.
 """
 
 from pytorch_distributed_mnist_tpu.cli import main

@@ -83,6 +83,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="coordinator address host:port for multi-host runs")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--spawn", type=int, default=0, metavar="N",
+                   help="fork N local host processes (one CPU device each) "
+                        "that rendezvous on a free loopback port — the "
+                        "reference's mp.spawn launch mode (:284-285), here "
+                        "as a flag instead of a source edit. Local "
+                        "simulation of an N-host pod; real pods need no "
+                        "spawner (one process per host already)")
     # TPU-framework extensions.
     p.add_argument("--model", type=str, default="cnn", choices=list_models())
     p.add_argument("--attention", type=str, default="dense",
@@ -245,6 +252,14 @@ def run(args, epoch_callback=None) -> dict:
     each epoch's train+eval+checkpoint; returning True stops the loop early
     (tools/northstar.py uses this to stop at the target accuracy).
     """
+    # An explicit JAX_PLATFORMS=cpu request (spawned children, smoke tests)
+    # must win even when an accelerator plugin force-writes jax_platforms at
+    # import time; tests/conftest.py and tools/northstar.py apply the same
+    # override for their own processes.
+    import os as _os0
+
+    if _os0.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     # Must run before ANY jax call that initializes the backend (including
     # jax.process_index in log0) — jax.distributed.initialize refuses to run
     # after backend init, the analog of init_process_group-before-CUDA order.
@@ -577,7 +592,22 @@ def run(args, epoch_callback=None) -> dict:
 
 
 def main(argv: Optional[list] = None) -> None:
-    run(build_parser().parse_args(argv))
+    import sys as _sys
+
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(argv)
+    if args.spawn:
+        if (args.coordinator or args.process_id is not None
+                or args.num_processes is not None):
+            raise SystemExit(
+                "--spawn forks its own local world; it cannot combine with "
+                "--coordinator/--num-processes/--process-id (those join an "
+                "existing one)"
+            )
+        from pytorch_distributed_mnist_tpu.parallel.launcher import spawn_local
+
+        raise SystemExit(spawn_local(args.spawn, argv))
+    run(args)
 
 
 if __name__ == "__main__":

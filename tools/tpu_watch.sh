@@ -9,10 +9,17 @@ while true; do
   if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) TPU alive - capturing" >> "$OUT/watch.log"
     timeout 900 python /root/repo/bench.py > "$OUT/bench.json" 2>> "$OUT/watch.log"
+    BENCH_RC=$?
     timeout 1800 python /root/repo/tools/northstar.py \
       --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
       --root /tmp/ns_tpu > "$OUT/northstar.json" 2>> "$OUT/watch.log"
-    echo "$(date -u +%FT%TZ) capture done rc=$?" >> "$OUT/watch.log"
+    NS_RC=$?
+    echo "$(date -u +%FT%TZ) capture done bench_rc=$BENCH_RC northstar_rc=$NS_RC" >> "$OUT/watch.log"
+    if [ "$BENCH_RC" -ne 0 ] || [ "$NS_RC" -ne 0 ]; then
+      echo "$(date -u +%FT%TZ) capture INCOMPLETE - will retry" >> "$OUT/watch.log"
+      sleep 300
+      continue
+    fi
     exit 0
   fi
   echo "$(date -u +%FT%TZ) tpu still down" >> "$OUT/watch.log"
