@@ -74,7 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=0.9, help="for --optimizer sgd")
     p.add_argument("--wd", "--weight-decay", type=float, default=1e-4,
                    dest="weight_decay", help="for --optimizer sgd")
-    p.add_argument("--resume", type=str, default="", help="checkpoint path to resume from")
+    p.add_argument("--resume", type=str, default="",
+                   help="checkpoint path to resume from, or 'auto' to pick "
+                        "the newest checkpoint in --checkpoint-dir (trains "
+                        "fresh when none exists yet — the same command line "
+                        "works for first launch and every restart)")
     p.add_argument("-e", "--evaluate", action="store_true",
                    help="evaluate on the test set and exit")
     p.add_argument("--seed", type=int, default=None)
@@ -160,6 +164,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
+    p.add_argument("--keep-last", type=int, default=0, metavar="N",
+                   help="retain only the N newest per-epoch checkpoints "
+                        "(model_best is never pruned); 0 keeps every "
+                        "epoch's file, the reference's behavior (:267-268)")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="write checkpoints on a background thread, "
+                        "overlapping file I/O with the next epoch "
+                        "(leaves are snapshotted to host memory first, so "
+                        "the saved state is exactly the epoch's; sharded "
+                        "multi-host layouts fall back to synchronous saves)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace here")
     p.add_argument("--metrics-file", type=str, default=None,
@@ -481,8 +495,31 @@ def run(args, epoch_callback=None) -> dict:
         )
         if init_model is not None:
             state = state.replace(apply_fn=model.apply)
-    state, start_epoch, best_acc = try_resume(args.resume, state)
-    resumed = args.resume and start_epoch > 0
+    resume_path = args.resume
+    if resume_path == "auto":
+        from pytorch_distributed_mnist_tpu.train.checkpoint import (
+            latest_checkpoint,
+        )
+
+        resume_path = latest_checkpoint(args.checkpoint_dir) or ""
+        if process_count() > 1:
+            # Every host must resume from the SAME checkpoint: a stale NFS
+            # attribute cache can hide the newest file from some hosts,
+            # and hosts resuming at different epochs run different numbers
+            # of collective programs — a silent hang, not an error.
+            # Process 0's resolution wins.
+            from jax.experimental import multihost_utils
+
+            payload = np.frombuffer(
+                resume_path.encode().ljust(4096, b"\0"), dtype=np.uint8
+            )
+            agreed = multihost_utils.broadcast_one_to_all(payload)
+            resume_path = bytes(agreed).rstrip(b"\0").decode()
+        if not resume_path:
+            log0(f"=> --resume auto: no checkpoint in "
+                 f"'{args.checkpoint_dir}' yet, training fresh")
+    state, start_epoch, best_acc = try_resume(resume_path, state)
+    resumed = resume_path and start_epoch > 0
     if not resumed:
         # Reference precedence (:204): a resumed checkpoint's epoch wins over
         # the --start-epoch flag; the flag only applies to fresh runs.
@@ -536,6 +573,13 @@ def run(args, epoch_callback=None) -> dict:
 
     timer = StepTimer()
     history = []
+    saver = None
+    if getattr(args, "async_checkpoint", False):
+        from pytorch_distributed_mnist_tpu.train.checkpoint import (
+            AsyncCheckpointer,
+        )
+
+        saver = AsyncCheckpointer()
     metrics_file = getattr(args, "metrics_file", None)
     if metrics_file and process_index() == 0:
         import json as _json
@@ -562,10 +606,15 @@ def run(args, epoch_callback=None) -> dict:
                  f" test loss: {test_loss}, test acc: {test_acc}")
             is_best = test_acc.accuracy > best_acc  # (:245-246)
             best_acc = max(test_acc.accuracy, best_acc)
-            save_checkpoint(
-                trainer.state, epoch=epoch, best_acc=best_acc, is_best=is_best,
+            ckpt_kwargs = dict(
+                epoch=epoch, best_acc=best_acc, is_best=is_best,
                 directory=args.checkpoint_dir,
+                keep_last=getattr(args, "keep_last", 0),
             )
+            if saver is not None:
+                saver.save(trainer.state, **ckpt_kwargs)
+            else:
+                save_checkpoint(trainer.state, **ckpt_kwargs)
             history.append({"epoch": epoch, "train_loss": train_loss.average,
                             "train_acc": train_acc.accuracy,
                             "test_loss": test_loss.average,
@@ -581,6 +630,8 @@ def run(args, epoch_callback=None) -> dict:
                     }) + "\n")
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break
+        if saver is not None:
+            saver.wait()  # the last epoch's write must land before exit
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
          f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")

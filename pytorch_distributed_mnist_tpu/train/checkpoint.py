@@ -45,6 +45,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import re
 import shutil
 from typing import Any, Dict, Optional, Tuple
 
@@ -89,6 +90,7 @@ def save_checkpoint(
     directory: str = CHECKPOINT_DIR,
     process_index: Optional[int] = None,
     layout: Optional[str] = None,
+    keep_last: int = 0,
 ) -> Optional[str]:
     """Write ``checkpoint_{epoch}.npz`` (+ best copy); returns the path.
 
@@ -108,7 +110,7 @@ def save_checkpoint(
     ):
         return _save_sharded(
             named, epoch=epoch, best_acc=best_acc, is_best=is_best,
-            directory=directory, pid=pid,
+            directory=directory, pid=pid, keep_last=keep_last,
         )
     if pid != 0:
         return None
@@ -131,6 +133,7 @@ def save_checkpoint(
         best = os.path.join(directory, "model_best.npz")
         shutil.copyfile(path, best + ".tmp")
         os.replace(best + ".tmp", best)
+    prune_checkpoints(directory, keep_last)
     return path
 
 
@@ -145,7 +148,7 @@ def _shard_slices(leaf, shard) -> Tuple[list, list]:
 
 
 def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
-                  directory: str, pid: int) -> str:
+                  directory: str, pid: int, keep_last: int = 0) -> str:
     """Every process writes its owned shards; process 0 publishes the dir.
 
     Ownership = ``shard.replica_id == 0``: exactly one device globally
@@ -239,6 +242,7 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
             if os.path.isdir(best):
                 shutil.rmtree(best)
             os.replace(best_tmp, best)
+        prune_checkpoints(directory, keep_last)
     _barrier(f"ckpt_publish_{epoch}")  # no reader races a half-published dir
     return final
 
@@ -349,9 +353,143 @@ def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
     return new_state, int(meta["epoch"]), float(meta["best_acc"])
 
 
+def _epoch_checkpoints(directory: str) -> list:
+    """All published per-epoch checkpoints in ``directory`` as sorted
+    ``(epoch, path)`` pairs. The single source of the eligibility rule for
+    both resume selection and pruning (so they can never disagree about
+    what counts as a checkpoint). Both layouts match (``.npz`` file,
+    ``.ckpt`` dir); the atomic writers' in-flight ``.tmp`` names never do,
+    so a crash mid-save can only ever expose the last *published* file —
+    the restart-from-checkpoint recovery model SURVEY.md section 5
+    prescribes."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = re.fullmatch(r"checkpoint_(\d+)\.(npz|ckpt)", name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Path of the highest-epoch ``checkpoint_{e}`` in ``directory``, or None.
+
+    Multi-host callers must agree on the result across processes (NFS
+    attribute caches can show different listings); ``cli.run`` resolves on
+    process 0 and broadcasts.
+    """
+    found = _epoch_checkpoints(directory)
+    return found[-1][1] if found else None
+
+
+def prune_checkpoints(directory: str, keep_last: int) -> None:
+    """Delete all but the ``keep_last`` newest per-epoch checkpoints.
+
+    The reference retains every epoch's file with no GC (``:267-268``) and
+    so does this framework by default (``keep_last <= 0``); this is the
+    opt-in bound for long runs. ``model_best`` copies are never pruned.
+    Only process 0 calls this (same gate as the npz write).
+    """
+    if keep_last <= 0:
+        return
+    for _, path in _epoch_checkpoints(directory)[:-keep_last]:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint file I/O with the next epoch's compute.
+
+    ``save()`` snapshots every leaf to host memory synchronously (the only
+    part that must see a consistent device state — the train loop is free
+    to donate/overwrite buffers the moment it returns), then runs the
+    actual ``save_checkpoint`` on a single worker thread. ``wait()`` joins
+    the in-flight write; it is called before the next ``save`` (one write
+    in flight at most, so a slow disk can delay training by at most one
+    checkpoint), at context exit, and returns the last written path.
+
+    Cross-host sharded states fall back to a synchronous save: the sharded
+    layout's correctness barriers are device collectives, and running
+    those on a side thread while the main thread launches train steps
+    could interleave two collective programs — a deadlock, not a speedup.
+    """
+
+    def __init__(self) -> None:
+        self._thread = None
+        self._result: Optional[str] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, state, **kwargs) -> None:
+        self.wait()
+        named = _leaves_with_names(_state_tree(state))
+        if not all(_npz_saveable(v) for _, v in named):
+            self._result = save_checkpoint(state, **kwargs)
+            return
+        pid = kwargs.get("process_index")
+        if (jax.process_index() if pid is None else pid) != 0:
+            # npz saves are process-0-only; snapshotting a full host copy
+            # of params+moments (and spawning a thread) on every other
+            # host would buy nothing but RAM pressure.
+            self._result = None
+            return
+        host_state = jax.tree.map(np.asarray, _state_tree(state))
+        snapshot = _HostState(host_state)
+
+        def _write() -> None:
+            try:
+                self._result = save_checkpoint(snapshot, **kwargs)
+            except BaseException as exc:  # surfaced by the next wait()
+                self._error = exc
+
+        import threading
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> Optional[str]:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            exc, self._error = self._error, None
+            raise exc
+        return self._result
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        # Swallow nothing: a failed in-flight write must fail the run,
+        # unless the body is already unwinding on its own exception.
+        if exc_info[0] is None:
+            self.wait()
+        elif self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+
+class _HostState:
+    """Duck-typed stand-in for a TrainState whose leaves are host arrays:
+    exactly the attributes ``_state_tree`` reads, nothing else."""
+
+    def __init__(self, tree: Dict[str, Any]) -> None:
+        self.params = tree["params"]
+        self.opt_state = tree["opt_state"]
+        self.step = tree["step"]
+
+
 def try_resume(path: str, state) -> Tuple[Any, int, float]:
     """Reference resume policy (``:197-214``): load if the file exists, else
-    warn and continue fresh with ``(state, 0, 0.0)``."""
+    warn and continue fresh with ``(state, 0, 0.0)``.
+
+    ``path == 'auto'`` resolves to the newest checkpoint in the run's
+    checkpoint directory (see ``cli.py``) — the restart-after-preemption
+    mode: the same command line works for the first launch (no checkpoint
+    yet, trains fresh) and every relaunch (continues where it died).
+    """
     if path and (os.path.isfile(path) or os.path.isdir(path)):
         state, start_epoch, best_acc = load_checkpoint(path, state)
         print(f"=> loaded checkpoint '{path}' (epoch {start_epoch})")

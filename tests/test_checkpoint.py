@@ -173,3 +173,130 @@ def test_sharded_and_npz_round_trips_agree(tmp_path, mesh8):
     rb, _, _ = load_checkpoint(p_dir, fresh_state(seed=2))
     for a, b in zip(jax.tree.leaves(ra.params), jax.tree.leaves(rb.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_checkpoint_and_prune(tmp_path):
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        latest_checkpoint,
+        prune_checkpoints,
+    )
+
+    assert latest_checkpoint(str(tmp_path / "nope")) is None
+    state = fresh_state()
+    for e in range(4):
+        save_checkpoint(state, epoch=e, best_acc=0.1, is_best=(e == 1),
+                        directory=str(tmp_path), process_index=0)
+    assert latest_checkpoint(str(tmp_path)).endswith("checkpoint_3.npz")
+    # in-flight tmp names are never eligible
+    open(tmp_path / "checkpoint_9.npz.tmp", "w").close()
+    assert latest_checkpoint(str(tmp_path)).endswith("checkpoint_3.npz")
+
+    prune_checkpoints(str(tmp_path), keep_last=2)
+    kept = sorted(os.listdir(tmp_path))
+    assert "checkpoint_2.npz" in kept and "checkpoint_3.npz" in kept
+    assert "checkpoint_0.npz" not in kept and "checkpoint_1.npz" not in kept
+    assert "model_best.npz" in kept  # never pruned
+    # keep_last=0 is the reference's keep-everything default
+    prune_checkpoints(str(tmp_path), keep_last=0)
+    assert "checkpoint_2.npz" in os.listdir(tmp_path)
+
+
+def test_save_checkpoint_keep_last_inline(tmp_path):
+    state = fresh_state()
+    for e in range(3):
+        save_checkpoint(state, epoch=e, best_acc=0.1, is_best=False,
+                        directory=str(tmp_path), process_index=0,
+                        keep_last=1)
+    names = [n for n in os.listdir(tmp_path) if n.startswith("checkpoint_")]
+    assert names == ["checkpoint_2.npz"]
+
+
+def test_async_checkpointer_matches_sync(tmp_path, tiny_data):
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        AsyncCheckpointer,
+    )
+
+    state = fresh_state()
+    step = make_train_step()
+    images, labels = tiny_data
+    batch = {"image": jnp.asarray(images[:32]), "label": jnp.asarray(labels[:32])}
+    state, _ = step(state, batch)
+
+    sync_path = save_checkpoint(state, epoch=0, best_acc=0.2, is_best=True,
+                                directory=str(tmp_path / "sync"),
+                                process_index=0)
+    with AsyncCheckpointer() as saver:
+        saver.save(state, epoch=0, best_acc=0.2, is_best=True,
+                   directory=str(tmp_path / "async"), process_index=0)
+        async_path = saver.wait()
+    assert os.path.basename(async_path) == os.path.basename(sync_path)
+    # byte-identical files: the host snapshot is the same state
+    ra, ea, ba = load_checkpoint(async_path, fresh_state(seed=1))
+    rs, es, bs = load_checkpoint(sync_path, fresh_state(seed=2))
+    assert (ea, ba) == (es, bs)
+    for a, b in zip(jax.tree.leaves(ra.params), jax.tree.leaves(rs.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert os.path.exists(tmp_path / "async" / "model_best.npz")
+
+
+def test_async_checkpointer_surfaces_write_error(tmp_path):
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        AsyncCheckpointer,
+    )
+
+    state = fresh_state()
+    saver = AsyncCheckpointer()
+    # an unwritable target (a path component is a FILE, so makedirs raises
+    # regardless of uid): the failure must surface at wait(), not be
+    # swallowed on the worker thread
+    blocked = tmp_path / "blocked"
+    blocked.write_text("not a directory")
+    saver.save(state, epoch=0, best_acc=0.0, is_best=False,
+               directory=str(blocked / "sub"), process_index=0)
+    with pytest.raises(OSError):
+        saver.wait()
+
+
+def test_resume_auto_cli(tmp_path, capsys):
+    """--resume auto: fresh when the dir is empty, newest checkpoint after."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    common = [
+        "--dataset", "synthetic", "--model", "linear",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0",
+        "--checkpoint-dir", str(tmp_path), "--resume", "auto",
+        "--trainer-mode", "stepwise",
+    ]
+    run(build_parser().parse_args(common + ["--epochs", "2"]))
+    out1 = capsys.readouterr().out
+    assert "training fresh" in out1
+    first = {n for n in os.listdir(tmp_path) if n.startswith("checkpoint_")}
+    assert first == {"checkpoint_0.npz", "checkpoint_1.npz"}
+
+    summary = run(build_parser().parse_args(common + ["--epochs", "3"]))
+    out2 = capsys.readouterr().out
+    assert "loaded checkpoint" in out2 and "checkpoint_1.npz" in out2
+    # resumed at epoch 2: exactly one new epoch ran
+    assert summary["epochs_run"] == 1
+    assert "checkpoint_2.npz" in os.listdir(tmp_path)
+
+
+def test_async_and_keep_last_cli(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0", "--epochs", "3",
+        "--checkpoint-dir", str(tmp_path), "--trainer-mode", "stepwise",
+        "--async-checkpoint", "--keep-last", "1",
+    ]))
+    names = sorted(os.listdir(tmp_path))
+    assert [n for n in names if n.startswith("checkpoint_")] == [
+        "checkpoint_2.npz"]
+    assert "model_best.npz" in names
+    # the retained file is complete and loadable (async write landed)
+    _, epoch, _ = load_checkpoint(str(tmp_path / "checkpoint_2.npz"),
+                                  fresh_state())
+    assert epoch == 3

@@ -226,3 +226,41 @@ def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path):
     # resume continued at epoch 1, so the resumed run improves on (or at
     # least evolves from) the first epoch's loss deterministically
     assert second[0]["train_loss"] != first[0]["train_loss"]
+
+
+@pytest.mark.slow
+def test_two_process_resume_auto(tmp_path):
+    """--resume auto across a real 2-process world: run 1 trains fresh,
+    run 2 resolves the newest checkpoint on process 0, broadcasts the
+    choice (cli.py), and both ranks resume at the same epoch."""
+
+    def spawn(extra):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, _WORKER, str(rank), "2", str(port),
+                 str(tmp_path / "ckpts")] + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=_child_env(), cwd=_REPO,
+            )
+            for rank in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=600)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        return outs
+
+    spawn(["--resume", "auto"])
+    assert "checkpoint_0.npz" in os.listdir(tmp_path / "ckpts")
+    outs = spawn(["--resume", "auto", "--epochs", "2"])
+    # both ranks loaded the SAME checkpoint process 0 resolved
+    for out in outs:
+        assert "loaded checkpoint" in out and "checkpoint_0.npz" in out
