@@ -109,6 +109,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "when absent (reference :137-138 download=True; for "
                         "multi-host runs, pre-download with a single-process "
                         "run first, as the reference README does)")
+    p.add_argument("--dtype", type=str, default=None,
+                   choices=["bf16", "f32"],
+                   help="compute dtype override. linear/cnn/vit default to "
+                        "bfloat16 activations with float32 params/logits "
+                        "(the MXU-native policy); the MoE models default "
+                        "to f32 (router numerics). f32 forces "
+                        "full-precision compute everywhere for numerics "
+                        "debugging or CPU parity runs")
     p.add_argument("--optimizer", type=str, default="adam",
                    choices=["adam", "adam_pallas", "sgd"],
                    help="adam_pallas = fused Pallas update kernel")
@@ -431,6 +439,17 @@ def run(args, epoch_callback=None) -> dict:
          f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     model_kwargs = {}
+    if getattr(args, "dtype", None):
+        if not model_accepts(args.model, "compute_dtype"):
+            raise SystemExit(
+                f"--dtype not supported: model {args.model!r} does not "
+                f"accept a compute_dtype"
+            )
+        import jax.numpy as jnp
+
+        model_kwargs["compute_dtype"] = {
+            "bf16": jnp.bfloat16, "f32": jnp.float32,
+        }[args.dtype]
     if getattr(args, "attention", "dense") == "flash":
         # Explicit capability probe (not except TypeError, which would
         # swallow genuine constructor bugs as a flag error).
@@ -590,7 +609,15 @@ def run(args, epoch_callback=None) -> dict:
             _os2.makedirs(parent, exist_ok=True)
     else:
         metrics_file = None
-    with profile_trace(args.profile_dir):
+    from contextlib import nullcontext
+
+    # The saver as context manager: a clean exit waits for the last write
+    # (and surfaces any stashed write error); an exception still joins the
+    # in-flight thread so an already-snapshotted checkpoint lands on disk
+    # instead of dying with the daemon thread at interpreter exit.
+    with profile_trace(args.profile_dir), (
+        saver if saver is not None else nullcontext()
+    ):
         for epoch in range(start_epoch, args.epochs):
             train_loader.set_sample_epoch(epoch)  # per-epoch reshuffle (:231)
             trainer.state = trainer.state.with_learning_rate(lr_of(epoch))  # (:232)
@@ -630,8 +657,6 @@ def run(args, epoch_callback=None) -> dict:
                     }) + "\n")
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break
-        if saver is not None:
-            saver.wait()  # the last epoch's write must land before exit
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
          f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")

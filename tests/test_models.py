@@ -40,3 +40,32 @@ def test_cnn_is_bigger_than_linear():
     params = cnn.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
     n = sum(p.size for p in jax.tree.leaves(params))
     assert n > 100_000  # conv + dense stack for the 99% target
+
+
+def test_dtype_flag_cli(tmp_path):
+    """--dtype f32 forces full-precision compute; bf16 is the default."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    common = [
+        "--dataset", "synthetic", "--model", "linear",
+        "--batch-size", "64", "--synthetic-train-size", "128",
+        "--synthetic-test-size", "64", "--seed", "0", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path), "--trainer-mode", "stepwise",
+    ]
+    s32 = run(build_parser().parse_args(common + ["--dtype", "f32"]))
+    sbf = run(build_parser().parse_args(common + ["--dtype", "bf16"]))
+    import numpy as np
+
+    assert np.isfinite(s32["history"][0]["train_loss"])
+    assert np.isfinite(sbf["history"][0]["train_loss"])
+    # different compute precision -> measurably different loss trajectories
+    assert s32["history"][0]["train_loss"] != sbf["history"][0]["train_loss"]
+
+
+def test_dtype_flag_model_kwargs():
+    import jax.numpy as jnp
+
+    from pytorch_distributed_mnist_tpu.models import get_model
+
+    m = get_model("cnn", compute_dtype=jnp.float32)
+    assert m.compute_dtype == jnp.float32
