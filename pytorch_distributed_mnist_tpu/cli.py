@@ -120,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", type=str, default="adam",
                    choices=["adam", "adam_pallas", "sgd"],
                    help="adam_pallas = fused Pallas update kernel")
+    p.add_argument("--loss", type=str, default="xla",
+                   choices=["xla", "fused"],
+                   help="cross-entropy impl: xla (compiler-fused, "
+                        "GSPMD-partitionable, default) or fused (the "
+                        "Pallas single-pass kernel, ops/pallas/xent.py; "
+                        "single-device or --trainer-mode explicit only — "
+                        "under GSPMD batch sharding a pallas call would "
+                        "gather, not partition)")
     p.add_argument("--pipeline-stages", type=int, default=1,
                    help="pipeline-parallel stages for --model vit (GPipe "
                         "over a 'stage' mesh axis; devices are split "
@@ -437,6 +445,18 @@ def run(args, epoch_callback=None) -> dict:
         mesh = make_mesh(("data",))
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
          f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    loss_impl = getattr(args, "loss", "xla")
+    if loss_impl == "fused" and jax.device_count() > 1 and \
+            args.trainer_mode != "explicit":
+        raise SystemExit(
+            "--loss fused on a multi-device mesh requires --trainer-mode "
+            "explicit: the shard_map step hands the kernel local batch "
+            "shards; under GSPMD jit the pallas call would force a gather"
+        )
+    from pytorch_distributed_mnist_tpu.ops.loss import set_loss_impl
+
+    set_loss_impl(loss_impl)
 
     model_kwargs = {}
     if getattr(args, "dtype", None):

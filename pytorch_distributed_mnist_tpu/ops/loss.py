@@ -37,13 +37,48 @@ def cross_entropy_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.n
     return jnp.maximum(logz - label_logits, 0.0)
 
 
+_IMPL = "xla"
+
+
+def set_loss_impl(name: str) -> None:
+    """Select the cross-entropy implementation: ``xla`` (default) or
+    ``fused`` (the Pallas kernel, ``ops/pallas/xent.py``). Resolved at
+    trace time, so it must be set before the step functions are jitted
+    (the CLI sets it before constructing the Trainer). ``fused`` under
+    GSPMD batch sharding would be gathered, not partitioned — the CLI
+    restricts it to single-device or explicit-shard_map runs, where the
+    kernel sees local shards."""
+    if name not in ("xla", "fused"):
+        raise ValueError(f"unknown loss impl {name!r}")
+    global _IMPL
+    _IMPL = name
+
+
+def get_loss_impl() -> str:
+    return _IMPL
+
+
+def masked_mean(per_ex: jnp.ndarray, mask: jnp.ndarray | None) -> jnp.ndarray:
+    """Mean (or masked mean) over per-example losses — the ONE place the
+    reduction semantics live, shared by both loss impls so they cannot
+    drift. Padded examples (0 in ``mask``) contribute nothing."""
+    if mask is None:
+        return jnp.mean(per_ex)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(per_ex * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def cross_entropy(
     logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray | None = None
 ) -> jnp.ndarray:
     """Mean softmax cross-entropy; with ``mask`` (0/1 per example), a masked
     mean so padded examples (eval batch padding) contribute nothing."""
-    per_ex = cross_entropy_per_example(logits, labels)
-    if mask is None:
-        return jnp.mean(per_ex)
-    mask = mask.astype(jnp.float32)
-    return jnp.sum(per_ex * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if _IMPL == "fused":
+        from pytorch_distributed_mnist_tpu.ops.pallas.xent import (
+            fused_cross_entropy_per_example,
+        )
+
+        per_ex = fused_cross_entropy_per_example(logits, labels)
+    else:
+        per_ex = cross_entropy_per_example(logits, labels)
+    return masked_mean(per_ex, mask)

@@ -46,3 +46,15 @@ def tiny_data():
 
     images, labels = synthetic_dataset(512, seed=42)
     return normalize_images(images), labels.astype(np.int32)
+
+
+@pytest.fixture(autouse=True)
+def _reset_loss_impl():
+    """The loss impl is a process-global trace-time switch (ops/loss.py);
+    a test that sets 'fused' must not leak it into later-collected tests
+    (which would silently stop exercising the XLA path — including the
+    bf16 optimization-barrier regression coverage)."""
+    yield
+    from pytorch_distributed_mnist_tpu.ops.loss import set_loss_impl
+
+    set_loss_impl("xla")
