@@ -53,7 +53,11 @@ from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
 from pytorch_distributed_mnist_tpu.train.state import create_train_state
 from pytorch_distributed_mnist_tpu.train.trainer import Trainer
 from pytorch_distributed_mnist_tpu.utils.logging import log0
-from pytorch_distributed_mnist_tpu.utils.profiling import StepTimer, profile_trace
+from pytorch_distributed_mnist_tpu.utils.profiling import (
+    StepTimer,
+    phase,
+    profile_trace,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -156,6 +160,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--patch-size", type=int, default=4,
                    help="ViT patch size (28 must divide evenly; tokens = "
                         "(28/patch)^2)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each transformer block: recompute "
+                        "block activations in backward instead of storing "
+                        "them (~depth x lower activation memory for the "
+                        "token axis; composes with --grad-accum and the "
+                        "parallelism flags). --model vit only")
     p.add_argument("--optimizer-sharding", type=str, default="none",
                    choices=["none", "zero1", "zero3"],
                    help="zero1 = shard Adam moments over the data axis "
@@ -488,6 +498,13 @@ def run(args, epoch_callback=None) -> dict:
                 f"{args.model!r} does not accept one"
             )
         model_kwargs["patch_size"] = patch
+    if getattr(args, "remat", False):
+        if not model_accepts(args.model, "remat"):
+            raise SystemExit(
+                f"--remat only applies to block-structured models; "
+                f"{args.model!r} does not accept it"
+            )
+        model_kwargs["remat"] = True
     init_model = None  # a dense-attention twin when the real apply can't init
     if sp > 1:
         from functools import partial as _partial
@@ -645,9 +662,11 @@ def run(args, epoch_callback=None) -> dict:
             # host values before returning, so the measured span covers all
             # device work for the epoch and nothing else (not eval, not the
             # checkpoint write).
-            with timer.measure(len(train_loader) * args.batch_size):
+            with timer.measure(len(train_loader) * args.batch_size), \
+                    phase("train", epoch=epoch):
                 train_loss, train_acc = trainer.train()
-            test_loss, test_acc = trainer.evaluate()
+            with phase("eval", epoch=epoch):
+                test_loss, test_acc = trainer.evaluate()
             log0(f"Epoch: {epoch}/{args.epochs}, lr: {lr_of(epoch):g},"
                  f" train loss: {train_loss}, train acc: {train_acc},"
                  f" test loss: {test_loss}, test acc: {test_acc}")
@@ -659,9 +678,14 @@ def run(args, epoch_callback=None) -> dict:
                 keep_last=getattr(args, "keep_last", 0),
             )
             if saver is not None:
-                saver.save(trainer.state, **ckpt_kwargs)
+                # The annotated span is the drain of the PREVIOUS epoch's
+                # in-flight write + this epoch's host snapshot; the write
+                # itself runs on the saver's thread, annotated there.
+                with phase("checkpoint_drain", epoch=epoch):
+                    saver.save(trainer.state, **ckpt_kwargs)
             else:
-                save_checkpoint(trainer.state, **ckpt_kwargs)
+                with phase("checkpoint", epoch=epoch):
+                    save_checkpoint(trainer.state, **ckpt_kwargs)
             history.append({"epoch": epoch, "train_loss": train_loss.average,
                             "train_acc": train_acc.accuracy,
                             "test_loss": test_loss.average,

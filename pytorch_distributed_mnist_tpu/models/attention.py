@@ -107,6 +107,12 @@ class VisionTransformer(nn.Module):
     mlp_ratio: int = 4
     attention_fn: Optional[Callable] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # jax.checkpoint around each block: activations inside a block are
+    # recomputed during backward instead of stored, the standard TPU
+    # HBM-for-FLOPs trade for long sequences (the FLOPs rerun on an MXU
+    # that was stalling on HBM anyway). Param structure is unchanged, so
+    # checkpoints round-trip between remat and non-remat models.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -121,8 +127,9 @@ class VisionTransformer(nn.Module):
             (1, x.shape[1], self.embed_dim),
         )
         x = x + pos.astype(self.compute_dtype)
+        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = block_cls(
                 self.num_heads, self.mlp_ratio, self.attention_fn,
                 self.compute_dtype, name=f"block{i}",
             )(x)

@@ -74,3 +74,37 @@ def make_explicit_dp_train_step(mesh: Mesh, axis: str = "data"):
         return new_state, metrics
 
     return jax.jit(sharded_body, donate_argnums=(0,))
+
+
+def make_explicit_dp_eval_step(mesh: Mesh, axis: str = "data"):
+    """Explicit-shard_map eval step, the forward-only sibling of the train
+    step above. Explicit mode must be explicit END TO END: a GSPMD eval
+    step alongside a shard_map train step would silently re-introduce the
+    auto path (and, with ``--loss fused``, gather the batch for a pallas
+    call the shard_map body hands local shards instead)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def sharded_body(state, batch):
+        images, labels = batch["image"], batch["label"]
+        mask = batch.get("mask")
+        logits = state.apply_fn(state.params, images, train=False)
+        loss = cross_entropy(logits, labels, mask)
+        hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        if mask is None:
+            n = jnp.asarray(labels.shape[0], jnp.float32)
+        else:
+            n = jnp.sum(mask.astype(jnp.float32))
+            hit = hit * mask
+        return MetricState(
+            loss_sum=lax.psum(loss * n, axis),
+            correct=lax.psum(jnp.sum(hit), axis),
+            count=lax.psum(n, axis),
+        )
+
+    return jax.jit(sharded_body)

@@ -117,6 +117,11 @@ def make_pipelined_vit_apply(
         def body(h, bp):
             return block_mod.apply({"params": bp}, h), None
 
+        if model.remat:
+            # Same contract as the non-pipelined model's nn.remat blocks:
+            # per-block activations recompute in backward, so each stage
+            # holds one block's activations instead of k.
+            body = jax.checkpoint(body)
         h, _ = lax.scan(body, h, stage_blocks)
         return h
 

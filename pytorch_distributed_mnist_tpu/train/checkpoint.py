@@ -440,7 +440,12 @@ class AsyncCheckpointer:
 
         def _write() -> None:
             try:
-                self._result = save_checkpoint(snapshot, **kwargs)
+                # Annotated on THIS thread's timeline: the main thread's
+                # "checkpoint_drain" span only covers waiting for us.
+                with jax.profiler.TraceAnnotation(
+                    "checkpoint_async_write", epoch=kwargs.get("epoch", -1)
+                ):
+                    self._result = save_checkpoint(snapshot, **kwargs)
             except BaseException as exc:  # surfaced by the next wait()
                 self._error = exc
 

@@ -88,11 +88,20 @@ class Trainer:
                     "scan/stepwise"
                 )
             self._train_step = make_explicit_dp_train_step(mesh)
+            # Explicit end to end: the eval step must be shard_map too, or
+            # eval would silently run the auto-GSPMD path beside the
+            # explicit train step (and with the fused pallas loss, gather
+            # the batch the shard_map body otherwise keeps local).
+            from pytorch_distributed_mnist_tpu.parallel.collectives import (
+                make_explicit_dp_eval_step,
+            )
+
+            self._eval_step = make_explicit_dp_eval_step(mesh)
         else:
             self._train_step = make_train_step(
                 mesh, state_sharding=state_sharding, grad_accum=grad_accum
             )
-        self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
+            self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
         self._train_epoch = (
             make_train_epoch(mesh, state_sharding=state_sharding,
                              grad_accum=grad_accum)

@@ -85,3 +85,12 @@ def profile_trace(logdir: Optional[str]):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def phase(name: str, **kwargs):
+    """Named trace span (``jax.profiler.TraceAnnotation``) for one lifecycle
+    phase — train/eval/checkpoint per epoch. Zero-cost when no trace is
+    being captured; inside a ``--profile-dir`` capture the spans label the
+    host timeline so the train/eval/checkpoint split is readable in
+    xprof/perfetto instead of one undifferentiated epoch blob."""
+    return jax.profiler.TraceAnnotation(name, **kwargs)

@@ -165,3 +165,36 @@ def test_cli_pipeline_rejects_non_vit(tmp_path):
     ])
     with pytest.raises(SystemExit, match="requires --model vit"):
         run(args)
+
+
+def test_pipelined_remat_same_loss_and_grads():
+    """--remat through the pipeline: jax.checkpoint around each block in
+    the stage scan must not change loss or gradients."""
+    import numpy as np
+
+    from pytorch_distributed_mnist_tpu.models import get_model
+    from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
+    from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+        create_pipelined_vit_state,
+    )
+
+    mesh_dp_pp = make_mesh(("data", "stage"), shape=(4, 2))
+    x = jax.random.normal(jax.random.key(0), (8, 28, 28, 1), jnp.float32)
+    y = jnp.arange(8, dtype=jnp.int32) % 10
+
+    outs = []
+    for remat in (False, True):
+        model = get_model("vit", compute_dtype=jnp.float32, depth=2,
+                          remat=remat)
+        state, _ = create_pipelined_vit_state(
+            model, jax.random.key(1), mesh_dp_pp, data_axis="data")
+
+        def loss_fn(p, apply=state.apply_fn):
+            return cross_entropy(apply(p, x), y)
+
+        l, g = jax.value_and_grad(loss_fn)(state.params)
+        outs.append((float(l), g))
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs[0][1]), jax.tree.leaves(outs[1][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -86,3 +86,55 @@ def test_vit_ring_attention_forward_matches_dense():
     np.testing.assert_allclose(
         ring.apply(params, x), dense.apply(params, x), rtol=2e-4, atol=2e-4
     )
+
+
+def test_remat_same_params_loss_and_grads():
+    """nn.remat(TransformerBlock) must be a pure memory/FLOPs trade:
+    identical param structure, identical forward, identical gradients."""
+    import numpy as np
+
+    from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
+
+    k = jax.random.key(0)
+    x = jax.random.normal(k, (4, 28, 28, 1), jnp.float32)
+    y = jnp.array([1, 2, 3, 4], jnp.int32)
+
+    base = get_model("vit", compute_dtype=jnp.float32)
+    rem = get_model("vit", compute_dtype=jnp.float32, remat=True)
+    params = base.init(k, x)["params"]
+    assert jax.tree_util.tree_structure(
+        params) == jax.tree_util.tree_structure(rem.init(k, x)["params"])
+
+    def loss(m, p):
+        return cross_entropy(m.apply({"params": p}, x), y)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(rem, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_cli(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    s = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--remat",
+        "--batch-size", "64", "--synthetic-train-size", "128",
+        "--synthetic-test-size", "64", "--seed", "0", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path), "--trainer-mode", "stepwise",
+    ]))
+    assert s["epochs_run"] == 1
+
+
+def test_remat_wrong_model_errors(tmp_path):
+    import pytest
+
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    with pytest.raises(SystemExit, match="remat"):
+        run(build_parser().parse_args([
+            "--dataset", "synthetic", "--model", "cnn", "--remat",
+            "--checkpoint-dir", str(tmp_path),
+        ]))
