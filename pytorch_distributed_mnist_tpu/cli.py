@@ -390,12 +390,17 @@ def run(args, epoch_callback=None) -> dict:
                 f"target its blocks; other models would silently stay "
                 f"replicated); got --model {args.model}"
             )
-        if getattr(args, "attention", "dense") == "flash":
+        if getattr(args, "attention", "dense") == "flash" and not (
+            tp == 1 and sp > 1
+            and getattr(args, "sequence_parallel_impl", "ring") == "ulysses"
+        ):
             raise SystemExit(
-                "--tensor-parallel/--sequence-parallel require "
-                "--attention dense: the Pallas flash kernel is not "
-                "GSPMD-partitionable, and the ring supplies its own "
-                "blockwise attention"
+                "--attention flash composes only with "
+                "--sequence-parallel-impl ulysses (each device holds the "
+                "FULL sequence for its head subset, so the kernel runs on "
+                "local shards inside the shard_map); under GSPMD "
+                "tensor-parallel the pallas call would gather, and the "
+                "ring supplies its own blockwise attention"
             )
         if jax.device_count() % (tp * sp):
             raise SystemExit(
@@ -513,6 +518,10 @@ def run(args, epoch_callback=None) -> dict:
         # twin (the batch-1 init trace can't satisfy the SP data-axis
         # sharding), then the sequence-parallel apply_fn is swapped in —
         # the same pattern the dryrun's DP x TP x SP phase uses.
+        # With --attention flash, the guard above admitted only the
+        # Ulysses composition: the kernel becomes the per-device LOCAL
+        # attention inside its shard_map (full sequence, local heads).
+        local_attn = model_kwargs.pop("attention_fn", None)
         init_model = get_model(args.model, **model_kwargs)
         if getattr(args, "sequence_parallel_impl", "ring") == "ulysses":
             from pytorch_distributed_mnist_tpu.parallel.ulysses import (
@@ -521,6 +530,7 @@ def run(args, epoch_callback=None) -> dict:
 
             model_kwargs["attention_fn"] = _partial(
                 ulysses_attention, mesh=mesh, axis="seq", batch_axis="data",
+                local_attention=local_attn,
             )
         else:
             from pytorch_distributed_mnist_tpu.parallel.ring import (

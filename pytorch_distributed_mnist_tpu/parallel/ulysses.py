@@ -20,7 +20,7 @@ Requires ``num_heads % axis_size == 0``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,13 +38,23 @@ def ulysses_attention_local(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    local_attention: Optional[Callable] = None,
 ) -> jnp.ndarray:
-    """Per-device body; token axis sharded on ``axis_name`` (inside shard_map)."""
+    """Per-device body; token axis sharded on ``axis_name`` (inside shard_map).
+
+    ``local_attention`` is the per-device kernel over the full sequence /
+    local heads (default: dense ``full_attention``). Because Ulysses hands
+    each device the WHOLE sequence for its head subset, the Pallas flash
+    kernel slots in directly — unlike the ring, whose blockwise online
+    softmax supplies its own attention. This is how ``--attention flash``
+    composes with ``--sequence-parallel-impl ulysses`` from the CLI.
+    """
     n = lax.axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(
             f"num_heads {q.shape[2]} not divisible by axis size {n}"
         )
+    attn = local_attention if local_attention is not None else full_attention
 
     def to_heads(x):  # (B, T/n, H, D) -> (B, T, H/n, D)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -52,7 +62,7 @@ def ulysses_attention_local(
     def to_tokens(x):  # (B, T, H/n, D) -> (B, T/n, H, D)
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    o = full_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal, scale=scale)
+    o = attn(to_heads(q), to_heads(k), to_heads(v), causal=causal, scale=scale)
     return to_tokens(o)
 
 
@@ -66,6 +76,7 @@ def ulysses_attention(
     batch_axis: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    local_attention: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Ulysses attention on GLOBAL ``(B, T, H, D)`` arrays; T sharded on ``axis``.
 
@@ -73,7 +84,8 @@ def ulysses_attention(
     cannot also be mesh-sharded here — Ulysses itself re-shards heads.
     """
     spec = P(batch_axis, axis, None, None)
-    fn = partial(ulysses_attention_local, axis_name=axis, causal=causal, scale=scale)
+    fn = partial(ulysses_attention_local, axis_name=axis, causal=causal,
+                 scale=scale, local_attention=local_attention)
     return jax.shard_map(
         fn,
         mesh=mesh,

@@ -100,3 +100,29 @@ def test_ulysses_rejects_indivisible_heads(seq_mesh):
     q, k, v = (jax.random.normal(kk, (1, 16, 3, 8), jnp.float32) for kk in ks)
     with pytest.raises(ValueError, match="not divisible"):
         ulysses_attention(q, k, v, mesh=seq_mesh)
+
+
+def test_ulysses_with_flash_local_matches_dense():
+    """Ulysses + Pallas flash as the per-device local attention: the
+    composition the CLI exposes as --sequence-parallel-impl ulysses
+    --attention flash. Must match single-device dense attention."""
+    from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+    from pytorch_distributed_mnist_tpu.parallel.ulysses import (
+        ulysses_attention,
+    )
+
+    mesh = make_mesh(("data", "seq"), shape=(2, 4))
+    b, t, h, d = 2, 32, 8, 16
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+
+    for causal in (False, True):
+        want = full_attention(q, k, v, causal=causal)
+        got = ulysses_attention(
+            q, k, v, mesh=mesh, axis="seq", batch_axis="data",
+            causal=causal, local_attention=flash_attention,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)

@@ -138,3 +138,31 @@ def test_remat_wrong_model_errors(tmp_path):
             "--dataset", "synthetic", "--model", "cnn", "--remat",
             "--checkpoint-dir", str(tmp_path),
         ]))
+
+
+def test_ulysses_flash_cli(tmp_path):
+    """--sequence-parallel-impl ulysses --attention flash end-to-end."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    s = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--patch-size", "7",
+        "--sequence-parallel", "2", "--sequence-parallel-impl", "ulysses",
+        "--attention", "flash",
+        "--batch-size", "32", "--synthetic-train-size", "64",
+        "--synthetic-test-size", "32", "--seed", "0", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path), "--trainer-mode", "stepwise",
+    ]))
+    assert s["epochs_run"] == 1
+
+
+def test_ring_flash_cli_still_rejected(tmp_path):
+    import pytest
+
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    with pytest.raises(SystemExit, match="ulysses"):
+        run(build_parser().parse_args([
+            "--dataset", "synthetic", "--model", "vit", "--patch-size", "7",
+            "--sequence-parallel", "2", "--attention", "flash",
+            "--checkpoint-dir", str(tmp_path),
+        ]))
