@@ -537,6 +537,10 @@ def run(args, epoch_callback=None) -> dict:
                 ring_attention,
             )
 
+            # The ring's blockwise online softmax IS the attention; a
+            # popped flash kernel has nowhere to go. The guard above must
+            # keep ring+flash unreachable — assert the coupling locally.
+            assert local_attn is None, "ring+flash must be rejected earlier"
             model_kwargs["attention_fn"] = _partial(
                 ring_attention, mesh=mesh, axis="seq", batch_axis="data",
                 head_axis="model" if tp > 1 else None,
