@@ -155,20 +155,27 @@ def child_bench(steps: int, reps: int) -> dict:
     if not flops_per_step:
         flops_per_step = float(_CNN_STEP_FLOPS_PER_IMAGE * batch)
 
-    # Warmup with the SAME shapes so the timed region is compile-free.
-    state, m = run_pass(state)
-    float(m.count)  # full host roundtrip: remote execution definitely done
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        state, m = run_pass(state)
-        assert float(m.count) == batch * (1 if device.platform == "cpu" else steps)
-        best = min(best, time.perf_counter() - t0)
+    def warmup_and_time(run_fn, st, expected_count):
+        """Shared timing protocol: one compile/warmup pass synced by a full
+        host read, then best-of-``reps`` — identical for the primary and
+        the fused-kernel secondary so the two numbers stay comparable."""
+        st, m = run_fn(st)
+        float(m.count)  # full host roundtrip: remote execution definitely done
+        t_best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            st, m = run_fn(st)
+            assert float(m.count) == expected_count
+            t_best = min(t_best, time.perf_counter() - t0)
+        return st, t_best
+
+    expected = batch * (1 if device.platform == "cpu" else steps)
+    state, best = warmup_and_time(run_pass, state, expected)
 
     steps_per_sec = steps / best
     peak = _peak_flops(device.device_kind)
     mfu = (flops_per_step * steps_per_sec / n_chips / peak) if peak else None
-    return {
+    result = {
         "ok": True,
         "images_per_sec_per_chip": batch * steps / best / n_chips,
         "steps_per_sec": steps_per_sec,
@@ -180,6 +187,32 @@ def child_bench(steps: int, reps: int) -> dict:
         "peak_flops_per_chip": peak,
         "mfu": mfu,
     }
+
+    if (device.platform != "cpu" and n_chips == 1
+            and not os.environ.get("BENCH_SKIP_FUSED")):
+        # Secondary measurement: the all-first-party-kernel path (Pallas
+        # fused cross-entropy + fused Adam). Extra fields only — any
+        # failure here is recorded and cannot harm the primary number.
+        # Single-chip only: under GSPMD batch sharding the pallas loss
+        # would gather (the exact configuration cli.py refuses), so a
+        # multi-chip "fused" number would measure an unsupported path.
+        try:
+            from pytorch_distributed_mnist_tpu.ops.loss import set_loss_impl
+
+            set_loss_impl("fused")
+            try:
+                state_f = create_train_state(
+                    model, jax.random.key(0), optimizer="adam_pallas")
+                epoch_f = make_train_epoch(mesh)
+                state_f, best_f = warmup_and_time(
+                    epoch_f, state_f, batch * steps)
+                result["images_per_sec_per_chip_fused_kernels"] = (
+                    batch * steps / best_f / n_chips)
+            finally:
+                set_loss_impl("xla")
+        except Exception as exc:  # noqa: BLE001 - secondary must not fail the bench
+            result["fused_kernels_error"] = repr(exc)
+    return result
 
 
 def _run_child(env_extra: dict, steps: int, reps: int, timeout: float):
