@@ -188,18 +188,17 @@ def child_bench(steps: int, reps: int) -> dict:
         "mfu": mfu,
     }
 
-    if (device.platform != "cpu" and n_chips == 1
-            and not os.environ.get("BENCH_SKIP_FUSED")):
+    if device.platform != "cpu" and not os.environ.get("BENCH_SKIP_FUSED"):
         # Secondary measurement: the all-first-party-kernel path (Pallas
         # fused cross-entropy + fused Adam). Extra fields only — any
         # failure here is recorded and cannot harm the primary number.
-        # Single-chip only: under GSPMD batch sharding the pallas loss
-        # would gather (the exact configuration cli.py refuses), so a
-        # multi-chip "fused" number would measure an unsupported path.
+        # Passing the mesh embeds the loss kernel in the GSPMD program
+        # via its nested shard_map (per-device batch shards, no gather) —
+        # the same path `--loss fused` takes on a multi-chip run.
         try:
             from pytorch_distributed_mnist_tpu.ops.loss import set_loss_impl
 
-            set_loss_impl("fused")
+            set_loss_impl("fused", mesh=mesh)
             try:
                 state_f = create_train_state(
                     model, jax.random.key(0), optimizer="adam_pallas")

@@ -128,10 +128,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["xla", "fused"],
                    help="cross-entropy impl: xla (compiler-fused, "
                         "GSPMD-partitionable, default) or fused (the "
-                        "Pallas single-pass kernel, ops/pallas/xent.py; "
-                        "single-device or --trainer-mode explicit only — "
-                        "under GSPMD batch sharding a pallas call would "
-                        "gather, not partition)")
+                        "Pallas single-pass kernel, ops/pallas/xent.py, "
+                        "embedded in GSPMD programs via a nested "
+                        "shard_map over the data axis; pure-DP meshes "
+                        "only — TP/SP/PP logits layouts are "
+                        "model-dependent)")
     p.add_argument("--pipeline-stages", type=int, default=1,
                    help="pipeline-parallel stages for --model vit (GPipe "
                         "over a 'stage' mesh axis; devices are split "
@@ -461,17 +462,26 @@ def run(args, epoch_callback=None) -> dict:
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
          f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
-    loss_impl = getattr(args, "loss", "xla")
-    if loss_impl == "fused" and jax.device_count() > 1 and \
-            args.trainer_mode != "explicit":
-        raise SystemExit(
-            "--loss fused on a multi-device mesh requires --trainer-mode "
-            "explicit: the shard_map step hands the kernel local batch "
-            "shards; under GSPMD jit the pallas call would force a gather"
-        )
     from pytorch_distributed_mnist_tpu.ops.loss import set_loss_impl
 
-    set_loss_impl(loss_impl)
+    loss_impl = getattr(args, "loss", "xla")
+    if loss_impl == "fused":
+        if pp > 1 or tp > 1 or sp > 1:
+            raise SystemExit(
+                "--loss fused supports the pure data-parallel mesh: with "
+                "TP/SP/PP axes the logits layout is model-dependent and "
+                "the kernel's nested shard_map would mis-shard it; use "
+                "--loss xla there"
+            )
+        # GSPMD modes get the mesh so the kernel runs per-device on local
+        # batch shards via a nested shard_map; the explicit mode is
+        # already inside a shard_map (no nesting over the same axis).
+        set_loss_impl(
+            "fused",
+            mesh=mesh if args.trainer_mode != "explicit" else None,
+        )
+    else:
+        set_loss_impl("xla")
 
     model_kwargs = {}
     if getattr(args, "dtype", None):

@@ -38,24 +38,57 @@ def cross_entropy_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.n
 
 
 _IMPL = "xla"
+_MESH = None
+_MESH_AXIS = "data"
 
 
-def set_loss_impl(name: str) -> None:
+def set_loss_impl(name: str, mesh=None, data_axis: str = "data") -> None:
     """Select the cross-entropy implementation: ``xla`` (default) or
     ``fused`` (the Pallas kernel, ``ops/pallas/xent.py``). Resolved at
     trace time, so it must be set before the step functions are jitted
-    (the CLI sets it before constructing the Trainer). ``fused`` under
-    GSPMD batch sharding would be gathered, not partitioned — the CLI
-    restricts it to single-device or explicit-shard_map runs, where the
-    kernel sees local shards."""
+    (the CLI sets it before constructing the Trainer).
+
+    ``mesh``: a pallas call under GSPMD batch sharding would be gathered,
+    not partitioned; passing the mesh makes ``cross_entropy`` wrap the
+    kernel in a nested ``shard_map`` over ``data_axis`` so each device
+    runs it on its local batch shard — the standard way to embed a manual
+    kernel in a GSPMD program. Leave ``mesh=None`` when the caller is
+    ALREADY inside a shard_map (the explicit trainer mode): shard_maps do
+    not nest over the same axis, and there the batch is local anyway."""
     if name not in ("xla", "fused"):
         raise ValueError(f"unknown loss impl {name!r}")
-    global _IMPL
+    global _IMPL, _MESH, _MESH_AXIS
     _IMPL = name
+    _MESH = mesh if name == "fused" else None
+    _MESH_AXIS = data_axis
 
 
 def get_loss_impl() -> str:
     return _IMPL
+
+
+def _fused_per_example(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    from pytorch_distributed_mnist_tpu.ops.pallas.xent import (
+        fused_cross_entropy_per_example,
+    )
+
+    if _MESH is None or _MESH.shape[_MESH_AXIS] == 1:
+        return fused_cross_entropy_per_example(logits, labels)
+    size = _MESH.shape[_MESH_AXIS]
+    if logits.shape[0] % size:
+        # shard_map needs exact divisibility (GSPMD pads, manual regions
+        # cannot); a ragged tail batch statically falls back to the XLA
+        # impl — same values, different fusion.
+        return cross_entropy_per_example(logits, labels)
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        fused_cross_entropy_per_example,
+        mesh=_MESH,
+        in_specs=(P(_MESH_AXIS), P(_MESH_AXIS)),
+        out_specs=P(_MESH_AXIS),
+        check_vma=False,
+    )(logits, labels)
 
 
 def masked_mean(per_ex: jnp.ndarray, mask: jnp.ndarray | None) -> jnp.ndarray:
@@ -74,11 +107,7 @@ def cross_entropy(
     """Mean softmax cross-entropy; with ``mask`` (0/1 per example), a masked
     mean so padded examples (eval batch padding) contribute nothing."""
     if _IMPL == "fused":
-        from pytorch_distributed_mnist_tpu.ops.pallas.xent import (
-            fused_cross_entropy_per_example,
-        )
-
-        per_ex = fused_cross_entropy_per_example(logits, labels)
+        per_ex = _fused_per_example(logits, labels)
     else:
         per_ex = cross_entropy_per_example(logits, labels)
     return masked_mean(per_ex, mask)
