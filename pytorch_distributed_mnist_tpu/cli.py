@@ -213,6 +213,21 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _vit_num_heads() -> int:
+    """The ViT's default head count, read from the model dataclass — the
+    single source for every head-divisibility flag check."""
+    import dataclasses
+
+    from pytorch_distributed_mnist_tpu.models.attention import (
+        VisionTransformer,
+    )
+
+    return next(
+        f.default for f in dataclasses.fields(VisionTransformer)
+        if f.name == "num_heads"
+    )
+
+
 def _build_loaders(args, seed: int):
     name = "mnist" if args.dataset == "synthetic" else args.dataset
     synthesize = args.dataset == "synthetic"
@@ -391,17 +406,17 @@ def run(args, epoch_callback=None) -> dict:
                 f"target its blocks; other models would silently stay "
                 f"replicated); got --model {args.model}"
             )
-        if getattr(args, "attention", "dense") == "flash" and not (
+        flash_ok = (
             tp == 1 and sp > 1
             and getattr(args, "sequence_parallel_impl", "ring") == "ulysses"
-        ):
+        ) or (tp > 1 and sp == 1)
+        if getattr(args, "attention", "dense") == "flash" and not flash_ok:
             raise SystemExit(
-                "--attention flash composes only with "
-                "--sequence-parallel-impl ulysses (each device holds the "
-                "FULL sequence for its head subset, so the kernel runs on "
-                "local shards inside the shard_map); under GSPMD "
-                "tensor-parallel the pallas call would gather, and the "
-                "ring supplies its own blockwise attention"
+                "--attention flash composes with "
+                "--sequence-parallel-impl ulysses (full sequence per "
+                "device, head subset) or with --tensor-parallel alone "
+                "(kernel shard_mapped over batch x heads); the ring "
+                "supplies its own blockwise attention"
             )
         if jax.device_count() % (tp * sp):
             raise SystemExit(
@@ -424,16 +439,7 @@ def run(args, epoch_callback=None) -> dict:
                     "nest inside the explicit-DP shard_map); use scan or "
                     "stepwise"
                 )
-            import dataclasses as _dc
-
-            from pytorch_distributed_mnist_tpu.models.attention import (
-                VisionTransformer,
-            )
-
-            num_heads = next(
-                f.default for f in _dc.fields(VisionTransformer)
-                if f.name == "num_heads"
-            )
+            num_heads = _vit_num_heads()
             if tp > 1 and num_heads % tp:
                 raise SystemExit(
                     f"--tensor-parallel {tp} with --sequence-parallel: the "
@@ -555,6 +561,40 @@ def run(args, epoch_callback=None) -> dict:
                 ring_attention, mesh=mesh, axis="seq", batch_axis="data",
                 head_axis="model" if tp > 1 else None,
             )
+    elif tp > 1 and model_kwargs.get("attention_fn") is not None:
+        # --tensor-parallel + --attention flash (sp == 1): shard_map the
+        # kernel over batch x heads so it matches the Megatron layout
+        # (qkv/proj weights head-sharded on 'model') with no gather.
+        from functools import partial as _partial
+
+        from pytorch_distributed_mnist_tpu.ops.pallas.flash import (
+            sharded_flash_attention,
+        )
+
+        num_heads = _vit_num_heads()
+        if num_heads % tp:
+            raise SystemExit(
+                f"--attention flash with --tensor-parallel {tp}: the "
+                f"kernel shards the ViT's {num_heads} heads over the "
+                f"model axis, so the width must divide {num_heads}"
+            )
+        dp_width = jax.device_count() // (tp * sp)
+        micro = args.batch_size // grad_accum
+        if micro % dp_width:
+            # shard_map requires exact divisibility (GSPMD pads; manual
+            # regions cannot) — fail with flag-level language, not a
+            # jit-time sharding trace error.
+            raise SystemExit(
+                f"--attention flash with --tensor-parallel {tp}: the "
+                f"per-step batch ({micro}) must divide evenly over the "
+                f"{dp_width} data slices for the kernel's shard_map"
+            )
+        del model_kwargs["attention_fn"]
+        init_model = get_model(args.model, **model_kwargs)
+        model_kwargs["attention_fn"] = _partial(
+            sharded_flash_attention, mesh=mesh, batch_axis="data",
+            head_axis="model",
+        )
     model = get_model(args.model, **model_kwargs)
     pp_sharding = None
     if pp > 1:

@@ -40,7 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from pytorch_distributed_mnist_tpu.ops.attention import NEG_INF
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "sharded_flash_attention"]
 
 
 def _keep_mask(iq, jk, block_q, block_k, t_real, causal):
@@ -364,3 +364,30 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash(q, k, v, causal, float(scale))
+
+
+def sharded_flash_attention(q, k, v, *, mesh, batch_axis=None,
+                            head_axis=None, causal: bool = False,
+                            scale: float | None = None):
+    """Flash attention embedded in a GSPMD program via nested shard_map.
+
+    Attention is embarrassingly parallel over batch AND heads, so on a
+    ``data x model`` mesh each device runs the kernel on its local
+    ``(B/dp, T, H/tp, D)`` block — no gather, no cross-device softmax.
+    This is how ``--attention flash`` composes with ``--tensor-parallel``
+    (the CLI passes ``head_axis='model'``): the Megatron rule table
+    shards the qkv/proj weights on heads, and this wrapper keeps the
+    kernel's view consistent with that layout. Head count must divide the
+    head-axis size (the same requirement the TP rules impose).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, None, head_axis, None)
+    fn = functools.partial(flash_attention, causal=causal, scale=scale)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -126,3 +126,26 @@ def test_ulysses_with_flash_local_matches_dense():
         )
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_flash_matches_dense_on_tp_mesh():
+    """sharded_flash_attention on a data x model mesh: batch and heads
+    sharded, kernel runs per-device, output equals dense attention."""
+    from pytorch_distributed_mnist_tpu.ops.pallas.flash import (
+        sharded_flash_attention,
+    )
+
+    mesh = make_mesh(("data", "model"), shape=(2, 4))
+    b, t, h, d = 2, 32, 8, 16
+    k1, k2, k3 = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+    for causal in (False, True):
+        want = full_attention(q, k, v, causal=causal)
+        got = sharded_flash_attention(
+            q, k, v, mesh=mesh, batch_axis="data", head_axis="model",
+            causal=causal,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)

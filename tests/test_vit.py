@@ -166,3 +166,17 @@ def test_ring_flash_cli_still_rejected(tmp_path):
             "--sequence-parallel", "2", "--attention", "flash",
             "--checkpoint-dir", str(tmp_path),
         ]))
+
+
+def test_tp_flash_cli(tmp_path):
+    """--tensor-parallel 2 --attention flash end-to-end (sharded kernel)."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    s = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit",
+        "--tensor-parallel", "2", "--attention", "flash",
+        "--batch-size", "32", "--synthetic-train-size", "64",
+        "--synthetic-test-size", "32", "--seed", "0", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path), "--trainer-mode", "stepwise",
+    ]))
+    assert s["epochs_run"] == 1
