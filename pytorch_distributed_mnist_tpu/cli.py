@@ -386,10 +386,11 @@ def run(args, epoch_callback=None) -> dict:
                 f"architecture is embed -> N transformer blocks -> head); "
                 f"got --model {args.model}"
             )
-        if getattr(args, "optimizer_sharding", "none") != "none":
+        if getattr(args, "optimizer_sharding", "none") == "zero3":
             raise SystemExit(
-                "--pipeline-stages does not compose with "
-                "--optimizer-sharding yet"
+                "--pipeline-stages composes with --optimizer-sharding "
+                "zero1 (moments sharded stage x data); zero3 would "
+                "re-shard the stage-sharded params themselves"
             )
         if jax.device_count() % pp:
             raise SystemExit(
@@ -672,10 +673,23 @@ def run(args, epoch_callback=None) -> dict:
         from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
 
         # With --tensor-parallel, the TP rule table composes: TP-ruled
-        # leaves keep their layout, ZeRO claims the rest.
+        # leaves keep their layout, ZeRO claims the rest. With
+        # --pipeline-stages, the pipeline's sharding tree is the base:
+        # stage-sharded block moments gain a data axis on an unsharded
+        # dim (stage x data), embed/head moments shard over data alone.
+        if pp > 1 and process_count() > 1:
+            # The pipeline state is already committed stage-sharded
+            # across hosts; re-placing it onto the composed layout needs
+            # a cross-host reshard place_state does not perform.
+            raise SystemExit(
+                "--pipeline-stages with --optimizer-sharding is "
+                "single-host for now (multi-host would need a cross-host "
+                "reshard of the already-placed pipeline state)"
+            )
         state, state_sharding = shard_state_zero(
             state, mesh, rules=tp_rules,
             level=3 if zero == "zero3" else 1,
+            base_sharding=pp_sharding if pp > 1 else None,
         )
 
     train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)

@@ -62,6 +62,7 @@ def zero_state_sharding(
     data_axis: str = "data",
     rules: Optional[Dict[Tuple[str, str], P]] = None,
     level: int = 1,
+    base_sharding=None,
 ):
     """NamedSharding pytree for a TrainState with ZeRO-style sharding.
 
@@ -76,11 +77,48 @@ def zero_state_sharding(
     it matches keep the TP layout everywhere (params AND moments — TP
     moments must mirror their params), and ZeRO sharding applies to the
     remaining leaves only.
+
+    ``base_sharding`` is an alternative base: a full NamedSharding pytree
+    (e.g. the pipeline layout from ``parallel/pipeline_vit.py``, blocks
+    sharded on 'stage'). Unlike the conservative rules path, claimed
+    moment leaves get ``data_axis`` ADDED on their largest still-unsharded
+    divisible dimension — a stage-sharded block moment becomes
+    stage x data sharded, which is exactly the PP x ZeRO-1 partition.
+    Mutually exclusive with ``rules``.
     """
     if level not in (1, 3):
         raise ValueError(f"zero level must be 1 or 3, got {level}")
+    if rules and base_sharding is not None:
+        raise ValueError("pass rules or base_sharding, not both")
+    if level == 3 and base_sharding is not None:
+        # ZeRO-3 would add a data axis onto the base layout's params —
+        # e.g. re-sharding stage-sharded pipeline blocks, a layout no
+        # step program expects. Enforced here, not just in the CLI, so
+        # library callers hit the same wall.
+        raise ValueError(
+            "level=3 does not compose with base_sharding: the base "
+            "layout owns the param placement; use level=1"
+        )
     rules = rules or {}
     axis_size = mesh.shape[data_axis]
+
+    def claimed_spec(shape: Tuple[int, ...], base: P) -> NamedSharding:
+        return NamedSharding(mesh, _zero_spec(shape, axis_size, data_axis, base))
+
+    if base_sharding is not None:
+        def spec_from_base(path, leaf, base_ns):
+            base = base_ns.spec if hasattr(base_ns, "spec") else P()
+            claimed = _is_moment_path(path) or (
+                level == 3 and _is_param_path(path)
+            )
+            if not claimed:
+                return base_ns
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            return claimed_spec(shape, base)
+
+        return jax.tree_util.tree_map_with_path(
+            spec_from_base, state, base_sharding
+        )
 
     def spec_for(path, leaf):
         base = leaf_spec(path, rules)
@@ -92,7 +130,7 @@ def zero_state_sharding(
         shape = tuple(getattr(leaf, "shape", ()) or ())
         if base != P():
             return NamedSharding(mesh, base)  # TP-ruled leaf: keep layout
-        return NamedSharding(mesh, _zero_spec(shape, axis_size, data_axis, base))
+        return claimed_spec(shape, base)
 
     return jax.tree_util.tree_map_with_path(spec_for, state)
 
@@ -109,7 +147,7 @@ def zero1_state_sharding(
 
 def shard_state_zero(state, mesh: Mesh, data_axis: str = "data",
                      rules: Optional[Dict[Tuple[str, str], P]] = None,
-                     level: int = 1):
+                     level: int = 1, base_sharding=None):
     """Place a TrainState onto the mesh with ZeRO-``level`` sharding.
 
     Multi-host placement goes through ``parallel.mesh.place_state`` (each
@@ -118,7 +156,8 @@ def shard_state_zero(state, mesh: Mesh, data_axis: str = "data",
     """
     from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
 
-    sharding = zero_state_sharding(state, mesh, data_axis, rules, level)
+    sharding = zero_state_sharding(state, mesh, data_axis, rules, level,
+                                   base_sharding)
     return place_state(state, sharding), sharding
 
 

@@ -198,3 +198,72 @@ def test_pipelined_remat_same_loss_and_grads():
     for a, b in zip(jax.tree.leaves(outs[0][1]), jax.tree.leaves(outs[1][1])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_zero1_matches_pipeline_only():
+    """PP x ZeRO-1: stage-sharded block moments gain a data axis; the
+    training trajectory must equal the pipeline-only step."""
+    import numpy as np
+
+    from pytorch_distributed_mnist_tpu.models import get_model
+    from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+        create_pipelined_vit_state,
+    )
+    from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+    mesh = make_mesh(("data", "stage"), shape=(4, 2))
+    x = jax.random.normal(jax.random.key(0), (8, 28, 28, 1), jnp.float32)
+    y = jnp.arange(8, dtype=jnp.int32) % 10
+    batch = {"image": x, "label": y}
+
+    model = get_model("vit", compute_dtype=jnp.float32, depth=2)
+
+    def run_steps(with_zero):
+        state, sharding = create_pipelined_vit_state(
+            model, jax.random.key(1), mesh, data_axis="data")
+        if with_zero:
+            state, sharding = shard_state_zero(
+                state, mesh, base_sharding=sharding, level=1)
+        step = make_train_step(mesh, state_sharding=sharding)
+        for _ in range(2):
+            state, m = step(state, batch)
+        return state, m, sharding
+
+    s0, m0, _ = run_steps(False)
+    s1, m1, sh1 = run_steps(True)
+    np.testing.assert_allclose(float(m0.loss_sum), float(m1.loss_sum),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # the ZeRO layout actually sharded a stage-sharded block moment over
+    # data as well (stage x data), not just the replicated embed/head
+    specs = [s.spec for s in jax.tree.leaves(sh1.opt_state)]
+    assert any("stage" in str(sp) and "data" in str(sp) for sp in specs)
+
+
+def test_pipeline_zero1_cli(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    s = run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit",
+        "--pipeline-stages", "2", "--optimizer-sharding", "zero1",
+        "--batch-size", "32", "--synthetic-train-size", "64",
+        "--synthetic-test-size", "32", "--seed", "0", "--epochs", "1",
+        "--checkpoint-dir", str(tmp_path), "--trainer-mode", "stepwise",
+    ]))
+    assert s["epochs_run"] == 1
+
+
+def test_pipeline_zero3_rejected(tmp_path):
+    import pytest
+
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    with pytest.raises(SystemExit, match="zero1"):
+        run(build_parser().parse_args([
+            "--dataset", "synthetic", "--model", "vit",
+            "--pipeline-stages", "2", "--optimizer-sharding", "zero3",
+            "--checkpoint-dir", str(tmp_path),
+        ]))
