@@ -107,14 +107,17 @@ def zero_state_sharding(
 
     if base_sharding is not None:
         def spec_from_base(path, leaf, base_ns):
-            base = base_ns.spec if hasattr(base_ns, "spec") else P()
-            claimed = _is_moment_path(path) or (
-                level == 3 and _is_param_path(path)
-            )
-            if not claimed:
+            if not isinstance(base_ns, NamedSharding):
+                raise ValueError(
+                    f"base_sharding leaves must be NamedSharding, got "
+                    f"{type(base_ns).__name__} at {jax.tree_util.keystr(path)}"
+                )
+            # level 3 is rejected above: the base layout owns params, so
+            # only moment leaves are ever claimed here.
+            if not _is_moment_path(path):
                 return base_ns
             shape = tuple(getattr(leaf, "shape", ()) or ())
-            return claimed_spec(shape, base)
+            return claimed_spec(shape, base_ns.spec)
 
         return jax.tree_util.tree_map_with_path(
             spec_from_base, state, base_sharding

@@ -203,12 +203,6 @@ def test_pipelined_remat_same_loss_and_grads():
 def test_pipeline_zero1_matches_pipeline_only():
     """PP x ZeRO-1: stage-sharded block moments gain a data axis; the
     training trajectory must equal the pipeline-only step."""
-    import numpy as np
-
-    from pytorch_distributed_mnist_tpu.models import get_model
-    from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
-        create_pipelined_vit_state,
-    )
     from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
     from pytorch_distributed_mnist_tpu.train.steps import make_train_step
 
