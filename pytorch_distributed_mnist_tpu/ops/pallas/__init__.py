@@ -13,13 +13,32 @@ hand-written kernels cover the two places a fused kernel beats stock XLA:
 - ``flash_attention``: blockwise online-softmax attention that never
   materializes the (T, T) score matrix in HBM — the long-context hot op;
   same math as ``ops/attention.py``'s blockwise reference, tiled for the
-  MXU.
+  MXU. ``sharded_flash_attention`` embeds it in GSPMD programs
+  (batch x heads shard_map, the ``--tensor-parallel`` composition).
+- ``fused_cross_entropy``: single-pass softmax-xent forward (loss + lse
+  in VMEM) with a single-pass backward from the saved lse
+  (``--loss fused``; ``ops/loss.py`` embeds it in GSPMD via a nested
+  shard_map over the data axis).
 
 Every kernel auto-selects interpret mode off-TPU so the whole suite runs
 hermetically on the virtual CPU mesh (tests/conftest.py).
 """
 
 from pytorch_distributed_mnist_tpu.ops.pallas.adam import fused_adam_leaf, pallas_adam
-from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+from pytorch_distributed_mnist_tpu.ops.pallas.flash import (
+    flash_attention,
+    sharded_flash_attention,
+)
+from pytorch_distributed_mnist_tpu.ops.pallas.xent import (
+    fused_cross_entropy,
+    fused_cross_entropy_per_example,
+)
 
-__all__ = ["fused_adam_leaf", "pallas_adam", "flash_attention"]
+__all__ = [
+    "fused_adam_leaf",
+    "pallas_adam",
+    "flash_attention",
+    "sharded_flash_attention",
+    "fused_cross_entropy",
+    "fused_cross_entropy_per_example",
+]
