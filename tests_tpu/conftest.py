@@ -16,8 +16,13 @@ import sys
 import pytest
 
 _PROBE = (
+    # Listing devices is not enough: a wedged tunnel can enumerate the
+    # chip while every execution hangs (observed 2026-07-30). The probe
+    # must round-trip a real computation.
     "import jax; assert jax.default_backend() == 'tpu' or any("
-    "d.platform == 'tpu' for d in jax.devices())"
+    "d.platform == 'tpu' for d in jax.devices()); "
+    "import jax.numpy as jnp; "
+    "assert float(jnp.sum(jnp.ones((8, 8)))) == 64.0"
 )
 
 
