@@ -14,9 +14,17 @@ for the TPU runtime:
   ``jax.distributed.initialize`` — auto-detected on TPU pods, so none are
   needed in the common case. There is no mode selection by editing source
   (the reference's spawn-vs-launch comment dance, ``:353-359``);
-- new flags: ``--model`` (the reference hard-codes its model at ``:185``),
-  ``--dataset`` (hard-coded MNIST at ``:137``; BASELINE config 5 needs
-  FashionMNIST), ``--trainer-mode``, ``--profile-dir``, ``--checkpoint-dir``.
+- new flags beyond the reference's surface: ``--model`` (hard-coded at
+  ``:185``) / ``--dataset`` (hard-coded MNIST at ``:137``) / ``--dtype`` /
+  ``--trainer-mode`` / ``--profile-dir`` / ``--checkpoint-dir``;
+  launch: ``--spawn N`` (the ``mp.spawn`` mode as a flag, ``:284-285``);
+  kernels: ``--optimizer adam_pallas``, ``--loss fused``,
+  ``--attention flash``; parallelism: ``--tensor-parallel``,
+  ``--sequence-parallel[-impl]``, ``--pipeline-stages``,
+  ``--optimizer-sharding zero1|zero3``, ``--grad-accum``, ``--remat``;
+  checkpoint lifecycle: ``--resume auto``, ``--keep-last``,
+  ``--async-checkpoint``; observability: ``--metrics-file``,
+  ``--debug-nans``.
 
 Batch-size semantics: the reference's ``--batch-size`` is the per-node total
 divided among that node's GPUs (``:174``, ``:297-300``). Here it is the
