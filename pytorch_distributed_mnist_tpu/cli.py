@@ -138,9 +138,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "GSPMD-partitionable, default) or fused (the "
                         "Pallas single-pass kernel, ops/pallas/xent.py, "
                         "embedded in GSPMD programs via a nested "
-                        "shard_map over the data axis; pure-DP meshes "
-                        "only — TP/SP/PP logits layouts are "
-                        "model-dependent)")
+                        "shard_map over the data axis; composes with "
+                        "DP/TP/SP but not --pipeline-stages)")
     p.add_argument("--pipeline-stages", type=int, default=1,
                    help="pipeline-parallel stages for --model vit (GPipe "
                         "over a 'stage' mesh axis; devices are split "
@@ -481,16 +480,17 @@ def run(args, epoch_callback=None) -> dict:
 
     loss_impl = getattr(args, "loss", "xla")
     if loss_impl == "fused":
-        if pp > 1 or tp > 1 or sp > 1:
+        if pp > 1:
             raise SystemExit(
-                "--loss fused supports the pure data-parallel mesh: with "
-                "TP/SP/PP axes the logits layout is model-dependent and "
-                "the kernel's nested shard_map would mis-shard it; use "
-                "--loss xla there"
+                "--loss fused does not compose with --pipeline-stages: "
+                "the loss consumes the pipeline's psum-gathered output "
+                "inside its own collective program; use --loss xla"
             )
         # GSPMD modes get the mesh so the kernel runs per-device on local
-        # batch shards via a nested shard_map; the explicit mode is
-        # already inside a shard_map (no nesting over the same axis).
+        # batch shards via a nested shard_map (P('data') in_specs force a
+        # batch-sharded, model/seq-replicated layout, valid on TP/SP
+        # meshes too); the explicit mode is already inside a shard_map
+        # (no nesting over the same axis).
         set_loss_impl(
             "fused",
             mesh=mesh if args.trainer_mode != "explicit" else None,

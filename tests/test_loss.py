@@ -181,15 +181,45 @@ def test_fused_loss_gspmd_multidevice_matches_xla(tmp_path):
             s_xla["history"][0]["test_acc"], rtol=1e-6)
 
 
-def test_fused_loss_rejected_on_tp_mesh(tmp_path):
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("axis_flag", [
+    ("--tensor-parallel", "2"),
+    ("--sequence-parallel", "2"),
+])
+def test_fused_loss_on_tp_sp_mesh_matches_xla(tmp_path, axis_flag):
+    """--loss fused on TP and SP meshes: the nested shard_map's P('data')
+    specs force a batch-sharded, axis-replicated layout — trajectory
+    equal to the XLA impl."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    common = [
+        "--dataset", "synthetic", "--model", "vit", "--dtype", "f32",
+        "--patch-size", "7", *axis_flag,
+        "--batch-size", "32", "--synthetic-train-size", "64",
+        "--synthetic-test-size", "32", "--seed", "0", "--epochs", "1",
+        "--trainer-mode", "stepwise",
+    ]
+    s_xla = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "a")]))
+    s_fused = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "b"),
+                  "--loss", "fused"]))
+    np.testing.assert_allclose(
+        s_fused["history"][0]["train_loss"],
+        s_xla["history"][0]["train_loss"], rtol=1e-5)
+
+
+def test_fused_loss_rejected_on_pp_mesh(tmp_path):
     import pytest
 
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 
-    with pytest.raises(SystemExit, match="pure data-parallel"):
+    with pytest.raises(SystemExit, match="pipeline"):
         run(build_parser().parse_args([
             "--dataset", "synthetic", "--model", "vit",
-            "--tensor-parallel", "2", "--loss", "fused",
+            "--pipeline-stages", "2", "--loss", "fused",
             "--checkpoint-dir", str(tmp_path),
         ]))
 
