@@ -125,3 +125,23 @@ def test_metrics_file(tmp_path):
         for key in ("train_loss", "test_acc", "lr", "best_acc",
                     "images_per_sec"):
             assert key in row
+
+
+def test_compile_cache_populated(tmp_path):
+    """--compile-cache DIR: the persistent XLA cache receives entries, and
+    a second identical run still trains correctly while reading from it."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    cache = tmp_path / "xla_cache"
+    common = [
+        "--dataset", "synthetic", "--model", "linear",
+        "--batch-size", "64", "--synthetic-train-size", "128",
+        "--synthetic-test-size", "64", "--seed", "0", "--epochs", "1",
+        "--trainer-mode", "stepwise", "--compile-cache", str(cache),
+    ]
+    s1 = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "a")]))
+    assert cache.is_dir() and len(list(cache.iterdir())) > 0
+    s2 = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "b")]))
+    assert s2["history"][0]["train_loss"] == s1["history"][0]["train_loss"]

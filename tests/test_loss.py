@@ -3,6 +3,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
 
@@ -119,8 +120,6 @@ def test_fused_xent_masked_mean_matches():
 
 
 def test_fused_xent_too_many_classes_raises():
-    import pytest
-
     from pytorch_distributed_mnist_tpu.ops.pallas.xent import (
         fused_cross_entropy,
     )
@@ -181,10 +180,7 @@ def test_fused_loss_gspmd_multidevice_matches_xla(tmp_path):
             s_xla["history"][0]["test_acc"], rtol=1e-6)
 
 
-import pytest as _pytest
-
-
-@_pytest.mark.parametrize("axis_flag", [
+@pytest.mark.parametrize("axis_flag", [
     ("--tensor-parallel", "2"),
     ("--sequence-parallel", "2"),
 ])
@@ -212,8 +208,6 @@ def test_fused_loss_on_tp_sp_mesh_matches_xla(tmp_path, axis_flag):
 
 
 def test_fused_loss_rejected_on_pp_mesh(tmp_path):
-    import pytest
-
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 
     with pytest.raises(SystemExit, match="pipeline"):

@@ -47,6 +47,10 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-train-size", type=int, default=60000)
     p.add_argument("--synthetic-test-size", type=int, default=10000)
+    p.add_argument("--compile-cache", type=str, default=None,
+                   help="persistent XLA compile cache dir (forwarded to "
+                        "the CLI): a repeat measurement skips the compile "
+                        "seconds that dominate short runs")
     args = p.parse_args()
 
     t0 = time.perf_counter()
@@ -71,6 +75,8 @@ def main() -> None:
     ]
     if args.download:
         cli_args.append("--download")
+    if args.compile_cache:
+        cli_args += ["--compile-cache", args.compile_cache]
     ns = build_parser().parse_args(cli_args)
 
     epoch_log = []

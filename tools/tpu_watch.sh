@@ -12,6 +12,7 @@ while true; do
     BENCH_RC=$?
     timeout 1800 python /root/repo/tools/northstar.py \
       --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
+      --compile-cache /tmp/ns_xla_cache \
       --root /tmp/ns_tpu > "$OUT/northstar.json" 2>> "$OUT/watch.log"
     NS_RC=$?
     echo "$(date -u +%FT%TZ) capture done bench_rc=$BENCH_RC northstar_rc=$NS_RC" >> "$OUT/watch.log"
