@@ -27,7 +27,6 @@ mesh: the batch stays sharded on ``data`` while stages ride ``stage``.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax
