@@ -237,3 +237,25 @@ def test_fused_loss_ragged_batch_falls_back_statically():
     set_loss_impl("fused", mesh=make_mesh(("data",)))
     got = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_fused_loss_grad_accum_scan_matches_xla(tmp_path):
+    """fused loss inside the grad-accum micro-batch scan inside the epoch
+    scan — the deepest nesting the trainer produces — equals the XLA impl
+    exactly (f32)."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    common = [
+        "--dataset", "synthetic", "--model", "linear", "--dtype", "f32",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0", "--epochs", "1",
+        "--trainer-mode", "scan", "--grad-accum", "2",
+    ]
+    a = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "a")]))
+    b = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "b"), "--loss",
+                  "fused"]))
+    np.testing.assert_allclose(
+        a["history"][0]["train_loss"], b["history"][0]["train_loss"],
+        rtol=1e-6)
