@@ -322,3 +322,19 @@ def test_preemption_kill_and_auto_resume(tmp_path):
     assert "Epoch: 0/6" not in done.stdout
     names = set(os.listdir(ckpt))
     assert {f"checkpoint_{e}.npz" for e in range(6)}.issubset(names)
+
+
+@pytest.mark.slow
+def test_spawn_launcher_propagates_child_failure(capfd):
+    """A failing rank must fail the launch (nonzero exit) and surface the
+    failed child's output, not report success."""
+    from pytorch_distributed_mnist_tpu.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        # --patch-size 5 parses fine in the parent (int) but every child's
+        # run() rejects it (28 % 5 != 0) — a genuine in-child failure.
+        main(["--spawn", "2", "--dataset", "synthetic", "--model", "vit",
+              "--patch-size", "5"])
+    assert exc.value.code not in (0, None)
+    err = capfd.readouterr().err
+    assert "spawned process 1 failed" in err  # non-rank-0 log replayed
