@@ -18,11 +18,27 @@ of its own, see BASELINE.md). The ``baseline`` field names this so the ratio
 is not mistaken for a like-for-like chip comparison.
 
 Robustness (round-1 postmortem: BENCH_r01.json was rc=1/parsed=null because
-one TPU-init failure escaped as a traceback): the accelerator bench runs in
-a CHILD process with a timeout, retried with backoff; on persistent TPU
-failure it falls back to a CPU-backend run (honestly labelled
-``"backend": "cpu"`` with the TPU error attached); if even that fails the
-parent still exits 0 with an ``{"error": ...}`` JSON line.
+one TPU-init failure escaped as a traceback; round-2: both TPU children
+timed out compiling from scratch against a wedged chip link and the round's
+artifact ended up CPU-only): the accelerator bench runs in a CHILD process
+with a timeout and a three-level degradation ladder —
+
+1. a cheap PROBE child first (per-step jit, batch 256 — seconds of compile,
+   not minutes), then the full 50-step scan bench; if the scan fails but
+   the probe produced a number, the probe's throughput is reported with
+   ``"mode": "probe"`` so a half-healthy link still yields a TPU number;
+2. every child shares a persistent XLA compilation cache
+   (``BENCH_COMPILE_CACHE``, default ``<repo>/.xla_cache`` — the same dir
+   ``tools/tpu_watch.sh`` pre-warms), so a recovered chip skips the
+   compile minutes that blew round 2's timeouts;
+3. if no live TPU attempt succeeds, the freshest watcher capture
+   (``tools/captured/bench.json``, written by ``tools/tpu_watch.sh`` the
+   moment the chip answers mid-session) is emitted with its capture
+   timestamp and ``"source": "watcher_capture"`` — a mid-session TPU
+   measurement becomes end-of-round evidence automatically;
+4. only then the CPU-backend fallback (honestly labelled
+   ``"backend": "cpu"`` with the TPU errors attached); if even that fails
+   the parent still exits 0 with an ``{"error": ...}`` JSON line.
 """
 
 from __future__ import annotations
@@ -66,18 +82,41 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def child_bench(steps: int, reps: int) -> dict:
-    """Run the accelerator bench on whatever backend the env selects."""
+def configure_jax(jax_module, force_cpu: bool = False) -> None:
+    """Shared jax prologue for every bench entry point (this file's
+    children and tools/bench_kernels.py): honor an explicit CPU request
+    despite accelerator plugins that force-write ``jax_platforms`` on
+    import (same workaround as tests/conftest.py), and enable the
+    persistent compile cache shared with tools/tpu_watch.sh — a chip that
+    recovered mid-session already has that cache warm, so the driver's
+    end-of-round run spends its timeout measuring, not compiling
+    (round-2 postmortem).
+    """
+    if force_cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax_module.config.update("jax_platforms", "cpu")
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE")
+    if cache_dir:
+        jax_module.config.update("jax_compilation_cache_dir", cache_dir)
+        jax_module.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def child_bench(steps: int, reps: int, probe: bool = False) -> dict:
+    """Run the accelerator bench on whatever backend the env selects.
+
+    ``probe`` selects the cheap path: small batch, per-step jit (a program
+    that compiles in seconds), no fused-kernel secondary — the canary that
+    tells a flaky chip link apart from a dead one and still produces an
+    honest throughput number when the full scan bench can't finish.
+    """
     if os.environ.get("BENCH_FORCE_CPU"):
-        # Some accelerator plugins force-write jax_platforms at import time,
-        # so both the env var (before import) and the config API (after) are
-        # needed — same workaround as tests/conftest.py.
+        # The env var must be set before jax imports; the config write-back
+        # in configure_jax handles plugins that override it at import.
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
 
-    if os.environ.get("BENCH_FORCE_CPU"):
-        jax.config.update("jax_platforms", "cpu")
+    configure_jax(jax, force_cpu=bool(os.environ.get("BENCH_FORCE_CPU")))
 
     import jax.numpy as jnp
     import numpy as np
@@ -97,12 +136,19 @@ def child_bench(steps: int, reps: int) -> dict:
     n_chips = jax.device_count()
     device = jax.devices()[0]
     mesh = make_mesh(("data",)) if n_chips > 1 else None
+    # Stepwise = time the per-batch jitted step instead of the scan epoch:
+    # the CPU fallback needs it (XLA:CPU pessimizes convs inside scanned
+    # while-bodies ~30x), and the probe wants it (seconds of compile).
+    stepwise = device.platform == "cpu" or probe
     if device.platform == "cpu":
         # Fallback mode: bf16 conv is emulated (and awful) on CPU; use f32
         # and a smaller batch so the fallback finishes in seconds, not
         # minutes. The TPU path keeps the bf16 MXU configuration.
         batch = 256
         model = get_model("cnn", compute_dtype=jnp.float32)
+    elif probe:
+        batch = 256
+        model = get_model("cnn")
     else:
         batch = BATCH
         model = get_model("cnn")
@@ -116,12 +162,10 @@ def child_bench(steps: int, reps: int) -> dict:
         "label": jnp.broadcast_to(y, (steps,) + y.shape),
     }
 
-    if device.platform == "cpu":
-        # XLA:CPU compiles convolutions inside the scanned while-loop body
-        # to a far slower code path than top-level convs (~30x observed), so
-        # the fallback times the per-batch jitted step instead. On TPU the
-        # scan epoch is the whole point: one device program per epoch, no
-        # host round-trips through the tunnel.
+    if stepwise:
+        # On TPU the scan epoch is the whole point: one device program per
+        # epoch, no host round-trips through the tunnel. The stepwise path
+        # exists for the CPU fallback and the probe (see above).
         one = {"image": jnp.asarray(x), "label": jnp.asarray(y)}
         step_fn = make_train_step(mesh)
 
@@ -169,7 +213,7 @@ def child_bench(steps: int, reps: int) -> dict:
             t_best = min(t_best, time.perf_counter() - t0)
         return st, t_best
 
-    expected = batch * (1 if device.platform == "cpu" else steps)
+    expected = batch * (1 if stepwise else steps)
     state, best = warmup_and_time(run_pass, state, expected)
 
     steps_per_sec = steps / best
@@ -187,8 +231,11 @@ def child_bench(steps: int, reps: int) -> dict:
         "peak_flops_per_chip": peak,
         "mfu": mfu,
     }
+    if probe:
+        result["mode"] = "probe"
 
-    if device.platform != "cpu" and not os.environ.get("BENCH_SKIP_FUSED"):
+    if device.platform != "cpu" and not probe \
+            and not os.environ.get("BENCH_SKIP_FUSED"):
         # Secondary measurement: the all-first-party-kernel path (Pallas
         # fused cross-entropy + fused Adam). Extra fields only — any
         # failure here is recorded and cannot harm the primary number.
@@ -204,7 +251,7 @@ def child_bench(steps: int, reps: int) -> dict:
                     model, jax.random.key(0), optimizer="adam_pallas")
                 epoch_f = make_train_epoch(mesh)
                 state_f, best_f = warmup_and_time(
-                    epoch_f, state_f, batch * steps)
+                    lambda st: epoch_f(st, batches), state_f, batch * steps)
                 result["images_per_sec_per_chip_fused_kernels"] = (
                     batch * steps / best_f / n_chips)
             finally:
@@ -243,10 +290,80 @@ def _run_child(env_extra: dict, steps: int, reps: int, timeout: float):
     return None, f"rc={proc.returncode}: " + " | ".join(tail)
 
 
+def _load_watcher_capture() -> dict | None:
+    """Freshest mid-session TPU capture from tools/tpu_watch.sh, if any.
+
+    The watcher polls the flaky chip link all session and runs this very
+    benchmark the moment the chip answers; its output (the full formatted
+    JSON line) is the round's evidence when the end-of-round live attempt
+    hits a wedged link again. Only a capture that actually ran on TPU
+    qualifies — a CPU-fallback capture is no better than a live CPU run.
+    """
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if "BENCH_CAPTURE_PATH" in os.environ:
+        path = os.environ["BENCH_CAPTURE_PATH"]
+        if not path:  # empty = fallback disabled (tpu_watch.sh sets this so
+            return None  # bench.py can never re-emit the watcher's own file)
+    else:
+        path = os.path.join(repo, "tools", "captured", "bench.json")
+    try:
+        with open(path) as f:
+            captured = json.loads(f.read().strip().splitlines()[-1])
+        mtime = os.path.getmtime(path)
+    except (OSError, IndexError, json.JSONDecodeError):
+        return None
+    if not isinstance(captured, dict):  # e.g. a truncated write leaving
+        return None                     # 'null' — still valid JSON
+    if captured.get("backend") != "tpu" or not captured.get("value"):
+        return None
+    # Freshness: only a capture from THIS round is evidence. The round
+    # boundary markers are the driver's own artifacts (VERDICT.md /
+    # BENCH_r*.json, written at round start); a stale capture restored by
+    # git checkout shares their checkout mtime, while a live watcher write
+    # during the session is strictly newer. Round 1 (no markers) accepts
+    # any capture. BENCH_CAPTURE_PATH set => caller controls provenance
+    # explicitly (tests), skip the bound.
+    if "BENCH_CAPTURE_PATH" not in os.environ:
+        import glob
+        markers = glob.glob(os.path.join(repo, "BENCH_r*.json"))
+        markers += [p for p in (os.path.join(repo, "VERDICT.md"),)
+                    if os.path.exists(p)]
+        marker_mtime = max(
+            (os.path.getmtime(m) for m in markers if os.path.exists(m)),
+            default=0.0)
+        if mtime <= marker_mtime + 60.0:
+            return None
+    captured["source"] = "watcher_capture"
+    if "measured_at" not in captured:
+        # Legacy capture without an embedded measurement time; file mtime
+        # is the best remaining provenance (weaker: a rewrite or git
+        # checkout restamps it, which is why new lines embed measured_at).
+        captured["capture_timestamp"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+    return captured
+
+
 def bench_accelerator() -> dict:
-    """TPU child with retry/backoff; CPU-backend fallback; never raises."""
+    """Probe -> scan -> watcher capture -> CPU fallback; never raises."""
+    os.environ.setdefault(
+        "BENCH_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".xla_cache"))
     errors = []
-    timeouts = (480.0, 720.0)
+
+    # Level 1: cheap probe — small batch, per-step jit, seconds of compile.
+    # Tells a dead link apart from a slow one, and its number stands in if
+    # the scan bench can't finish.
+    probe, err = _run_child({"BENCH_PROBE": "1"}, steps=8, reps=2,
+                            timeout=360.0)
+    if probe is None:
+        errors.append(f"tpu probe: {err}")
+
+    # Level 2: the real measurement — 50-step scan epoch. A live probe
+    # means the link is up and the compile cache is warming, so it earns a
+    # retry; a dead probe gets one shot in case the probe failure was
+    # program-specific.
+    timeouts = (600.0, 720.0) if probe else (480.0,)
     for attempt, timeout in enumerate(timeouts):
         result, err = _run_child({}, steps=50, reps=3, timeout=timeout)
         if result:
@@ -254,9 +371,21 @@ def bench_accelerator() -> dict:
         errors.append(f"tpu attempt {attempt + 1}: {err}")
         if attempt + 1 < len(timeouts):  # backoff only between retries
             time.sleep(15 * (attempt + 1))
-    # This environment has a single host core; keep the CPU fallback tiny so
-    # it finishes inside the timeout (it exists to produce an honest number,
-    # not a fast one).
+
+    if probe:
+        probe["tpu_error"] = "; ".join(errors)
+        return probe
+
+    # Level 3: a mid-session watcher capture is real TPU evidence; emit it
+    # (timestamped, labelled) rather than degrade to CPU.
+    captured = _load_watcher_capture()
+    if captured is not None:
+        return {"ok": True, "captured": captured,
+                "live_errors": "; ".join(errors)}
+
+    # Level 4: CPU. This environment has a single host core; keep the CPU
+    # fallback tiny so it finishes inside the timeout (it exists to produce
+    # an honest number, not a fast one).
     result, err = _run_child(
         {"BENCH_FORCE_CPU": "1"}, steps=4, reps=2, timeout=900.0
     )
@@ -313,6 +442,14 @@ def bench_torch_reference() -> float:
 
 def main() -> None:
     result = bench_accelerator()
+    if result.get("captured"):
+        # Watcher capture: already a fully formatted output line (baseline
+        # ratio computed at capture time); pass it through with the live
+        # failure attached so the provenance is auditable.
+        out = result["captured"]
+        out["tpu_error_live"] = result.get("live_errors")
+        print(json.dumps(out))
+        return
     try:
         baseline = bench_torch_reference()
     except Exception as exc:  # noqa: BLE001 - bench must always emit JSON
@@ -332,7 +469,7 @@ def main() -> None:
         out["mfu"] = round(mfu, 4) if mfu is not None else None
         for key in ("backend", "device_kind", "n_chips", "global_batch",
                     "steps_per_sec", "flops_per_step", "peak_flops_per_chip",
-                    "tpu_error", "notes"):
+                    "mode", "tpu_error", "notes"):
             if result.get(key) is not None:
                 val = result[key]
                 out[key] = round(val, 2) if isinstance(val, float) else val
@@ -342,6 +479,10 @@ def main() -> None:
         out["error"] = result.get("error", "unknown failure")
     if baseline > 0:
         out["baseline_images_per_sec"] = round(baseline, 1)
+    # Measurement provenance travels inside the line itself so a later
+    # re-emission (watcher-capture fallback) can never restamp it.
+    out["measured_at"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     print(json.dumps(out))
 
 
@@ -350,7 +491,8 @@ if __name__ == "__main__":
         steps = int(sys.argv[2]) if len(sys.argv) > 2 else 50
         reps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
         try:
-            print(json.dumps(child_bench(steps, reps)))
+            print(json.dumps(child_bench(
+                steps, reps, probe=bool(os.environ.get("BENCH_PROBE")))))
         except Exception as exc:  # noqa: BLE001 - parent parses this
             print(json.dumps({"ok": False, "error": repr(exc)}))
             sys.exit(1)

@@ -1,0 +1,167 @@
+"""bench.py degradation-ladder units (hermetic, CPU).
+
+Round-2 postmortem: both live TPU bench attempts timed out against a wedged
+chip link and the round's perf artifact degraded to CPU even though a valid
+mid-session TPU capture existed. These tests pin the ladder pieces that fix
+that: the watcher-capture fallback, the probe child's stepwise path, and
+the compile-cache plumbing — all without any accelerator.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def _write_capture(tmp_path, payload):
+    path = tmp_path / "bench.json"
+    path.write_text(json.dumps(payload) + "\n")
+    return str(path)
+
+
+def test_watcher_capture_accepted(tmp_path, monkeypatch):
+    payload = {"metric": "mnist_cnn_train_images_per_sec_per_chip",
+               "value": 377686.0, "unit": "images/sec/chip",
+               "vs_baseline": 774.0, "backend": "tpu",
+               "device_kind": "TPU v5 lite"}
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", _write_capture(tmp_path, payload))
+    cap = bench._load_watcher_capture()
+    assert cap is not None
+    assert cap["source"] == "watcher_capture"
+    assert cap["value"] == 377686.0
+    # Legacy capture without embedded measured_at: file mtime stands in.
+    assert cap["capture_timestamp"].endswith("Z")
+
+
+def test_watcher_capture_prefers_embedded_timestamp(tmp_path, monkeypatch):
+    """A capture that embeds measured_at keeps it — a git checkout or
+    rewrite restamps mtime, so the embedded time is the real provenance."""
+    payload = {"value": 1.0, "backend": "tpu",
+               "measured_at": "2026-07-29T12:00:00Z"}
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", _write_capture(tmp_path, payload))
+    cap = bench._load_watcher_capture()
+    assert cap["measured_at"] == "2026-07-29T12:00:00Z"
+    assert "capture_timestamp" not in cap
+
+
+@pytest.mark.parametrize("payload", [
+    {"backend": "cpu", "value": 268.6},   # CPU capture is not TPU evidence
+    {"backend": "tpu", "value": 0.0},     # zero value means a failed run
+    {"backend": "tpu"},                   # no value at all
+])
+def test_watcher_capture_rejected(tmp_path, monkeypatch, payload):
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", _write_capture(tmp_path, payload))
+    assert bench._load_watcher_capture() is None
+
+
+def test_watcher_capture_non_dict_rejected(tmp_path, monkeypatch):
+    """'null' is valid JSON but not a capture; must return None, not raise
+    (bench_accelerator's contract is 'never raises')."""
+    path = tmp_path / "bench.json"
+    path.write_text("null\n")
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", str(path))
+    assert bench._load_watcher_capture() is None
+
+
+def test_empty_capture_path_disables_fallback(tmp_path, monkeypatch):
+    """tpu_watch.sh sets BENCH_CAPTURE_PATH= so bench.py can never re-emit
+    the watcher's own prior output as a fresh capture."""
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", "")
+    assert bench._load_watcher_capture() is None
+
+
+def test_capture_freshness_bound(tmp_path, monkeypatch):
+    """Default-path captures older than the round's driver artifacts
+    (VERDICT.md / BENCH_r*.json mtimes) are stale — a git checkout restores
+    last round's committed capture with checkout-time mtime, and it must
+    not become this round's evidence."""
+    import shutil
+
+    fake_repo = tmp_path / "repo"
+    (fake_repo / "tools" / "captured").mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "bench.py"), fake_repo / "bench.py")
+    monkeypatch.setattr(bench, "__file__", str(fake_repo / "bench.py"))
+    monkeypatch.delenv("BENCH_CAPTURE_PATH", raising=False)
+
+    cap_path = fake_repo / "tools" / "captured" / "bench.json"
+    cap_path.write_text(json.dumps({"backend": "tpu", "value": 9.0}) + "\n")
+    marker = fake_repo / "VERDICT.md"
+    marker.write_text("round marker\n")
+
+    now = os.path.getmtime(cap_path)
+    # Stale: capture and marker share the checkout mtime.
+    os.utime(marker, (now, now))
+    assert bench._load_watcher_capture() is None
+    # Fresh: watcher wrote the capture well after the round started.
+    os.utime(cap_path, (now + 3600, now + 3600))
+    cap = bench._load_watcher_capture()
+    assert cap is not None and cap["value"] == 9.0
+
+
+def test_watcher_capture_missing_or_garbage(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", str(tmp_path / "absent.json"))
+    assert bench._load_watcher_capture() is None
+    path = tmp_path / "bench.json"
+    path.write_text("not json at all\n")
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", str(path))
+    assert bench._load_watcher_capture() is None
+
+
+def test_main_emits_watcher_capture(tmp_path, monkeypatch, capsys):
+    """When live attempts fail, main() prints the capture verbatim with the
+    live errors attached — the driver's BENCH_r{N}.json then carries the
+    TPU evidence automatically."""
+    payload = {"metric": "mnist_cnn_train_images_per_sec_per_chip",
+               "value": 1234.5, "vs_baseline": 2.5, "backend": "tpu"}
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", _write_capture(tmp_path, payload))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda env, steps, reps, timeout: (None, "simulated dead link"))
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 1234.5
+    assert out["source"] == "watcher_capture"
+    assert "simulated dead link" in out["tpu_error_live"]
+    assert out["backend"] == "tpu"
+
+
+def test_probe_child_stepwise_cpu():
+    """The probe path end-to-end in a real child process on CPU: it must
+    produce a throughput number with mode=probe in well under the 360s the
+    parent allows it on TPU."""
+    env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_PROBE="1",
+               BENCH_COMPILE_CACHE="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child", "2", "1"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    line = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")][-1]
+    result = json.loads(line)
+    assert result["ok"], result
+    assert result["mode"] == "probe"
+    assert result["images_per_sec_per_chip"] > 0
+
+
+def test_compile_cache_config_plumbing(tmp_path):
+    """BENCH_COMPILE_CACHE reaches jax_compilation_cache_dir in the child."""
+    env = dict(os.environ, BENCH_FORCE_CPU="1",
+               BENCH_COMPILE_CACHE=str(tmp_path / "cache"))
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import sys; sys.path.insert(0, %r)\n"
+        "from bench import child_bench\n"
+        "# invoke only the cache-config prologue cheaply: run a 1-step probe\n"
+        "r = child_bench(1, 1, probe=True)\n"
+        "print('CACHE=' + jax.config.jax_compilation_cache_dir)\n"
+        % REPO)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"CACHE={tmp_path / 'cache'}" in proc.stdout

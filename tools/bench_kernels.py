@@ -1,0 +1,173 @@
+"""MXU-bound kernel benchmarks: flash vs dense attention, fused Adam vs optax.
+
+The CNN headline bench (bench.py) is HBM-bound at 1.9 MFLOP/image — its MFU
+is a rounding error by construction and says nothing about the Pallas
+kernels. This runner measures the kernels on workloads where the MXU is the
+bottleneck, answering the only question that matters for them: do the
+first-party kernels beat (or match) XLA's own lowering?
+
+- Attention: ``ops.pallas.flash.flash_attention`` vs the dense XLA path
+  (``ops.attention.full_attention``) at T in {256, 1024, 4096}, fwd+bwd
+  (the training configuration), constant token budget so every row fits
+  HBM. Reports per-config times, speedup, and analytic-FLOPs MFU.
+- Optimizer: ``ops.pallas.adam.pallas_adam`` vs ``optax.adam`` on a ~13M
+  parameter pytree (transformer-block-shaped leaves), update step only.
+
+Prints ONE JSON line. Runs standalone on whatever backend is up (the
+watcher invokes it on TPU after a successful bench capture); ``--quick``
+shrinks shapes for the hermetic CPU smoke test (flash falls back to
+interpret mode off-TPU, so only correctness-of-the-harness is asserted
+there, never perf).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, args, reps: int, iters: int) -> float:
+    """Seconds per call: warmup (compile) then best-of-``reps`` means."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def bench_attention(quick: bool, reps: int, iters: int) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _peak_flops
+    from pytorch_distributed_mnist_tpu.ops.attention import full_attention
+    from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+
+    # Constant ~8k-token budget: T grows, B shrinks, HBM footprint stays
+    # bounded (the dense path still materializes (B,H,T,T) f32 scores —
+    # 0.5 GB at the 4k row, the largest tensor in this file).
+    configs = [(64, 2), (128, 1)] if quick else [(256, 32), (1024, 8), (4096, 2)]
+    heads, dim = (2, 64) if quick else (8, 128)
+    peak = _peak_flops(jax.devices()[0].device_kind)
+
+    def make_loss(attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    flash_g = make_loss(flash_attention)
+    dense_g = make_loss(full_attention)
+
+    rows = []
+    for t, b in configs:
+        key = jax.random.key(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (b, t, heads, dim)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        flash_s = _timeit(flash_g, (q, k, v), reps, iters)
+        dense_s = _timeit(dense_g, (q, k, v), reps, iters)
+        # Analytic matmul FLOPs: fwd QK^T + PV = 4*B*H*T^2*D; bwd recomputes
+        # scores and forms dV, dP, dQ, dK — 4 more T^2 matmuls plus the
+        # recompute = ~12*B*H*T^2*D total for fwd+bwd.
+        flops = 12.0 * b * heads * t * t * dim
+        rows.append({
+            "seq_len": t, "batch": b, "heads": heads, "head_dim": dim,
+            "flash_ms": round(flash_s * 1e3, 3),
+            "dense_ms": round(dense_s * 1e3, 3),
+            "flash_over_dense_speedup": round(dense_s / flash_s, 3),
+            "flash_mfu": round(flops / flash_s / peak, 4) if peak else None,
+            "dense_mfu": round(flops / dense_s / peak, 4) if peak else None,
+        })
+    return rows
+
+
+def bench_adam(quick: bool, reps: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pytorch_distributed_mnist_tpu.ops.pallas.adam import pallas_adam
+
+    # Transformer-block-shaped leaves, ~13.6M params (>=10M per VERDICT):
+    # one big square projection, an MLP up/down pair, and small vectors so
+    # the kernel's ragged-tail path is exercised too.
+    shapes = ([(256, 256), (256, 512), (512, 256), (256,)] if quick else
+              [(3072, 3072), (3072, 680), (680, 3072), (3072,), (680,)])
+    key = jax.random.key(1)
+    params = {}
+    grads = {}
+    for i, s in enumerate(shapes):
+        key, k1, k2 = jax.random.split(key, 3)
+        params[f"w{i}"] = jax.random.normal(k1, s, jnp.float32) * 0.02
+        grads[f"w{i}"] = jax.random.normal(k2, s, jnp.float32)
+    n_params = sum(int(jnp.size(p)) for p in params.values())
+
+    def step_time(tx):
+        state = tx.init(params)
+
+        @jax.jit
+        def step(state, grads, params):
+            updates, state = tx.update(grads, state, params)
+            return optax.apply_updates(params, updates), state
+
+        return _timeit(step, (state, grads, params), reps, iters)
+
+    optax_s = step_time(optax.adam(1e-3))
+    fused_s = step_time(pallas_adam(1e-3))
+    return {
+        "n_params": n_params,
+        "optax_ms": round(optax_s * 1e3, 3),
+        "fused_ms": round(fused_s * 1e3, 3),
+        "fused_over_optax_speedup": round(optax_s / fused_s, 3),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="tiny shapes for the hermetic CPU smoke test")
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+
+    from bench import configure_jax
+
+    configure_jax(jax)
+
+    device = jax.devices()[0]
+    out = {
+        "metric": "pallas_kernel_vs_xla",
+        "backend": device.platform,
+        "device_kind": device.device_kind,
+        "quick": args.quick,
+    }
+    try:
+        out["attention_fwd_bwd"] = bench_attention(
+            args.quick, args.reps, args.iters)
+    except Exception as exc:  # noqa: BLE001 - partial results still print
+        out["attention_error"] = repr(exc)
+    try:
+        out["adam_update"] = bench_adam(args.quick, args.reps, args.iters)
+    except Exception as exc:  # noqa: BLE001
+        out["adam_error"] = repr(exc)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,29 +5,71 @@
 set -u
 OUT=/root/repo/tools/captured
 mkdir -p "$OUT"
+# Shared persistent compile cache: whatever the watcher compiles here, the
+# driver's end-of-round bench.py reuses (BENCH_COMPILE_CACHE default), so a
+# recovered chip never pays the compile minutes twice.
+export BENCH_COMPILE_CACHE=/root/repo/.xla_cache
 while true; do
   if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) TPU alive - capturing" >> "$OUT/watch.log"
-    timeout 900 python /root/repo/bench.py > "$OUT/bench.json" 2>> "$OUT/watch.log"
+    # Write to a temp file and publish only a freshly measured TPU line: a
+    # wedged retry must never truncate or downgrade an earlier good capture
+    # (bench.py's own watcher-capture fallback reads bench.json), and
+    # BENCH_CAPTURE_PATH= disables that fallback here so bench.py can never
+    # re-emit this watcher's own prior output as a "new" capture.
+    # Timeout covers bench.py's worst-case internal ladder (~30 min).
+    BENCH_CAPTURE_PATH= timeout 2400 python /root/repo/bench.py > "$OUT/bench.json.new" 2>> "$OUT/watch.log"
     BENCH_RC=$?
+    if grep -q '"backend": "tpu"' "$OUT/bench.json.new" 2>/dev/null \
+        && ! grep -q '"source": "watcher_capture"' "$OUT/bench.json.new" 2>/dev/null; then
+      mv "$OUT/bench.json.new" "$OUT/bench.json"
+    else
+      echo "$(date -u +%FT%TZ) bench output not TPU-backed - kept prior capture" >> "$OUT/watch.log"
+      cat "$OUT/bench.json.new" >> "$OUT/watch.log" 2>/dev/null
+      rm -f "$OUT/bench.json.new"
+      BENCH_RC=1
+    fi
     timeout 1800 python /root/repo/tools/northstar.py \
       --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
-      --compile-cache /tmp/ns_xla_cache \
-      --root /tmp/ns_tpu > "$OUT/northstar.json" 2>> "$OUT/watch.log"
+      --compile-cache "$BENCH_COMPILE_CACHE" \
+      --root /tmp/ns_tpu > "$OUT/northstar.json.new" 2>> "$OUT/watch.log"
     NS_RC=$?
+    if [ "$NS_RC" -eq 0 ]; then
+      mv "$OUT/northstar.json.new" "$OUT/northstar.json"
+    else
+      cat "$OUT/northstar.json.new" >> "$OUT/watch.log" 2>/dev/null
+      rm -f "$OUT/northstar.json.new"
+    fi
     echo "$(date -u +%FT%TZ) capture done bench_rc=$BENCH_RC northstar_rc=$NS_RC" >> "$OUT/watch.log"
+    # Captures are round evidence: commit them the moment they exist, so a
+    # chip that answers at 3am still produces a timestamped git record.
+    # Pathspec'd commit: never scoop whatever the interactive session has
+    # staged into the watcher's background commit.
+    git -C /root/repo add tools/captured \
+      && git -C /root/repo commit -q \
+        -m "tools/captured: TPU capture bench_rc=$BENCH_RC northstar_rc=$NS_RC" \
+        -- tools/captured >> "$OUT/watch.log" 2>&1
     if [ "$BENCH_RC" -ne 0 ] || [ "$NS_RC" -ne 0 ]; then
       echo "$(date -u +%FT%TZ) capture INCOMPLETE - will retry" >> "$OUT/watch.log"
       sleep 300
       continue
     fi
-    # On-chip kernel/training suite (Mosaic compiles of all three Pallas
-    # kernels + the fused-path training run); once per successful round,
-    # after the retry gate so a flaky bench never re-runs or clobbers it.
+    # MXU-bound kernel benchmarks (flash vs dense attention, fused Adam vs
+    # optax) + on-chip kernel/training suite (Mosaic compiles of all three
+    # Pallas kernels); once per successful round, after the retry gate so a
+    # flaky bench never re-runs or clobbers them.
+    timeout 1800 python /root/repo/tools/bench_kernels.py \
+      > "$OUT/kernels.json" 2>> "$OUT/watch.log"
+    KB_RC=$?
+    echo "$(date -u +%FT%TZ) kernel bench rc=$KB_RC" >> "$OUT/watch.log"
     timeout 1800 python -m pytest /root/repo/tests_tpu/ -q \
       > "$OUT/tests_tpu.log" 2>&1
     TT_RC=$?
     echo "$(date -u +%FT%TZ) tests_tpu rc=$TT_RC (see tests_tpu.log)" >> "$OUT/watch.log"
+    git -C /root/repo add tools/captured \
+      && git -C /root/repo commit -q \
+        -m "tools/captured: kernel bench rc=$KB_RC, tests_tpu rc=$TT_RC" \
+        -- tools/captured >> "$OUT/watch.log" 2>&1
     exit 0
   fi
   echo "$(date -u +%FT%TZ) tpu still down" >> "$OUT/watch.log"
