@@ -351,11 +351,23 @@ def bench_accelerator() -> dict:
                      ".xla_cache"))
     errors = []
 
+    def _tpu_only(result, err, label):
+        """A child that silently fell back to the CPU backend (plugin init
+        failure with JAX_PLATFORMS unset) is NOT a TPU measurement — it
+        must not shadow the watcher-capture fallback below."""
+        if result is None:
+            return None, err
+        backend = result.get("backend")
+        if backend != "tpu":
+            return None, f"{label} ran on backend {backend!r}, not tpu"
+        return result, None
+
     # Level 1: cheap probe — small batch, per-step jit, seconds of compile.
     # Tells a dead link apart from a slow one, and its number stands in if
     # the scan bench can't finish.
     probe, err = _run_child({"BENCH_PROBE": "1"}, steps=8, reps=2,
                             timeout=360.0)
+    probe, err = _tpu_only(probe, err, "probe")
     if probe is None:
         errors.append(f"tpu probe: {err}")
 
@@ -366,6 +378,7 @@ def bench_accelerator() -> dict:
     timeouts = (600.0, 720.0) if probe else (480.0,)
     for attempt, timeout in enumerate(timeouts):
         result, err = _run_child({}, steps=50, reps=3, timeout=timeout)
+        result, err = _tpu_only(result, err, "scan bench")
         if result:
             return result
         errors.append(f"tpu attempt {attempt + 1}: {err}")

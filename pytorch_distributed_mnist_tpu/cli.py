@@ -67,11 +67,21 @@ from pytorch_distributed_mnist_tpu.utils.profiling import (
     profile_trace,
 )
 
+# The process-wide compile-cache config from before the first run() call
+# (dir, min_compile_secs, min_entry_bytes) — captured lazily so a harness's
+# own cache setup (tests/conftest.py) survives flag-less runs; see run().
+_AMBIENT_CACHE = None
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu-mnist",
         description="TPU-native distributed MNIST training (JAX/XLA/pjit)",
+        # No prefix abbreviation: an abbreviated '--spaw 2' would set
+        # args.spawn here yet survive launcher.strip_spawn_flag's literal
+        # match, so children would re-parse it next to the injected
+        # --coordinator and die with a confusing combination error.
+        allow_abbrev=False,
     )
     # Reference-parity flags (defaults match :289-336).
     p.add_argument("--root", type=str, default="data", help="dataset root dir")
@@ -347,17 +357,44 @@ def run(args, epoch_callback=None) -> dict:
     jax.config.update("jax_debug_nans", debug_nans)
     # Unconditional, like jax_debug_nans above: run() is re-entered in one
     # process (tests, tools), and a previous run's cache dir must not leak
-    # into a run that didn't ask for one.
+    # into a run that didn't ask for one. "Didn't ask" restores the
+    # AMBIENT config from before the first run() — not None — so a harness
+    # that set its own process-wide cache (tests/conftest.py's .xla_cache)
+    # keeps it across every flag-less run.
+    global _AMBIENT_CACHE
+    if _AMBIENT_CACHE is None:
+        _AMBIENT_CACHE = (
+            jax.config.jax_compilation_cache_dir,
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+            jax.config.jax_persistent_cache_min_entry_size_bytes,
+        )
     if getattr(args, "compile_cache", None):
+        if jax.config.jax_compilation_cache_dir != args.compile_cache:
+            # jax binds its cache object to the first dir that initializes
+            # it (e.g. a test harness's shared cache), and an earlier
+            # run() in this process may have compiled the same programs
+            # under another dir (or none); reset so THIS run's programs
+            # land in the requested dir. The in-memory jit cache must go
+            # too — a program it already holds would never reach XLA, so
+            # nothing would be written to the new dir.
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+            jax.clear_caches()
         jax.config.update("jax_compilation_cache_dir", args.compile_cache)
         # Cache every program, however small/fast-compiling (defaults
         # skip sub-second compiles, which covers most CPU-test programs).
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     else:
-        jax.config.update("jax_compilation_cache_dir", None)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        amb_dir, amb_secs, amb_bytes = _AMBIENT_CACHE
+        jax.config.update("jax_compilation_cache_dir", amb_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          amb_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          amb_bytes)
     log0(args)  # startup args print parity (:337)
     seed = args.seed if args.seed is not None else 0
     if args.seed is not None:
@@ -401,10 +438,12 @@ def run(args, epoch_callback=None) -> dict:
                     f"{dp_size} data slices into a per-slice batch "
                     f"divisible by {pp} pipeline microbatches"
                 )
-    if pp > 1 and (tp > 1 or sp > 1):
+    if pp > 1 and sp > 1:
         raise SystemExit(
-            "--pipeline-stages does not compose with --tensor-parallel/"
-            "--sequence-parallel yet; pick pipeline or the TP/SP mesh"
+            "--pipeline-stages does not compose with --sequence-parallel: "
+            "the ring/Ulysses attention is itself a shard_map collective "
+            "program and cannot nest inside the pipeline's shard_map body "
+            "(see docs/DESIGN.md for the cost argument)"
         )
     if pp > 1:
         if args.model != "vit":
@@ -417,15 +456,34 @@ def run(args, epoch_callback=None) -> dict:
             raise SystemExit(
                 "--pipeline-stages composes with --optimizer-sharding "
                 "zero1 (moments sharded stage x data); zero3 would "
-                "re-shard the stage-sharded params themselves"
+                "re-shard the stage-sharded params themselves (see "
+                "docs/DESIGN.md)"
             )
-        if jax.device_count() % pp:
+        if jax.device_count() % (pp * tp):
             raise SystemExit(
-                f"--pipeline-stages {pp} does not divide the "
-                f"{jax.device_count()} available devices"
+                f"--pipeline-stages {pp}"
+                + (f" x --tensor-parallel {tp}" if tp > 1 else "")
+                + f" does not divide the {jax.device_count()} available "
+                  f"devices"
             )
-        mesh = make_mesh(("data", "stage"),
-                         shape=(jax.device_count() // pp, pp))
+        if tp > 1:
+            num_heads = _vit_num_heads()
+            if num_heads % tp:
+                raise SystemExit(
+                    f"--tensor-parallel {tp} with --pipeline-stages: the "
+                    f"Megatron stage body shards the ViT's {num_heads} "
+                    f"attention heads over the model axis, so the width "
+                    f"must divide {num_heads}"
+                )
+            # PP x TP: data x stage x model mesh; the stage body runs the
+            # explicit-Megatron block (parallel/pipeline_tp.py) since
+            # GSPMD cannot propagate inside the pipeline's shard_map.
+            mesh = make_mesh(
+                ("data", "stage", "model"),
+                shape=(jax.device_count() // (pp * tp), pp, tp))
+        else:
+            mesh = make_mesh(("data", "stage"),
+                             shape=(jax.device_count() // pp, pp))
     elif tp > 1 or sp > 1:
         if args.model != "vit":
             raise SystemExit(
@@ -500,17 +558,14 @@ def run(args, epoch_callback=None) -> dict:
 
     loss_impl = getattr(args, "loss", "xla")
     if loss_impl == "fused":
-        if pp > 1:
-            raise SystemExit(
-                "--loss fused does not compose with --pipeline-stages: "
-                "the loss consumes the pipeline's psum-gathered output "
-                "inside its own collective program; use --loss xla"
-            )
         # GSPMD modes get the mesh so the kernel runs per-device on local
         # batch shards via a nested shard_map (P('data') in_specs force a
-        # batch-sharded, model/seq-replicated layout, valid on TP/SP
-        # meshes too); the explicit mode is already inside a shard_map
-        # (no nesting over the same axis).
+        # batch-sharded, model/seq-replicated layout — valid on TP/SP
+        # meshes AND the pipeline's data x stage mesh: the logits leaving
+        # the GPipe shard_map are data-sharded and stage-replicated,
+        # exactly the layout the loss's in_specs request); the explicit
+        # mode is already inside a shard_map (no nesting over the same
+        # axis).
         set_loss_impl(
             "fused",
             mesh=mesh if args.trainer_mode != "explicit" else None,
@@ -590,10 +645,13 @@ def run(args, epoch_callback=None) -> dict:
                 ring_attention, mesh=mesh, axis="seq", batch_axis="data",
                 head_axis="model" if tp > 1 else None,
             )
-    elif tp > 1 and model_kwargs.get("attention_fn") is not None:
+    elif tp > 1 and pp == 1 and model_kwargs.get("attention_fn") is not None:
         # --tensor-parallel + --attention flash (sp == 1): shard_map the
         # kernel over batch x heads so it matches the Megatron layout
         # (qkv/proj weights head-sharded on 'model') with no gather.
+        # (Under --pipeline-stages the kernel needs no wrapper at all:
+        # the explicit-TP stage body already hands it this device's local
+        # (B, T, H/tp, D) heads, parallel/pipeline_tp.py.)
         from functools import partial as _partial
 
         from pytorch_distributed_mnist_tpu.ops.pallas.flash import (
@@ -626,7 +684,17 @@ def run(args, epoch_callback=None) -> dict:
         )
     model = get_model(args.model, **model_kwargs)
     pp_sharding = None
-    if pp > 1:
+    if pp > 1 and tp > 1:
+        from pytorch_distributed_mnist_tpu.parallel.pipeline_tp import (
+            create_pipelined_tp_vit_state,
+        )
+
+        state, pp_sharding = create_pipelined_tp_vit_state(
+            model, jax.random.key(seed), mesh, data_axis="data",
+            lr=args.lr, optimizer=args.optimizer, momentum=args.momentum,
+            weight_decay=args.weight_decay,
+        )
+    elif pp > 1:
         from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
             create_pipelined_vit_state,
         )
@@ -659,8 +727,18 @@ def run(args, epoch_callback=None) -> dict:
             # Process 0's resolution wins.
             from jax.experimental import multihost_utils
 
+            encoded = resume_path.encode()
+            if len(encoded) > 4096:
+                # ljust would be a no-op and process 0's payload shape
+                # would diverge from the other hosts', failing the
+                # broadcast with a shape error far from the cause.
+                raise SystemExit(
+                    f"--resume auto: checkpoint path is {len(encoded)} "
+                    "bytes, over the 4096-byte multi-host broadcast "
+                    "buffer; use a shorter --checkpoint-dir"
+                )
             payload = np.frombuffer(
-                resume_path.encode().ljust(4096, b"\0"), dtype=np.uint8
+                encoded.ljust(4096, b"\0"), dtype=np.uint8
             )
             agreed = multihost_utils.broadcast_one_to_all(payload)
             resume_path = bytes(agreed).rstrip(b"\0").decode()
@@ -677,7 +755,10 @@ def run(args, epoch_callback=None) -> dict:
     state_sharding = pp_sharding
     tp_rules = None
     zero = getattr(args, "optimizer_sharding", "none")
-    if tp > 1:
+    if tp > 1 and pp == 1:
+        # PP x TP already placed the state (head-major explicit layout,
+        # parallel/pipeline_tp.py); the GSPMD rule table below only
+        # applies to the standard flax tree.
         from pytorch_distributed_mnist_tpu.parallel.tensor import (
             shard_state,
             vit_tp_rules,
@@ -763,6 +844,9 @@ def run(args, epoch_callback=None) -> dict:
     ):
         for epoch in range(start_epoch, args.epochs):
             train_loader.set_sample_epoch(epoch)  # per-epoch reshuffle (:231)
+            # No epoch follows the last one: don't stage a gather nothing
+            # will consume.
+            trainer.prefetch_enabled = epoch + 1 < args.epochs
             trainer.state = trainer.state.with_learning_rate(lr_of(epoch))  # (:232)
             # Only the train pass is timed; trainer.train() folds metrics to
             # host values before returning, so the measured span covers all
@@ -823,6 +907,12 @@ def main(argv: Optional[list] = None) -> None:
     argv = list(_sys.argv[1:]) if argv is None else list(argv)
     args = build_parser().parse_args(argv)
     if args.spawn:
+        if args.spawn < 2:
+            raise SystemExit(
+                f"--spawn {args.spawn}: the local spawner simulates a "
+                "multi-host world and needs at least 2 processes; for a "
+                "single-process run just drop --spawn"
+            )
         if (args.coordinator or args.process_id is not None
                 or args.num_processes is not None):
             raise SystemExit(

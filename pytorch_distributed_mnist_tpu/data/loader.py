@@ -86,13 +86,15 @@ class MNISTDataLoader:
         n = len(self.sampler)
         return n // self.local_batch_size if self.drop_last else -(-n // self.local_batch_size)
 
-    def _epoch_index_matrix(self):
+    def _epoch_index_matrix(self, epoch: Optional[int] = None):
         """(steps, local_batch) index matrix + 0/1 validity mask.
 
         Padding (wrapping from the front) keeps shapes static for XLA; the
         mask marks padded positions so metrics never double-count them.
+        ``epoch`` selects a specific epoch's shuffle without mutating the
+        sampler (see ``DistributedShardSampler.indices_and_mask``).
         """
-        idx, valid = self.sampler.indices_and_mask()
+        idx, valid = self.sampler.indices_and_mask(epoch)
         steps = self.steps_per_epoch
         need = steps * self.local_batch_size
         mask = np.ones(need, np.float32)
@@ -111,17 +113,19 @@ class MNISTDataLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
-    def stacked_epoch(self) -> Dict[str, np.ndarray]:
+    def stacked_epoch(self, epoch: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Whole epoch as {'image': (S, B, ...), 'label': (S, B), 'mask': (S, B)}
         for lax.scan.
 
         The gather is the host-side hot path (one full-dataset permutation
         copy per epoch); it runs in multithreaded C++ when the native
         backend is built (``-j/--workers`` controls the thread count).
+        ``epoch`` gathers a specific epoch's shuffle purely (no sampler
+        mutation) — the trainer's background prefetch path.
         """
         from pytorch_distributed_mnist_tpu.data import native
 
-        m, mask = self._epoch_index_matrix()
+        m, mask = self._epoch_index_matrix(epoch)
         if self.images.dtype == np.float32 and native.available():
             got = native.gather_epoch(self.images, self.labels, m, self.workers)
             if got is not None:

@@ -60,16 +60,22 @@ class DistributedShardSampler:
         """This replica's index shard for the current epoch."""
         return self.indices_and_mask()[0]
 
-    def indices_and_mask(self):
+    def indices_and_mask(self, epoch: int | None = None):
         """(indices, valid) for this replica; ``valid`` is 0.0 on pad entries.
 
         Pad entries exist when the dataset size is not divisible by
         ``num_replicas`` (wrap-padding, torch DistributedSampler policy).
         torch counts the duplicates in eval; the mask lets this framework
         report exact whole-dataset metrics instead.
+
+        ``epoch`` overrides ``self.epoch`` without mutating it — the pure
+        form the trainer's background prefetch uses so it never races a
+        concurrent ``set_epoch`` from the caller.
         """
+        if epoch is None:
+            epoch = self.epoch
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
+            rng = np.random.default_rng(self.seed + epoch)
             order = rng.permutation(self.dataset_len)
         else:
             order = np.arange(self.dataset_len)

@@ -66,13 +66,24 @@ def _xent_fwd_kernel(c: int, logits_ref, label_ref, loss_ref, lse_ref):
 
 
 def _xent_bwd_kernel(c: int, logits_ref, label_ref, lse_ref, g_ref, dl_ref):
-    """dlogits = (softmax - onehot) * upstream, one pass over the block."""
+    """dlogits = (softmax - onehot) * upstream, one pass over the block.
+
+    Gated on the forward's ``max(lse - picked, 0)`` clamp exactly the way
+    XLA differentiates it: gradient factor 1 where ``lse > picked``, 0
+    where the clamp engaged (``lse < picked``, float-saturation artifact),
+    and 0.5 at the exact tie — ``d/dx max(x, 0)`` at x == 0 splits evenly
+    on the XLA path, so the fused gradient matches it even at
+    float-saturated logits."""
     l = logits_ref[:]
     col = jax.lax.broadcasted_iota(jnp.int32, l.shape, 1)
     valid = col < c
     p = jnp.where(valid, jnp.exp(l - lse_ref[:]), 0.0)
     onehot = jnp.where(col == label_ref[:], 1.0, 0.0)
-    dl_ref[:] = (p - onehot * valid) * g_ref[:]
+    picked = jnp.sum(jnp.where(col == label_ref[:], l, 0.0),
+                     axis=1, keepdims=True)
+    diff = lse_ref[:] - picked
+    live = jnp.where(diff > 0.0, 1.0, jnp.where(diff == 0.0, 0.5, 0.0))
+    dl_ref[:] = (p - onehot * valid) * g_ref[:] * live
 
 
 def _pad_rows(b: int) -> int:

@@ -51,6 +51,7 @@ def pipeline_apply(
     axis: str = "stage",
     num_microbatches: Optional[int] = None,
     data_axis: Optional[str] = None,
+    param_specs=None,
 ) -> jnp.ndarray:
     """Run ``x`` through S pipelined stages: ``y = f_S(... f_1(x))``.
 
@@ -61,6 +62,13 @@ def pipeline_apply(
     data slice runs its own pipeline flow over the same stage weights);
     microbatching then applies to the per-slice batch. Returns the
     full-batch output, replicated over ``axis``.
+
+    ``param_specs`` (a PartitionSpec pytree matching ``stage_params``)
+    overrides the default ``P(axis)``-on-dim-0 layout — the PP x TP
+    composition (``parallel/pipeline_tp.py``) shards block weights on the
+    ``model`` mesh axis *in addition to* the stage dim, and its
+    ``stage_fn`` closes the partial sums with psums over that axis; this
+    function's scan/ppermute schedule is axis-local and unchanged.
     """
     n_stages = mesh.shape[axis]
     m = num_microbatches or n_stages
@@ -116,8 +124,8 @@ def pipeline_apply(
         outs = lax.psum(jnp.where(s == n_stages - 1, outs, 0.0), axis)
         return outs.reshape((batch,) + xg.shape[1:])
 
-    spec_params = jax.tree_util.tree_map(
-        lambda _: P(axis), stage_params
+    spec_params = param_specs if param_specs is not None else (
+        jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     )
     x_spec = P(data_axis) if data_axis else P()
     return jax.shard_map(

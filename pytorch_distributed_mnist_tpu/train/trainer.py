@@ -17,11 +17,19 @@ The execution model is TPU-first rather than a translation:
 - ``mode='explicit'`` uses the shard_map/psum step from
   ``parallel/collectives.py`` — the auditable direct DDP analog;
 - metrics accumulate on device (``ops/metrics.py``) and transfer once per
-  pass.
+  pass;
+- the scan mode's host-side epoch gather is pipelined: epoch N+1's
+  permutation copy runs on a background thread while the device executes
+  epoch N (jit dispatch is async), and the eval pass — whose sampler never
+  reshuffles — stages its device-resident batches exactly once. The
+  reference hides the same cost behind DataLoader worker processes
+  (``/root/reference/multi_proc_single_gpu.py:156``); here it leaves the
+  critical path entirely.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Tuple
 
 from jax.sharding import Mesh
@@ -111,6 +119,35 @@ class Trainer:
             make_eval_epoch(mesh, state_sharding=state_sharding)
             if mode == "scan" else None
         )
+        # Epoch-gather pipelining (scan mode): (epoch, thread, holder) of a
+        # background stacked_epoch() for the NEXT epoch, plus the one-time
+        # device-resident eval stage. prefetch_enabled exists for the
+        # equivalence test that pins prefetched == synchronous trajectories.
+        self._prefetch = None
+        self.prefetch_enabled = True
+        self._eval_staged = None
+
+    def _start_prefetch(self) -> None:
+        """Stage the NEXT epoch's gather while the device runs this one.
+
+        Runs after the epoch program is dispatched (dispatch is async, so
+        the chips are already crunching). The gather is the PURE form
+        (``stacked_epoch(epoch)``) — the thread never mutates the shared
+        sampler, so a concurrent ``set_sample_epoch`` from the caller
+        cannot race it. ``train()`` validates the staged epoch against
+        the sampler's epoch at consumption time, so a caller that jumps
+        epochs (resume) just invalidates the stage — correctness never
+        depends on the prediction being right.
+        """
+        epoch = self.train_loader.sampler.epoch + 1
+        holder = {}
+
+        def work():
+            holder["batches"] = self.train_loader.stacked_epoch(epoch)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        self._prefetch = (epoch, t, holder)
 
     def train(self) -> Tuple[Average, Accuracy]:
         """One training epoch; returns (loss meter, accuracy meter).
@@ -118,10 +155,21 @@ class Trainer:
         Parity contract: reference ``Trainer.train`` (``:77-97``).
         """
         if self.mode == "scan":
+            staged = None
+            if self._prefetch is not None:
+                epoch, t, holder = self._prefetch
+                self._prefetch = None
+                t.join()
+                if epoch == self.train_loader.sampler.epoch:
+                    staged = holder.get("batches")
+            if staged is None:
+                staged = self.train_loader.stacked_epoch()
             batches = make_global_batch(
-                self.train_loader.stacked_epoch(), self.mesh, leading_replicated=True
+                staged, self.mesh, leading_replicated=True
             )
             self.state, ms = self._train_epoch(self.state, batches)
+            if self.prefetch_enabled:
+                self._start_prefetch()
         else:
             ms = None
             for batch in self.train_loader:
@@ -140,10 +188,16 @@ class Trainer:
         metric reduction crosses devices inside the jitted program.
         """
         if self.mode == "scan":
-            batches = make_global_batch(
-                self.test_loader.stacked_epoch(), self.mesh, leading_replicated=True
-            )
-            ms = self._eval_epoch(self.state, batches)
+            if self._eval_staged is None:
+                # The eval sampler never reshuffles, so the stacked epoch
+                # — and its device placement — is identical every pass:
+                # stage it once, host gather and H2D both leave the
+                # per-epoch path.
+                self._eval_staged = make_global_batch(
+                    self.test_loader.stacked_epoch(), self.mesh,
+                    leading_replicated=True
+                )
+            ms = self._eval_epoch(self.state, self._eval_staged)
         else:
             ms = None
             for batch in self.test_loader:

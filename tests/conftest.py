@@ -25,6 +25,20 @@ import jax
 # initializes so the suite really runs on the 8 virtual CPU devices.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: most of the suite's wall-clock is XLA
+# recompilation of near-identical programs across test processes (round-2
+# VERDICT measured 1127s for 255 tests, ~19 min of mostly compiles). The
+# cache dir is shared with bench.py/tools (same .xla_cache, gitignored);
+# entries are keyed by platform so CPU test entries never collide with
+# TPU bench entries.
+_cache_dir = os.environ.get(
+    "TPU_MNIST_TEST_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".xla_cache"))
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 import numpy as np
 import pytest
 

@@ -155,6 +155,26 @@ def test_spawn_flag_conflicts():
     assert "cannot combine" in str(exc.value.code)
 
 
+def test_spawn_one_clean_error():
+    """--spawn 1 must die with flag-level language (SystemExit), not a
+    bare ValueError traceback from the launcher (round-2 ADVICE)."""
+    from pytorch_distributed_mnist_tpu.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--spawn", "1"])
+    assert "at least 2 processes" in str(exc.value.code)
+
+
+def test_no_prefix_abbreviation():
+    """allow_abbrev=False: '--spaw 2' must be rejected outright — an
+    abbreviated spawn flag would survive strip_spawn_flag's literal match
+    and poison the children's argv (round-2 ADVICE)."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser
+
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--spaw", "2"])
+
+
 def test_strip_spawn_flag():
     from pytorch_distributed_mnist_tpu.parallel.launcher import (
         strip_spawn_flag,

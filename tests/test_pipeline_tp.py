@@ -1,0 +1,219 @@
+"""PP x TP (parallel/pipeline_tp.py): the explicit-Megatron stage body on a
+data x stage x model mesh — round-2 VERDICT's first composition hole.
+
+The bar is the same self-consistency the PP-only suite pins: the pipelined
+TP program must be numerically the same model as the sequential
+``VisionTransformer.apply`` — layouts are an implementation detail, math
+is the contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_mnist_tpu.parallel.pipeline_tp import (
+    create_pipelined_tp_vit_state,
+    make_pipelined_tp_vit_apply,
+    merge_vit_params_tp,
+    split_vit_params_tp,
+)
+
+
+def _model(depth=4, **kw):
+    return get_model("vit", compute_dtype=jnp.float32, depth=depth, **kw)
+
+
+def _params(model, seed=0):
+    return model.init(jax.random.key(seed), jnp.zeros((1, 28, 28, 1)))
+
+
+def test_split_merge_tp_round_trip():
+    model = _model()
+    params = _params(model)
+    merged = merge_vit_params_tp(
+        split_vit_params_tp(params, model.num_heads))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(merged)[0],
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=jax.tree_util.keystr(pa))
+
+
+@pytest.mark.parametrize(
+    "shape,depth",
+    [
+        ((2, 2, 2), 4),   # DP x PP x TP, 2 blocks/stage
+        ((1, 4, 2), 4),   # PP x TP, 1 block/stage
+        ((1, 2, 4), 4),   # wide TP: all 4 heads spread over the model axis
+    ],
+)
+def test_pp_tp_forward_matches_sequential(shape, depth):
+    model = _model(depth)
+    params = _params(model)
+    x = jax.random.normal(jax.random.key(1), (16, 28, 28, 1))
+    ref = model.apply(params, x)
+    mesh = make_mesh(("data", "stage", "model"), shape=shape)
+    apply_fn = make_pipelined_tp_vit_apply(
+        model, mesh, data_axis="data")
+    out = apply_fn(split_vit_params_tp(params, model.num_heads), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pp_tp_grads_match_sequential():
+    """Gradients through scan + ppermute + the model-axis psums equal the
+    sequential model's — the Megatron partial sums transpose correctly."""
+    from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
+
+    model = _model(depth=2)
+    mesh = make_mesh(("data", "stage", "model"), shape=(2, 2, 2))
+    x = jax.random.normal(jax.random.key(0), (8, 28, 28, 1), jnp.float32)
+    y = jnp.arange(8, dtype=jnp.int32) % 10
+
+    ref_params = _params(model, seed=3)
+
+    def ref_loss(p):
+        return cross_entropy(model.apply(p, x), y)
+
+    ref_grads = jax.grad(ref_loss)(ref_params)
+
+    apply_fn = make_pipelined_tp_vit_apply(model, mesh, data_axis="data")
+    tp_params = split_vit_params_tp(ref_params, model.num_heads)
+
+    def tp_loss(p):
+        return cross_entropy(apply_fn(p, x), y)
+
+    tp_grads = merge_vit_params_tp(jax.grad(tp_loss)(tp_params))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(tp_grads)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(pa))
+
+
+def test_pp_tp_state_actually_sharded():
+    model = _model(depth=4)
+    mesh = make_mesh(("data", "stage", "model"), shape=(2, 2, 2))
+    state, sharding = create_pipelined_tp_vit_state(
+        model, jax.random.key(0), mesh)
+    from jax.sharding import PartitionSpec as P
+
+    qkv = state.params["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv.shape == (4, 64, 3, 4, 16)  # (depth, C, 3, H, D) head-major
+    assert qkv.sharding.spec == P("stage", None, None, "model", None)
+    proj = state.params["blocks"]["attn"]["proj"]["kernel"]
+    assert proj.sharding.spec == P("stage", "model", None, None)
+    mlp1 = state.params["blocks"]["mlp1"]["kernel"]
+    assert mlp1.sharding.spec == P("stage", None, "model")
+    # Adam moments mirror the param layout through the same rule pass.
+    mu_qkv = state.opt_state.inner_state[0].mu[
+        "blocks"]["attn"]["qkv"]["kernel"]
+    assert mu_qkv.sharding.spec == P("stage", None, None, "model", None)
+
+
+def test_pp_tp_train_step_matches_unpipelined(tiny_data):
+    """One jitted train step on the PP x TP mesh == the plain model's step
+    (same init, same batch): loss exact, merged gradients equal."""
+    from pytorch_distributed_mnist_tpu.data.loader import make_global_batch
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+    model = _model(depth=2)
+    images, labels = tiny_data
+    batch = {"image": jnp.asarray(images[:32]),
+             "label": jnp.asarray(labels[:32])}
+
+    ref_state = create_train_state(model, jax.random.key(0))
+    ref_step = make_train_step()
+    ref_state, ref_m = ref_step(ref_state, batch)
+
+    mesh = make_mesh(("data", "stage", "model"), shape=(2, 2, 2))
+    tp_state, tp_sharding = create_pipelined_tp_vit_state(
+        model, jax.random.key(0), mesh)
+    tp_step = make_train_step(mesh, state_sharding=tp_sharding)
+    tp_state, tp_m = tp_step(tp_state, make_global_batch(
+        {k: np.asarray(v) for k, v in batch.items()}, mesh))
+
+    assert float(tp_m.loss_sum) == pytest.approx(float(ref_m.loss_sum),
+                                                 rel=1e-5)
+    assert float(tp_m.correct) == float(ref_m.correct)
+
+
+def test_pp_tp_zero1_composes():
+    """PP x TP x ZeRO-1: the generic base_sharding path adds a data axis
+    to moment leaves the TP layout left unsharded — three-strategy
+    composition on one mesh."""
+    from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+    mesh = make_mesh(("data", "stage", "model"), shape=(2, 2, 2))
+    x = jax.random.normal(jax.random.key(0), (8, 28, 28, 1), jnp.float32)
+    y = jnp.arange(8, dtype=jnp.int32) % 10
+    batch = {"image": x, "label": y}
+    model = _model(depth=2)
+
+    def run_steps(with_zero):
+        state, sharding = create_pipelined_tp_vit_state(
+            model, jax.random.key(1), mesh)
+        if with_zero:
+            state, sharding = shard_state_zero(
+                state, mesh, base_sharding=sharding, level=1)
+        step = make_train_step(mesh, state_sharding=sharding)
+        for _ in range(2):
+            state, m = step(state, batch)
+        return state, m, sharding
+
+    s0, m0, _ = run_steps(False)
+    s1, m1, sh1 = run_steps(True)
+    np.testing.assert_allclose(float(m0.loss_sum), float(m1.loss_sum),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    specs = [s.spec for s in jax.tree.leaves(sh1.opt_state)]
+    assert any("stage" in str(sp) and "data" in str(sp) for sp in specs)
+
+
+def test_heads_not_divisible_raises():
+    model = _model(depth=4)  # 4 heads
+    mesh = make_mesh(("data", "stage", "model"), shape=(1, 2, 4))
+    # 4 heads / tp=4 is fine; tp=8 impossible on 8 devices with stage=2;
+    # build a 3-head-incompatible case instead via num_heads=2.
+    model2 = _model(depth=4, num_heads=2)
+    with pytest.raises(ValueError, match="heads"):
+        make_pipelined_tp_vit_apply(model2, mesh)
+
+
+def test_cli_pp_tp_end_to_end(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit",
+        "--pipeline-stages", "2", "--tensor-parallel", "2",
+        "--epochs", "1", "--batch-size", "64",
+        "--synthetic-train-size", "256", "--synthetic-test-size", "128",
+        "--seed", "0",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    summary = run(args)
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_pp_sp_still_rejected(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    with pytest.raises(SystemExit, match="sequence-parallel"):
+        run(build_parser().parse_args([
+            "--dataset", "synthetic", "--model", "vit",
+            "--pipeline-stages", "2", "--sequence-parallel", "2",
+            "--checkpoint-dir", str(tmp_path),
+        ]))
