@@ -684,6 +684,12 @@ def run(args, epoch_callback=None) -> dict:
         )
     model = get_model(args.model, **model_kwargs)
     pp_sharding = None
+    # With ZeRO composing on top of the pipeline layout, the state must be
+    # placed exactly ONCE, onto the composed sharding: placing here first
+    # would commit the arrays stage-sharded, and re-placing them onto
+    # stage x data across hosts is a cross-host reshard place_state cannot
+    # do. place=False defers; shard_state_zero below does the one place.
+    pp_place = getattr(args, "optimizer_sharding", "none") == "none"
     if pp > 1 and tp > 1:
         from pytorch_distributed_mnist_tpu.parallel.pipeline_tp import (
             create_pipelined_tp_vit_state,
@@ -692,7 +698,7 @@ def run(args, epoch_callback=None) -> dict:
         state, pp_sharding = create_pipelined_tp_vit_state(
             model, jax.random.key(seed), mesh, data_axis="data",
             lr=args.lr, optimizer=args.optimizer, momentum=args.momentum,
-            weight_decay=args.weight_decay,
+            weight_decay=args.weight_decay, place=pp_place,
         )
     elif pp > 1:
         from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
@@ -702,7 +708,7 @@ def run(args, epoch_callback=None) -> dict:
         state, pp_sharding = create_pipelined_vit_state(
             model, jax.random.key(seed), mesh, data_axis="data",
             lr=args.lr, optimizer=args.optimizer, momentum=args.momentum,
-            weight_decay=args.weight_decay,
+            weight_decay=args.weight_decay, place=pp_place,
         )
     else:
         state = create_train_state(
@@ -785,16 +791,10 @@ def run(args, epoch_callback=None) -> dict:
         # leaves keep their layout, ZeRO claims the rest. With
         # --pipeline-stages, the pipeline's sharding tree is the base:
         # stage-sharded block moments gain a data axis on an unsharded
-        # dim (stage x data), embed/head moments shard over data alone.
-        if pp > 1 and process_count() > 1:
-            # The pipeline state is already committed stage-sharded
-            # across hosts; re-placing it onto the composed layout needs
-            # a cross-host reshard place_state does not perform.
-            raise SystemExit(
-                "--pipeline-stages with --optimizer-sharding is "
-                "single-host for now (multi-host would need a cross-host "
-                "reshard of the already-placed pipeline state)"
-            )
+        # dim (stage x data), embed/head moments shard over data alone —
+        # and the pipeline state arrives UNPLACED (place=False above), so
+        # this is the single placement, multi-host safe (every host holds
+        # the full fresh-init or checkpoint-restored value).
         state, state_sharding = shard_state_zero(
             state, mesh, rules=tp_rules,
             level=3 if zero == "zero3" else 1,
@@ -898,6 +898,7 @@ def run(args, epoch_callback=None) -> dict:
             "images_per_sec": ips,
             "images_per_sec_per_chip": timer.images_per_sec_per_chip,
             "dataset_synthesized": dataset_synthesized,
+            "start_epoch": start_epoch,
             "epochs_run": len(history)}
 
 

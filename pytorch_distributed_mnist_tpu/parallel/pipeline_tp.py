@@ -296,10 +296,13 @@ def create_pipelined_tp_vit_state(
     optimizer: str = "adam",
     momentum: float = 0.9,
     weight_decay: float = 1e-4,
+    place: bool = True,
 ):
     """``(state, state_sharding)`` for the PP x TP ViT — the same pair
     contract as ``create_pipelined_vit_state`` / ``shard_state``, consumed
-    by the standard train/eval steps unchanged."""
+    by the standard train/eval steps unchanged. ``place=False`` defers
+    placement for callers composing ZeRO on top (same rationale as
+    ``create_pipelined_vit_state``)."""
     from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
     from pytorch_distributed_mnist_tpu.train.state import (
         TrainState,
@@ -323,4 +326,6 @@ def create_pipelined_tp_vit_state(
         tx=tx,
     )
     sharding = pipelined_tp_state_sharding(state, mesh, stage_axis, tp_axis)
+    if not place:
+        return state, sharding
     return place_state(state, sharding), sharding

@@ -161,12 +161,21 @@ def create_pipelined_vit_state(
     optimizer: str = "adam",
     momentum: float = 0.9,
     weight_decay: float = 1e-4,
+    place: bool = True,
 ):
     """Return ``(state, state_sharding)``: a TrainState whose params use
     the pipelined layout and whose ``apply_fn`` runs the GPipe program —
     a drop-in for ``create_train_state`` that the standard train/eval
     steps consume unchanged (same pair convention as
-    ``shard_state_zero1``)."""
+    ``shard_state_zero1``).
+
+    ``place=False`` returns the HOST state unplaced (sharding tree still
+    computed): a caller composing a further layout on top (ZeRO moments)
+    must place exactly once onto the composed sharding — placing here
+    first would commit the arrays and make the multi-host re-placement a
+    cross-host reshard (see ``parallel.mesh.place_state``).
+    """
+    from pytorch_distributed_mnist_tpu.parallel.mesh import place_state
     from pytorch_distributed_mnist_tpu.train.state import (
         TrainState,
         make_optimizer,
@@ -188,7 +197,9 @@ def create_pipelined_vit_state(
         tx=tx,
     )
     sharding = pipelined_state_sharding(state, mesh, axis)
-    return jax.device_put(state, sharding), sharding
+    if not place:
+        return state, sharding
+    return place_state(state, sharding), sharding
 
 
 def pipelined_state_sharding(state, mesh: Mesh, axis: str = "stage"):

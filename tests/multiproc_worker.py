@@ -64,6 +64,8 @@ def main() -> None:
                 "best_acc": summary["best_acc"],
                 "train_loss": summary["history"][0]["train_loss"],
                 "test_acc": summary["history"][0]["test_acc"],
+                "start_epoch": summary.get("start_epoch"),
+                "epochs_run": summary.get("epochs_run"),
                 "checkpoint_files": wrote,
             }
         ),
