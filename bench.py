@@ -235,6 +235,38 @@ def child_bench(steps: int, reps: int, probe: bool = False) -> dict:
         result["mode"] = "probe"
 
     if device.platform != "cpu" and not probe \
+            and not os.environ.get("BENCH_SKIP_INDEXED"):
+        # Secondary: the device-gather input path (--epoch-gather device)
+        # on a real permuted dataset — the dataset resident in HBM, each
+        # scan tick jnp.take-ing its rows. Unlike the primary (which
+        # re-feeds one broadcast batch), this measures the throughput a
+        # real epoch with fresh indices sees. Extra fields only.
+        try:
+            from pytorch_distributed_mnist_tpu.train.steps import (
+                make_train_epoch_indexed,
+            )
+
+            n = steps * batch
+            imgs, labs = synthetic_dataset(n, seed=1)
+            data = {"image": jnp.asarray(normalize_images(imgs)),
+                    "label": jnp.asarray(labs.astype(np.int32))}
+            perm = np.random.default_rng(0).permutation(n).astype(np.int32)
+            ticks = {"idx": jnp.asarray(perm.reshape(steps, batch)),
+                     "mask": jnp.ones((steps, batch), jnp.float32)}
+            epoch_ix = make_train_epoch_indexed(mesh)
+            state_ix = create_train_state(model, jax.random.key(0))
+            state_ix, best_ix = warmup_and_time(
+                lambda st: epoch_ix(st, data, ticks), state_ix,
+                batch * steps)
+            result["images_per_sec_per_chip_device_gather"] = (
+                batch * steps / best_ix / n_chips)
+            # Free the ~320 MB resident dataset before the next secondary
+            # measures: dead bench arrays must not skew its HBM headroom.
+            del data, ticks, state_ix
+        except Exception as exc:  # noqa: BLE001 - secondary only
+            result["device_gather_error"] = repr(exc)
+
+    if device.platform != "cpu" and not probe \
             and not os.environ.get("BENCH_SKIP_FUSED"):
         # Secondary measurement: the all-first-party-kernel path (Pallas
         # fused cross-entropy + fused Adam). Extra fields only — any
@@ -482,7 +514,10 @@ def main() -> None:
         out["mfu"] = round(mfu, 4) if mfu is not None else None
         for key in ("backend", "device_kind", "n_chips", "global_batch",
                     "steps_per_sec", "flops_per_step", "peak_flops_per_chip",
-                    "mode", "tpu_error", "notes"):
+                    "mode", "images_per_sec_per_chip_fused_kernels",
+                    "fused_kernels_error",
+                    "images_per_sec_per_chip_device_gather",
+                    "device_gather_error", "tpu_error", "notes"):
             if result.get(key) is not None:
                 val = result[key]
                 out[key] = round(val, 2) if isinstance(val, float) else val

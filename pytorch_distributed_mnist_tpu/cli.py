@@ -23,8 +23,9 @@ for the TPU runtime:
   ``--sequence-parallel[-impl]``, ``--pipeline-stages``,
   ``--optimizer-sharding zero1|zero3``, ``--grad-accum``, ``--remat``;
   checkpoint lifecycle: ``--resume auto``, ``--keep-last``,
-  ``--async-checkpoint``; observability: ``--metrics-file``,
-  ``--debug-nans``.
+  ``--async-checkpoint``; input path: ``--epoch-gather host|device``
+  (device-resident dataset + in-program ``jnp.take``);
+  observability: ``--metrics-file``, ``--debug-nans``.
 
 Batch-size semantics: the reference's ``--batch-size`` is the per-node total
 divided among that node's GPUs (``:174``, ``:297-300``). Here it is the
@@ -207,6 +208,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "memory)")
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
+    p.add_argument("--epoch-gather", type=str, default="host",
+                   choices=["host", "device"],
+                   help="scan-mode batch staging: 'host' gathers each "
+                        "epoch's permuted copy on the host (pipelined on "
+                        "a background thread); 'device' keeps the dataset "
+                        "resident on device and gathers inside the "
+                        "scanned program (jnp.take) — per-epoch upload "
+                        "drops from the full dataset to a ~KB index "
+                        "matrix")
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
     p.add_argument("--keep-last", type=int, default=0, metavar="N",
                    help="retain only the N newest per-epoch checkpoints "
@@ -801,10 +811,16 @@ def run(args, epoch_callback=None) -> dict:
             base_sharding=pp_sharding if pp > 1 else None,
         )
 
+    epoch_gather = getattr(args, "epoch_gather", "host")
+    if epoch_gather == "device" and args.trainer_mode != "scan":
+        raise SystemExit(
+            "--epoch-gather device requires --trainer-mode scan (the "
+            "gather lives inside the scanned epoch program)"
+        )
     train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
                       mode=args.trainer_mode, state_sharding=state_sharding,
-                      grad_accum=grad_accum)
+                      grad_accum=grad_accum, epoch_gather=epoch_gather)
     lr_of = step_decay_schedule(args.lr)
 
     if args.evaluate:

@@ -138,11 +138,19 @@ class MNISTDataLoader:
         }
 
 
+def make_replicated(data: Dict[str, np.ndarray], mesh: Optional[Mesh]):
+    """Place host arrays on device fully replicated (every device, every
+    host, the whole array) — the layout the device-gather epoch path uses
+    for the resident dataset (train/steps.py make_train_epoch_indexed)."""
+    return make_global_batch(data, mesh, spec=P())
+
+
 def make_global_batch(
     batch: Dict[str, np.ndarray],
     mesh: Optional[Mesh],
     axis: str = "data",
     leading_replicated: bool = False,
+    spec: Optional[P] = None,
 ) -> Dict[str, jax.Array]:
     """Assemble this host's local batch into a (possibly) global jax.Array.
 
@@ -152,11 +160,14 @@ def make_global_batch(
     DDP rank holding its own sampler shard (``:143-144``).
 
     ``leading_replicated=True`` is for stacked epochs (steps axis first):
-    shards dim 1 instead of dim 0.
+    shards dim 1 instead of dim 0. ``spec`` overrides the PartitionSpec
+    entirely (``P()`` = fully replicated, every host passing the full
+    array — ``make_replicated``).
     """
     if mesh is None:
         return {k: jax.device_put(v) for k, v in batch.items()}
-    spec = P(None, axis) if leading_replicated else P(axis)
+    if spec is None:
+        spec = P(None, axis) if leading_replicated else P(axis)
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}

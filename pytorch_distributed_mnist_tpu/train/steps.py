@@ -170,6 +170,73 @@ def make_eval_step(
     )
 
 
+def _take_batch(data, tick):
+    """Gather one scan tick's batch from the device-resident dataset."""
+    return {
+        "image": jnp.take(data["image"], tick["idx"], axis=0),
+        "label": jnp.take(data["label"], tick["idx"], axis=0),
+        "mask": tick["mask"],
+    }
+
+
+def _accumulate(acc, m):
+    return MetricState(
+        acc.loss_sum + m.loss_sum,
+        acc.correct + m.correct,
+        acc.count + m.count,
+    )
+
+
+def _make_epoch(mesh, axis, state_sharding, step_fn, train, indexed):
+    """The one epoch builder behind all four make_*_epoch* factories.
+
+    ``train`` selects whether the scan carries (and donates) the state;
+    ``indexed`` selects the batch source: pre-staged ``(S, B, ...)``
+    arrays, or a device-resident dataset gathered per tick
+    (``_take_batch``). Everything else — scan body, metric accumulation,
+    jit/sharding wiring — is shared, so the host- and device-gather paths
+    cannot drift (tests/test_device_gather.py pins them
+    trajectory-identical).
+    """
+
+    def scan_epoch(state, batch_of, xs):
+        if train:
+            def body(carry, x):
+                st, acc = carry
+                st, m = step_fn(st, batch_of(x))
+                return (st, _accumulate(acc, m)), None
+
+            (state, acc), _ = lax.scan(body, (state, metrics_init()), xs)
+            return state, acc
+
+        def body(acc, x):
+            return _accumulate(acc, _eval_step(state, batch_of(x))), None
+
+        acc, _ = lax.scan(body, metrics_init(), xs)
+        return acc
+
+    if indexed:
+        def epoch(state, data, ticks):
+            return scan_epoch(state, lambda t: _take_batch(data, t), ticks)
+    else:
+        def epoch(state, batches):
+            return scan_epoch(state, lambda b: b, batches)
+
+    repl, _ = _shardings(mesh, axis)
+    donate = (0,) if train else ()
+    if mesh is None:
+        return jax.jit(epoch, donate_argnums=donate)
+    state_sh = repl if state_sharding is None else state_sharding
+    xs_shard = NamedSharding(mesh, P(None, axis))  # (steps, batch) prefix
+    in_sh = ((state_sh, repl, xs_shard) if indexed
+             else (state_sh, xs_shard))
+    out_sh = (state_sh, repl) if train else repl
+    return jax.jit(
+        epoch, donate_argnums=donate, in_shardings=in_sh,
+        out_shardings=out_sh,
+    )
+
+
 def make_train_epoch(
     mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None,
     grad_accum: int = 1,
@@ -183,62 +250,46 @@ def make_train_epoch(
     ``state_sharding`` overrides the replicated state layout (TP tables from
     ``parallel/tensor.py``, ZeRO-1 from ``parallel/zero.py``).
     """
-    step_fn = make_accum_train_step_fn(grad_accum)
+    return _make_epoch(mesh, axis, state_sharding,
+                       make_accum_train_step_fn(grad_accum),
+                       train=True, indexed=False)
 
-    def epoch(state, batches):
-        def body(carry, batch):
-            state, acc = carry
-            state, m = step_fn(state, batch)
-            acc = MetricState(
-                acc.loss_sum + m.loss_sum,
-                acc.correct + m.correct,
-                acc.count + m.count,
-            )
-            return (state, acc), None
 
-        (state, acc), _ = lax.scan(body, (state, metrics_init()), batches)
-        return state, acc
+def make_train_epoch_indexed(
+    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None,
+    grad_accum: int = 1,
+):
+    """Jitted ``epoch(state, data, ticks) -> (state, MetricState)`` where
+    the per-step batch is gathered ON DEVICE.
 
-    repl, _ = _shardings(mesh, axis)
-    if mesh is None:
-        return jax.jit(epoch, donate_argnums=(0,))
-    state_sh = repl if state_sharding is None else state_sharding
-    batch_shard = NamedSharding(mesh, P(None, axis))  # (steps, batch, ...) prefix
-    return jax.jit(
-        epoch,
-        donate_argnums=(0,),
-        in_shardings=(state_sh, batch_shard),
-        out_shardings=(state_sh, repl),
-    )
+    ``data`` is the whole dataset resident on device ({'image': (N, ...),
+    'label': (N,)}, replicated); ``ticks`` is {'idx': (S, B) int32,
+    'mask': (S, B)} with B sharded on the mesh. Each scan tick does a
+    ``jnp.take`` of its rows — so the dataset crosses the host boundary
+    once per RUN and the per-epoch upload is the ~KB index matrix, not a
+    full permuted copy of the dataset (the host-gather path's cost, which
+    the reference hides behind DataLoader workers,
+    ``/root/reference/multi_proc_single_gpu.py:156``). Device memory also
+    drops: one (B, ...) batch materializes per tick instead of the staged
+    (S, B, ...) epoch.
+    """
+    return _make_epoch(mesh, axis, state_sharding,
+                       make_accum_train_step_fn(grad_accum),
+                       train=True, indexed=True)
 
 
 def make_eval_epoch(
     mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
 ):
     """Jitted ``epoch(state, batches) -> MetricState`` via lax.scan."""
+    return _make_epoch(mesh, axis, state_sharding, None,
+                       train=False, indexed=False)
 
-    def epoch(state, batches):
-        def body(acc, batch):
-            m = _eval_step(state, batch)
-            return (
-                MetricState(
-                    acc.loss_sum + m.loss_sum,
-                    acc.correct + m.correct,
-                    acc.count + m.count,
-                ),
-                None,
-            )
 
-        acc, _ = lax.scan(body, metrics_init(), batches)
-        return acc
-
-    repl, _ = _shardings(mesh, axis)
-    if mesh is None:
-        return jax.jit(epoch)
-    state_sh = repl if state_sharding is None else state_sharding
-    batch_shard = NamedSharding(mesh, P(None, axis))
-    return jax.jit(
-        epoch,
-        in_shardings=(state_sh, batch_shard),
-        out_shardings=repl,
-    )
+def make_eval_epoch_indexed(
+    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
+):
+    """Jitted ``epoch(state, data, ticks) -> MetricState``, device-gather
+    twin of ``make_eval_epoch`` (see ``make_train_epoch_indexed``)."""
+    return _make_epoch(mesh, axis, state_sharding, None,
+                       train=False, indexed=True)

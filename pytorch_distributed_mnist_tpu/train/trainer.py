@@ -32,16 +32,23 @@ from __future__ import annotations
 import threading
 from typing import Optional, Tuple
 
+import numpy as np
 from jax.sharding import Mesh
 
-from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader, make_global_batch
+from pytorch_distributed_mnist_tpu.data.loader import (
+    MNISTDataLoader,
+    make_global_batch,
+    make_replicated,
+)
 from pytorch_distributed_mnist_tpu.ops.metrics import Accuracy, Average, MetricState
 from pytorch_distributed_mnist_tpu.parallel.collectives import make_explicit_dp_train_step
 from pytorch_distributed_mnist_tpu.train.state import TrainState
 from pytorch_distributed_mnist_tpu.train.steps import (
     make_eval_epoch,
+    make_eval_epoch_indexed,
     make_eval_step,
     make_train_epoch,
+    make_train_epoch_indexed,
     make_train_step,
 )
 
@@ -72,9 +79,17 @@ class Trainer:
         mode: str = "scan",
         state_sharding=None,
         grad_accum: int = 1,
+        epoch_gather: str = "host",
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
+        if epoch_gather not in ("host", "device"):
+            raise ValueError(f"unknown epoch_gather {epoch_gather!r}")
+        if epoch_gather == "device" and mode != "scan":
+            raise ValueError(
+                "epoch_gather='device' is a scan-mode path (the gather "
+                "lives inside the scanned epoch program)"
+            )
         if state_sharding is not None and mesh is None:
             raise ValueError("state_sharding requires a mesh")
         self.state = state
@@ -110,15 +125,27 @@ class Trainer:
                 mesh, state_sharding=state_sharding, grad_accum=grad_accum
             )
             self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
-        self._train_epoch = (
-            make_train_epoch(mesh, state_sharding=state_sharding,
-                             grad_accum=grad_accum)
-            if mode == "scan" else None
-        )
-        self._eval_epoch = (
-            make_eval_epoch(mesh, state_sharding=state_sharding)
-            if mode == "scan" else None
-        )
+        self.epoch_gather = epoch_gather
+        if mode == "scan" and epoch_gather == "device":
+            self._train_epoch = make_train_epoch_indexed(
+                mesh, state_sharding=state_sharding, grad_accum=grad_accum)
+            self._eval_epoch = make_eval_epoch_indexed(
+                mesh, state_sharding=state_sharding)
+        else:
+            self._train_epoch = (
+                make_train_epoch(mesh, state_sharding=state_sharding,
+                                 grad_accum=grad_accum)
+                if mode == "scan" else None
+            )
+            self._eval_epoch = (
+                make_eval_epoch(mesh, state_sharding=state_sharding)
+                if mode == "scan" else None
+            )
+        # Device-resident datasets for the device-gather path (uploaded
+        # lazily, once per run).
+        self._train_data = None
+        self._eval_data = None
+        self._eval_ticks = None
         # Epoch-gather pipelining (scan mode): (epoch, thread, holder) of a
         # background stacked_epoch() for the NEXT epoch, plus the one-time
         # device-resident eval stage. prefetch_enabled exists for the
@@ -154,7 +181,19 @@ class Trainer:
 
         Parity contract: reference ``Trainer.train`` (``:77-97``).
         """
-        if self.mode == "scan":
+        if self.mode == "scan" and self.epoch_gather == "device":
+            if self._train_data is None:
+                # The dataset crosses the host boundary exactly once.
+                self._train_data = make_replicated(
+                    {"image": self.train_loader.images,
+                     "label": self.train_loader.labels}, self.mesh)
+            idx, mask = self.train_loader._epoch_index_matrix()
+            ticks = make_global_batch(
+                {"idx": idx.astype(np.int32), "mask": mask}, self.mesh,
+                leading_replicated=True)
+            self.state, ms = self._train_epoch(
+                self.state, self._train_data, ticks)
+        elif self.mode == "scan":
             staged = None
             if self._prefetch is not None:
                 epoch, t, holder = self._prefetch
@@ -187,7 +226,18 @@ class Trainer:
         gradient, no state update. When the eval loader is sharded the
         metric reduction crosses devices inside the jitted program.
         """
-        if self.mode == "scan":
+        if self.mode == "scan" and self.epoch_gather == "device":
+            if self._eval_data is None:
+                self._eval_data = make_replicated(
+                    {"image": self.test_loader.images,
+                     "label": self.test_loader.labels}, self.mesh)
+                idx, mask = self.test_loader._epoch_index_matrix()
+                self._eval_ticks = make_global_batch(
+                    {"idx": idx.astype(np.int32), "mask": mask}, self.mesh,
+                    leading_replicated=True)
+            ms = self._eval_epoch(
+                self.state, self._eval_data, self._eval_ticks)
+        elif self.mode == "scan":
             if self._eval_staged is None:
                 # The eval sampler never reshuffles, so the stacked epoch
                 # — and its device placement — is identical every pass:

@@ -132,6 +132,7 @@ def test_main_emits_watcher_capture(tmp_path, monkeypatch, capsys):
     assert out["backend"] == "tpu"
 
 
+@pytest.mark.slow
 def test_probe_child_stepwise_cpu():
     """The probe path end-to-end in a real child process on CPU: it must
     produce a throughput number with mode=probe in well under the 360s the
@@ -148,6 +149,7 @@ def test_probe_child_stepwise_cpu():
     assert result["images_per_sec_per_chip"] > 0
 
 
+@pytest.mark.slow
 def test_compile_cache_config_plumbing(tmp_path):
     """BENCH_COMPILE_CACHE reaches jax_compilation_cache_dir in the child."""
     env = dict(os.environ, BENCH_FORCE_CPU="1",

@@ -1,0 +1,87 @@
+"""--epoch-gather device (train/steps.py make_*_epoch_indexed): the
+dataset stays device-resident and each scan tick gathers its batch with
+jnp.take — trajectories must equal the host-gather path exactly; the only
+thing that changes is what crosses the host boundary per epoch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+
+
+def _run_cli(tmp_path, tag, extra):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    return run(build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear", "--dtype", "f32",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "96",  # ragged: 96/8 devices pads eval
+        "--seed", "0", "--epochs", "2",
+        "--checkpoint-dir", str(tmp_path / tag),
+    ] + extra))
+
+
+def test_device_gather_cli_matches_host_gather(tmp_path):
+    host = _run_cli(tmp_path, "h", [])
+    dev = _run_cli(tmp_path, "d", ["--epoch-gather", "device"])
+    assert dev["history"] == host["history"]  # exact float equality
+    assert dev["best_acc"] == host["best_acc"]
+
+
+def test_device_gather_with_grad_accum_matches(tmp_path):
+    host = _run_cli(tmp_path, "ha", ["--grad-accum", "2"])
+    dev = _run_cli(tmp_path, "da", ["--grad-accum", "2",
+                                    "--epoch-gather", "device"])
+    assert dev["history"] == host["history"]
+
+
+def test_device_gather_eval_counts_each_sample_once():
+    """Padded eval ticks carry the validity mask through jnp.take: 110
+    samples at batch 20 must count 110, not 120."""
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(110, 28, 28, 1)).astype(np.float32)
+    labels = (np.arange(110) % 10).astype(np.int32)
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    train = MNISTDataLoader(images, labels, batch_size=20, train=True)
+    test = MNISTDataLoader(images, labels, batch_size=20, train=False)
+    trainer = Trainer(state, train, test, mode="scan",
+                      epoch_gather="device")
+    loss, acc = trainer.evaluate()
+    assert acc.count == 110
+    assert loss.count == 110
+
+
+def test_device_gather_requires_scan_mode(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    with pytest.raises(SystemExit, match="scan"):
+        run(build_parser().parse_args([
+            "--dataset", "synthetic", "--model", "linear",
+            "--trainer-mode", "stepwise", "--epoch-gather", "device",
+            "--checkpoint-dir", str(tmp_path),
+        ]))
+
+
+def test_dataset_uploaded_once():
+    """The resident dataset is placed on device exactly once per run."""
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(128, 28, 28, 1)).astype(np.float32)
+    labels = (np.arange(128) % 10).astype(np.int32)
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    train = MNISTDataLoader(images, labels, batch_size=32, train=True)
+    test = MNISTDataLoader(images, labels, batch_size=32, train=False)
+    trainer = Trainer(state, train, test, mode="scan",
+                      epoch_gather="device")
+    trainer.train()
+    data_id = id(trainer._train_data)
+    train.set_sample_epoch(1)
+    trainer.train()
+    assert id(trainer._train_data) == data_id

@@ -63,6 +63,7 @@ def test_trainer_modes_run(tmp_path, mode):
     assert out["epochs_run"] == 1
 
 
+@pytest.mark.slow
 def test_cnn_overfits_synthetic(tmp_path):
     out = run(make_args(tmp_path, model="cnn", epochs=8, batch_size=64, lr=1e-3,
                         synthetic_train_size=256, synthetic_test_size=128))

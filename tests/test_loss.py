@@ -212,6 +212,7 @@ def test_fused_loss_gspmd_multidevice_matches_xla(tmp_path):
             s_xla["history"][0]["test_acc"], rtol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("axis_flag", [
     ("--tensor-parallel", "2"),
     ("--sequence-parallel", "2"),
@@ -239,6 +240,7 @@ def test_fused_loss_on_tp_sp_mesh_matches_xla(tmp_path, axis_flag):
         s_xla["history"][0]["train_loss"], rtol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("extra", [
     (),                              # DP x PP
     ("--tensor-parallel", "2"),      # DP x PP x TP

@@ -65,6 +65,7 @@ def test_pp_tp_forward_matches_sequential(shape, depth):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pp_tp_grads_match_sequential():
     """Gradients through scan + ppermute + the model-axis psums equal the
     sequential model's — the Megatron partial sums transpose correctly."""
@@ -118,6 +119,7 @@ def test_pp_tp_state_actually_sharded():
     assert mu_qkv.sharding.spec == P("stage", None, None, "model", None)
 
 
+@pytest.mark.slow
 def test_pp_tp_train_step_matches_unpipelined(tiny_data):
     """One jitted train step on the PP x TP mesh == the plain model's step
     (same init, same batch): loss exact, merged gradients equal."""
@@ -146,6 +148,7 @@ def test_pp_tp_train_step_matches_unpipelined(tiny_data):
     assert float(tp_m.correct) == float(ref_m.correct)
 
 
+@pytest.mark.slow
 def test_pp_tp_zero1_composes():
     """PP x TP x ZeRO-1: the generic base_sharding path adds a data axis
     to moment leaves the TP layout left unsharded — three-strategy
@@ -191,6 +194,7 @@ def test_heads_not_divisible_raises():
         make_pipelined_tp_vit_apply(model2, mesh)
 
 
+@pytest.mark.slow
 def test_cli_pp_tp_end_to_end(tmp_path):
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 

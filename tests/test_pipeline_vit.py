@@ -61,6 +61,7 @@ def test_pipelined_forward_matches_sequential(mesh_axes, shape, data_axis,
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_train_step_matches_unpipelined(tiny_data):
     """One optimizer step through the pipeline == one step of the plain
     model (same init, same batch): gradients flow correctly through
@@ -138,6 +139,7 @@ def test_depth_not_divisible_raises():
         make_pipelined_vit_apply(model, mesh)
 
 
+@pytest.mark.slow
 def test_cli_pipeline_end_to_end(tmp_path):
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 
@@ -167,6 +169,7 @@ def test_cli_pipeline_rejects_non_vit(tmp_path):
         run(args)
 
 
+@pytest.mark.slow
 def test_pipelined_remat_same_loss_and_grads():
     """--remat through the pipeline: jax.checkpoint around each block in
     the stage scan must not change loss or gradients."""
@@ -200,6 +203,7 @@ def test_pipelined_remat_same_loss_and_grads():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_zero1_matches_pipeline_only():
     """PP x ZeRO-1: stage-sharded block moments gain a data axis; the
     training trajectory must equal the pipeline-only step."""
@@ -237,6 +241,7 @@ def test_pipeline_zero1_matches_pipeline_only():
     assert any("stage" in str(sp) and "data" in str(sp) for sp in specs)
 
 
+@pytest.mark.slow
 def test_pipeline_zero1_cli(tmp_path):
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 

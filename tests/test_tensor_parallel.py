@@ -99,6 +99,7 @@ def test_tp_eval_step_equals_single_device(batch):
     assert int(mt.correct) == int(m1.correct)
 
 
+@pytest.mark.slow
 def test_cli_tensor_parallel_end_to_end(tmp_path):
     """--tensor-parallel 2 trains the ViT through the full driver on a
     data x model mesh, matching the plain-DP run's metrics (TP is a layout
@@ -122,6 +123,7 @@ def test_cli_tensor_parallel_end_to_end(tmp_path):
         dp_summary["history"][0]["test_acc"], abs=1e-6)
 
 
+@pytest.mark.slow
 def test_cli_tensor_parallel_composes_with_zero1(tmp_path):
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 
@@ -150,6 +152,7 @@ def test_cli_tensor_parallel_rejects_non_vit(tmp_path):
         run(args)
 
 
+@pytest.mark.slow
 def test_cli_sequence_parallel_matches_dense(tmp_path):
     """--sequence-parallel 2 (ring attention) matches the dense-attention
     run's metrics: the ring is the same softmax, blockwise."""
@@ -172,6 +175,7 @@ def test_cli_sequence_parallel_matches_dense(tmp_path):
         dense["history"][0]["test_acc"], abs=1e-6)
 
 
+@pytest.mark.slow
 def test_cli_dp_tp_sp_composed(tmp_path):
     """The full 3-axis mesh (data x model x seq) trains from the CLI."""
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
@@ -201,6 +205,7 @@ def test_cli_sequence_parallel_rejects_indivisible_tokens(tmp_path):
         run(args)
 
 
+@pytest.mark.slow
 def test_cli_ulysses_matches_dense(tmp_path):
     """--sequence-parallel-impl ulysses (all_to_all head sharding) matches
     the dense run's metrics, same contract as the ring."""

@@ -46,6 +46,7 @@ def test_vit_trains_single_device():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_vit_trains_with_ring_attention():
     """Gradients flow through shard_map+ppermute: a ring-attention ViT train
     step runs and matches the dense-attention step's loss on same params."""
@@ -72,6 +73,7 @@ def test_vit_trains_with_ring_attention():
     assert np.isfinite(float(mr.loss_sum))
 
 
+@pytest.mark.slow
 def test_vit_ring_attention_forward_matches_dense():
     """Same params, dense vs ring attention_fn: identical logits."""
     mesh = make_mesh(("seq",))
@@ -88,6 +90,7 @@ def test_vit_ring_attention_forward_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_remat_same_params_loss_and_grads():
     """nn.remat(TransformerBlock) must be a pure memory/FLOPs trade:
     identical param structure, identical forward, identical gradients."""
@@ -116,6 +119,7 @@ def test_remat_same_params_loss_and_grads():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_remat_cli(tmp_path):
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 
@@ -140,6 +144,7 @@ def test_remat_wrong_model_errors(tmp_path):
         ]))
 
 
+@pytest.mark.slow
 def test_ulysses_flash_cli(tmp_path):
     """--sequence-parallel-impl ulysses --attention flash end-to-end."""
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
@@ -168,6 +173,7 @@ def test_ring_flash_cli_still_rejected(tmp_path):
         ]))
 
 
+@pytest.mark.slow
 def test_tp_flash_cli(tmp_path):
     """--tensor-parallel 2 --attention flash end-to-end (sharded kernel)."""
     from pytorch_distributed_mnist_tpu.cli import build_parser, run

@@ -60,6 +60,7 @@ def test_moments_are_sharded_params_replicated(mesh8):
         assert "data" in tuple(spec), (name, spec)
 
 
+@pytest.mark.slow
 def test_zero1_step_matches_replicated(mesh8):
     """3 sharded-optimizer steps == 3 replicated steps, bitwise-tolerance."""
     model = get_model("cnn")
@@ -230,6 +231,7 @@ def test_zero3_actually_shards_params(mesh8):
     assert mu.sharding.spec != P()
 
 
+@pytest.mark.slow
 def test_cli_zero3_end_to_end(tmp_path):
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 

@@ -30,6 +30,27 @@ def test_cnn_trains_on_tpu(tmp_path):
     assert (tmp_path / "ckpt" / "model_best.npz").exists()
 
 
+def test_device_gather_on_tpu(tmp_path):
+    """--epoch-gather device on silicon: the dataset stays resident in HBM
+    and each scan tick gathers with jnp.take; per-epoch host traffic drops
+    to the index matrix. Trajectory must match the host-gather run
+    exactly (same programs, same data — tests/test_device_gather.py pins
+    this on CPU; here we pin it through the tunnel)."""
+    common = [
+        "--dataset", "synthetic", "--model", "cnn", "--epochs", "2",
+        "--batch-size", "512", "--synthetic-train-size", "4096",
+        "--synthetic-test-size", "1024", "--seed", "1",
+        "--root", str(tmp_path / "data"),
+    ]
+    host = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "h")]))
+    dev = run(build_parser().parse_args(
+        common + ["--checkpoint-dir", str(tmp_path / "d"),
+                  "--epoch-gather", "device"]))
+    assert dev["history"] == host["history"]
+    assert dev["images_per_sec_per_chip"] > 10_000
+
+
 def test_all_first_party_kernels_train_on_tpu(tmp_path):
     """One run exercising every first-party Pallas kernel in the real
     training loop on silicon: fused cross-entropy (--loss fused) and the

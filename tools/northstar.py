@@ -72,6 +72,11 @@ def main() -> None:
         "--checkpoint-dir", os.path.join(args.root, "northstar_ckpt"),
         "--synthetic-train-size", str(args.synthetic_train_size),
         "--synthetic-test-size", str(args.synthetic_test_size),
+        # Device-resident dataset + in-program gather: per-epoch host work
+        # drops to a ~KB index upload (trajectory-identical to the host
+        # path, tests/test_device_gather.py) — wall-clock-to-target is
+        # this measurement's whole point.
+        "--epoch-gather", "device",
     ]
     if args.download:
         cli_args.append("--download")
