@@ -86,8 +86,11 @@ class MNISTDataLoader:
         n = len(self.sampler)
         return n // self.local_batch_size if self.drop_last else -(-n // self.local_batch_size)
 
-    def _epoch_index_matrix(self, epoch: Optional[int] = None):
-        """(steps, local_batch) index matrix + 0/1 validity mask.
+    def epoch_ticks(self, epoch: Optional[int] = None):
+        """(steps, local_batch) int index matrix + 0/1 validity mask —
+        the public index-space form of an epoch, consumed by the
+        device-gather path (``train/steps.py make_train_epoch_indexed``)
+        the way ``stacked_epoch`` serves the host-gather path.
 
         Padding (wrapping from the front) keeps shapes static for XLA; the
         mask marks padded positions so metrics never double-count them.
@@ -106,7 +109,7 @@ class MNISTDataLoader:
         return idx[:need].reshape(shape), mask.reshape(shape)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        m, mask = self._epoch_index_matrix()
+        m, mask = self.epoch_ticks()
         for row, mrow in zip(m, mask):
             yield {"image": self.images[row], "label": self.labels[row], "mask": mrow}
 
@@ -125,7 +128,7 @@ class MNISTDataLoader:
         """
         from pytorch_distributed_mnist_tpu.data import native
 
-        m, mask = self._epoch_index_matrix(epoch)
+        m, mask = self.epoch_ticks(epoch)
         if self.images.dtype == np.float32 and native.available():
             got = native.gather_epoch(self.images, self.labels, m, self.workers)
             if got is not None:

@@ -281,15 +281,12 @@ def make_train_epoch_indexed(
 def make_eval_epoch(
     mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
 ):
-    """Jitted ``epoch(state, batches) -> MetricState`` via lax.scan."""
+    """Jitted ``epoch(state, batches) -> MetricState`` via lax.scan.
+
+    No device-gather twin on purpose: the eval set never reshuffles, so
+    the Trainer stages its sharded epoch on device once and reuses it —
+    already zero per-pass host work, without replicating the test set
+    into every device's HBM the way a resident-dataset gather would.
+    """
     return _make_epoch(mesh, axis, state_sharding, None,
                        train=False, indexed=False)
-
-
-def make_eval_epoch_indexed(
-    mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None
-):
-    """Jitted ``epoch(state, data, ticks) -> MetricState``, device-gather
-    twin of ``make_eval_epoch`` (see ``make_train_epoch_indexed``)."""
-    return _make_epoch(mesh, axis, state_sharding, None,
-                       train=False, indexed=True)

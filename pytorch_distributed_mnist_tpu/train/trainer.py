@@ -45,7 +45,6 @@ from pytorch_distributed_mnist_tpu.parallel.collectives import make_explicit_dp_
 from pytorch_distributed_mnist_tpu.train.state import TrainState
 from pytorch_distributed_mnist_tpu.train.steps import (
     make_eval_epoch,
-    make_eval_epoch_indexed,
     make_eval_step,
     make_train_epoch,
     make_train_epoch_indexed,
@@ -129,23 +128,23 @@ class Trainer:
         if mode == "scan" and epoch_gather == "device":
             self._train_epoch = make_train_epoch_indexed(
                 mesh, state_sharding=state_sharding, grad_accum=grad_accum)
-            self._eval_epoch = make_eval_epoch_indexed(
-                mesh, state_sharding=state_sharding)
         else:
             self._train_epoch = (
                 make_train_epoch(mesh, state_sharding=state_sharding,
                                  grad_accum=grad_accum)
                 if mode == "scan" else None
             )
-            self._eval_epoch = (
-                make_eval_epoch(mesh, state_sharding=state_sharding)
-                if mode == "scan" else None
-            )
-        # Device-resident datasets for the device-gather path (uploaded
-        # lazily, once per run).
+        # Eval always uses the one-time device staging (_eval_staged):
+        # the eval sampler never reshuffles, so the sharded staged epoch
+        # already has zero per-pass host work — a device-gather eval would
+        # only replicate the test set into every device's HBM for nothing.
+        self._eval_epoch = (
+            make_eval_epoch(mesh, state_sharding=state_sharding)
+            if mode == "scan" else None
+        )
+        # Device-resident train dataset for the device-gather path
+        # (uploaded lazily, once per run).
         self._train_data = None
-        self._eval_data = None
-        self._eval_ticks = None
         # Epoch-gather pipelining (scan mode): (epoch, thread, holder) of a
         # background stacked_epoch() for the NEXT epoch, plus the one-time
         # device-resident eval stage. prefetch_enabled exists for the
@@ -187,7 +186,7 @@ class Trainer:
                 self._train_data = make_replicated(
                     {"image": self.train_loader.images,
                      "label": self.train_loader.labels}, self.mesh)
-            idx, mask = self.train_loader._epoch_index_matrix()
+            idx, mask = self.train_loader.epoch_ticks()
             ticks = make_global_batch(
                 {"idx": idx.astype(np.int32), "mask": mask}, self.mesh,
                 leading_replicated=True)
@@ -226,18 +225,7 @@ class Trainer:
         gradient, no state update. When the eval loader is sharded the
         metric reduction crosses devices inside the jitted program.
         """
-        if self.mode == "scan" and self.epoch_gather == "device":
-            if self._eval_data is None:
-                self._eval_data = make_replicated(
-                    {"image": self.test_loader.images,
-                     "label": self.test_loader.labels}, self.mesh)
-                idx, mask = self.test_loader._epoch_index_matrix()
-                self._eval_ticks = make_global_batch(
-                    {"idx": idx.astype(np.int32), "mask": mask}, self.mesh,
-                    leading_replicated=True)
-            ms = self._eval_epoch(
-                self.state, self._eval_data, self._eval_ticks)
-        elif self.mode == "scan":
+        if self.mode == "scan":
             if self._eval_staged is None:
                 # The eval sampler never reshuffles, so the stacked epoch
                 # — and its device placement — is identical every pass:

@@ -43,6 +43,34 @@ import numpy as np
 import pytest
 
 
+def pytest_collection_modifyitems(config, items):
+    """Default fast profile: deselect ``@pytest.mark.slow`` unless the
+    caller passed ``-m`` (their expression wins) or named a test
+    explicitly by node id (``pytest tests/x.py::test_y`` must run it, not
+    report '1 deselected' and exit green having run nothing — the failure
+    mode an ``addopts = -m 'not slow'`` filter has)."""
+    if config.option.markexpr:
+        return
+    named = []
+    for arg in config.invocation_params.args:
+        if "::" in str(arg):
+            a = str(arg)
+            # Normalize to the rootdir-relative node id form.
+            tail = a[a.index("tests/"):] if "tests/" in a else a
+            named.append(tail)
+    kept, dropped = [], []
+    for item in items:
+        if "slow" in item.keywords and not any(
+                item.nodeid == n or item.nodeid.startswith(n + "::")
+                or n.startswith(item.nodeid) for n in named):
+            dropped.append(item)
+        else:
+            kept.append(item)
+    if dropped:
+        config.hook.pytest_deselected(items=dropped)
+        items[:] = kept
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import jax

@@ -1,0 +1,33 @@
+"""tools/bench_kernels.py rot guard: the MXU-bound kernel benchmark must
+always produce its JSON (the watcher runs it unattended the moment the
+chip answers — a bitrotted tool would silently burn that rare window).
+Perf numbers are meaningless on CPU; only the harness contract is pinned.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_kernels_quick_emits_json():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COMPILE_CACHE="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_kernels.py"),
+         "--quick", "--reps", "1", "--iters", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    out = json.loads(line)
+    assert out["metric"] == "pallas_kernel_vs_xla"
+    assert "attention_error" not in out, out
+    assert "adam_error" not in out, out
+    rows = out["attention_fwd_bwd"]
+    assert len(rows) == 2 and all(r["flash_ms"] > 0 for r in rows)
+    assert out["adam_update"]["n_params"] > 0

@@ -42,8 +42,9 @@ def test_device_gather_with_grad_accum_matches(tmp_path):
 
 
 def test_device_gather_eval_counts_each_sample_once():
-    """Padded eval ticks carry the validity mask through jnp.take: 110
-    samples at batch 20 must count 110, not 120."""
+    """Eval under device mode uses the one-time staged path (the eval set
+    never reshuffles; device-gathering it would only replicate the test
+    set across HBM) — padding must still count 110 of 110, not 120."""
     rng = np.random.default_rng(0)
     images = rng.normal(size=(110, 28, 28, 1)).astype(np.float32)
     labels = (np.arange(110) % 10).astype(np.int32)

@@ -10,10 +10,25 @@ Run with:  python -m pytest tests_tpu/ -q
 Skips cleanly (doesn't fail) when no TPU backend is reachable.
 """
 
+import os
 import subprocess
 import sys
 
 import pytest
+
+# Share the repo's persistent XLA compile cache (same dir bench.py and
+# tools/tpu_watch.sh use): the watcher's capture run warms it, and this
+# suite's on-chip compiles (minutes through the tunnel) amortize across
+# sessions instead of re-paying every time the chip answers.
+_cache = os.environ.get(
+    "BENCH_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".xla_cache"))
+if _cache:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 _PROBE = (
     # Listing devices is not enough: a wedged tunnel can enumerate the
