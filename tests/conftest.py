@@ -14,9 +14,18 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
+    xla_flags = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in xla_flags:
+    # 8 virtual devices timeshare this host's SINGLE core: XLA:CPU's
+    # default 40s in-process collective rendezvous termination can fire
+    # from pure scheduling starvation (observed: collective-permute
+    # rendezvous abort, 5 of 8 threads arrived, same program passes when
+    # the core is idle). Starvation is not deadlock — give it time.
+    xla_flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=600"
+                  " --xla_cpu_collective_timeout_seconds=600")
+os.environ["XLA_FLAGS"] = xla_flags
 
 import jax
 
@@ -62,7 +71,8 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords and not any(
                 item.nodeid == n or item.nodeid.startswith(n + "::")
-                or n.startswith(item.nodeid) for n in named):
+                or item.nodeid.startswith(n + "[")  # param id omitted
+                for n in named):
             dropped.append(item)
         else:
             kept.append(item)

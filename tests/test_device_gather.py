@@ -4,10 +4,14 @@ jnp.take — trajectories must equal the host-gather path exactly; the only
 thing that changes is what crosses the host boundary per epoch.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
 from pytorch_distributed_mnist_tpu.models import get_model
@@ -32,6 +36,59 @@ def test_device_gather_cli_matches_host_gather(tmp_path):
     dev = _run_cli(tmp_path, "d", ["--epoch-gather", "device"])
     assert dev["history"] == host["history"]  # exact float equality
     assert dev["best_acc"] == host["best_acc"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    ("--model", "vit", "--pipeline-stages", "2"),
+    ("--model", "vit", "--pipeline-stages", "2", "--tensor-parallel", "2"),
+])
+def test_device_gather_on_pipeline_meshes_matches(tmp_path, extra):
+    """The indexed epoch program composes with the pipeline layouts: the
+    resident dataset is replicated over stage/model axes, the tick matrix
+    shards on data, and the GPipe (x Megatron) apply runs per tick —
+    trajectory equal to the host-gather run.
+
+    Runs in a CHILD process with the persistent compile cache disabled:
+    reloading this pair of collective programs (ppermute + all-reduce)
+    from the cache trips an XLA:CPU AOT-deserialization deadlock
+    ('only 5 of 8 threads arrived' in the collective-permute rendezvous,
+    SIGABRT; fresh compiles of the same HLO always pass — observed
+    2026-07-30, jaxlib 0.9.0, 8 virtual devices). Fresh-compiling in a
+    child keeps the equivalence coverage without importing the bug into
+    the suite process.
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_distributed_mnist_tpu.cli import build_parser, run\n"
+        "common = ['--dataset', 'synthetic', '--batch-size', '64',\n"
+        "          '--synthetic-train-size', '256',\n"
+        "          '--synthetic-test-size', '64', '--seed', '0',\n"
+        "          '--epochs', '1'] + %r\n"
+        "host = run(build_parser().parse_args(\n"
+        "    common + ['--checkpoint-dir', %r]))\n"
+        "dev = run(build_parser().parse_args(\n"
+        "    common + ['--checkpoint-dir', %r,\n"
+        "              '--epoch-gather', 'device']))\n"
+        "assert dev['history'] == host['history'], (dev, host)\n"
+        "print('EQUAL')\n"
+    ) % (list(extra), str(tmp_path / "h"), str(tmp_path / "d"))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        JAX_COMPILATION_CACHE_DIR="",
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=700)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "EQUAL" in proc.stdout
 
 
 def test_device_gather_with_grad_accum_matches(tmp_path):
