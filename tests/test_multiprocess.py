@@ -158,17 +158,20 @@ def test_env_detection(monkeypatch, var, value, expect):
 
 
 @pytest.mark.slow
-def test_spawn_launcher_cli(tmp_path, capfd):
-    """``tpu-mnist --spawn 2``: the reference's mp.spawn mode (:284-285) as
-    a flag. main() forks 2 local host processes that rendezvous on a free
-    loopback port and run the full driver; rc 0 means both ranks trained,
-    reduced metrics, and rank 0 wrote the checkpoints."""
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_spawn_launcher_cli(tmp_path, capfd, nprocs):
+    """``tpu-mnist --spawn N``: the reference's mp.spawn mode (:284-285) as
+    a flag. main() forks N local host processes that rendezvous on a free
+    loopback port and run the full driver; rc 0 means every rank trained,
+    reduced metrics, and rank 0 wrote the checkpoints. N=4 exercises a
+    wider world than the 2-process tests above — 4-way disjoint sampler
+    shards, 4-participant collectives over the loopback coordinator."""
     from pytorch_distributed_mnist_tpu.cli import main
 
     ckpt = str(tmp_path / "ckpts")
     with pytest.raises(SystemExit) as exc:
         main([
-            "--spawn", "2",
+            "--spawn", str(nprocs),
             "--dataset", "synthetic", "--model", "linear",
             "--epochs", "1", "--batch-size", "64",
             "--synthetic-train-size", "256", "--synthetic-test-size", "128",
