@@ -150,6 +150,27 @@ def test_probe_child_stepwise_cpu():
 
 
 @pytest.mark.slow
+def test_secondary_measurements_plumbing_cpu():
+    """The fused-kernels and device-gather secondaries end-to-end on CPU
+    (BENCH_FORCE_SECONDARIES): a broken secondary otherwise surfaces only
+    as a silent *_error field during the chip's rare capture windows —
+    exactly how a fused-path TypeError hid through round 2."""
+    env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_FORCE_SECONDARIES="1",
+               BENCH_COMPILE_CACHE="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child", "1", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    result = json.loads(line)
+    assert result["ok"], result
+    assert "fused_kernels_error" not in result, result
+    assert "device_gather_error" not in result, result
+    assert result["images_per_sec_per_chip_fused_kernels"] > 0
+    assert result["images_per_sec_per_chip_device_gather"] > 0
+
+
+@pytest.mark.slow
 def test_compile_cache_config_plumbing(tmp_path):
     """BENCH_COMPILE_CACHE reaches jax_compilation_cache_dir in the child."""
     env = dict(os.environ, BENCH_FORCE_CPU="1",
