@@ -828,7 +828,8 @@ def run(args, epoch_callback=None) -> dict:
         test_loss, test_acc = trainer.evaluate()
         log0(f"Test Loss: {test_loss}, Test Acc: {test_acc}")
         return {"test_loss": test_loss.average, "test_acc": test_acc.accuracy,
-                "best_acc": best_acc, "epochs_run": 0}
+                "best_acc": best_acc, "start_epoch": start_epoch,
+                "epochs_run": 0}
 
     timer = StepTimer()
     history = []
