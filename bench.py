@@ -482,7 +482,8 @@ def bench_torch_reference() -> float:
             x = x.flatten(1)
             return self.fc2(F.relu(self.fc1(x)))
 
-    model = Net()
+    torch.manual_seed(0)  # same weights/data every run: the baseline-side
+    model = Net()         # contribution to vs_baseline stays stable
     opt = torch.optim.Adam(model.parameters(), lr=1e-3)
     bs = 256
     data = torch.randn(bs, 1, 28, 28)
