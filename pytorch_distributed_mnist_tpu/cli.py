@@ -914,6 +914,12 @@ def run(args, epoch_callback=None) -> dict:
     return {"best_acc": best_acc, "history": history,
             "images_per_sec": ips,
             "images_per_sec_per_chip": timer.images_per_sec_per_chip,
+            # Final epoch's rate: steady-state throughput once the epoch
+            # program is compiled (the cumulative figure above folds epoch
+            # 0's compile into the denominator — on a 2-epoch smoke run
+            # that understates a v5e by ~500x).
+            "images_per_sec_per_chip_last_epoch":
+                timer.last_images_per_sec_per_chip,
             "dataset_synthesized": dataset_synthesized,
             "start_epoch": start_epoch,
             "epochs_run": len(history)}

@@ -112,11 +112,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
 
 
-def _block_sizes(t: int):
+def _block_sizes(t: int, block: int | None = None):
     # Pad T up to a tile-friendly block multiple (never shrink the block to
     # a divisor of T — a prime T would degrade to block 1); padded K
     # positions are masked inside the kernels, padded Q rows sliced off.
-    block = 128 if t >= 128 else ((t + 7) // 8) * 8
+    # Block choice: 128 matches the MXU tile; the 256-at-long-T default
+    # is a HYPOTHESIS (bigger tiles amortize loop/pipeline overhead;
+    # s/p scratch grows as block^2 f32 — 256 is 256 KB, well inside
+    # VMEM) motivated by the measured 0.86x-vs-dense at T=4096 with the
+    # old fixed 128 tile (tools/captured/kernels.json, 2026-07-31). The
+    # on-chip sweep (tools/sweep_flash.py, queued in the follow-up
+    # watcher) decides it; revisit this default when flash_sweep.json
+    # lands.
+    if block is None:
+        block = 256 if t >= 2048 else 128 if t >= 128 else ((t + 7) // 8) * 8
     t_pad = ((t + block - 1) // block) * block
     return block, t_pad
 
@@ -133,9 +142,10 @@ def _from_heads(x, b, t, h, d):
     return x[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _flash_forward(q, k, v, causal: bool, scale: float, interpret: bool):
+def _flash_forward(q, k, v, causal: bool, scale: float, interpret: bool,
+                   block_override: int | None = None):
     b, t, h, d = q.shape
-    block, t_pad = _block_sizes(t)
+    block, t_pad = _block_sizes(t, block_override)
     qh = _to_heads(q, b, t, h, d, t_pad)
     kh = _to_heads(k, b, t, h, d, t_pad)
     vh = _to_heads(v, b, t, h, d, t_pad)
@@ -260,9 +270,9 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o_heads, lse, g, causal: bool, scale: float,
-                    interpret: bool):
+                    interpret: bool, block_override: int | None = None):
     b, t, h, d = q.shape
-    block, t_pad = _block_sizes(t)
+    block, t_pad = _block_sizes(t, block_override)
     qh = _to_heads(q, b, t, h, d, t_pad)
     kh = _to_heads(k, b, t, h, d, t_pad)
     vh = _to_heads(v, b, t, h, d, t_pad)
@@ -322,23 +332,24 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
-    out, _, _ = _flash_forward(q, k, v, causal, scale, _interpret_default())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, block):
+    out, _, _ = _flash_forward(
+        q, k, v, causal, scale, _interpret_default(), block)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, causal, scale, block):
     out, o_heads, lse = _flash_forward(
-        q, k, v, causal, scale, _interpret_default()
+        q, k, v, causal, scale, _interpret_default(), block
     )
     return out, (q, k, v, o_heads, lse)
 
 
-def _flash_bwd(causal, scale, residuals, g):
+def _flash_bwd(causal, scale, block, residuals, g):
     q, k, v, o_heads, lse = residuals
     return _flash_backward(
-        q, k, v, o_heads, lse, g, causal, scale, _interpret_default()
+        q, k, v, o_heads, lse, g, causal, scale, _interpret_default(), block
     )
 
 
@@ -346,7 +357,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    scale: float | None = None):
+                    scale: float | None = None, block: int | None = None):
     """Flash attention on ``(B, T, H, D)``; drop-in for ``full_attention``.
 
     Fully differentiable with fused Pallas forward and backward kernels
@@ -354,6 +365,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     interpreter mode so tests are hermetic. Self-attention shapes only:
     Tq must equal Tk (the kernel's start-aligned causal mask and the dense
     oracle's end-aligned mask agree exactly there).
+
+    ``block`` overrides the q/k tile edge (multiple of 8; default is the
+    measured length-dependent heuristic in ``_block_sizes`` — exposed for
+    the on-chip sweep, tools/sweep_flash.py).
     """
     if q.shape[1] != k.shape[1]:
         raise ValueError(
@@ -361,9 +376,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
             f"Tq={q.shape[1]}, Tk={k.shape[1]} — use full_attention for "
             f"cross-attention shapes"
         )
+    if block is not None and (block < 8 or block % 8):
+        raise ValueError(f"block must be a multiple of 8, got {block}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash(q, k, v, causal, float(scale))
+    return _flash(q, k, v, causal, float(scale), block)
 
 
 def sharded_flash_attention(q, k, v, *, mesh, batch_axis=None,

@@ -70,6 +70,10 @@ class StepTimer:
         return self.last_images / max(self.last_seconds, 1e-9)
 
     @property
+    def last_images_per_sec_per_chip(self) -> float:
+        return self.last_images_per_sec / self.num_chips
+
+    @property
     def steps_per_sec(self) -> float:
         return self.steps / max(self.elapsed, 1e-9)
 

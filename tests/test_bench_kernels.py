@@ -31,3 +31,24 @@ def test_bench_kernels_quick_emits_json():
     rows = out["attention_fwd_bwd"]
     assert len(rows) == 2 and all(r["flash_ms"] > 0 for r in rows)
     assert out["adam_update"]["n_params"] > 0
+
+
+@pytest.mark.slow
+def test_sweep_flash_quick_emits_json():
+    """Same rot guard for the flash block-size sweep: the follow-up
+    watcher runs it unattended in a rare chip-recovery window, and it
+    imports across modules by path hack (bench.configure_jax,
+    bench_kernels._timeit) — drift there must fail here, not there."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COMPILE_CACHE="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep_flash.py"),
+         "--quick", "--reps", "1", "--iters", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    out = json.loads(line)
+    assert out["metric"] == "flash_block_sweep_fwd_bwd"
+    (row,) = out["rows"]
+    assert row["dense_ms"] > 0 and row["flash_b32_ms"] > 0
+    assert "flash_b32_speedup" in row

@@ -129,6 +129,34 @@ def test_flash_attention_grad_matches_dense(causal, t):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("block", [64, 256])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_block_override(block, causal):
+    """Numerics are block-size invariant (fwd AND bwd): the ``block``
+    override exists so tools/sweep_flash.py can tune the tile edge on
+    chip — any size must produce the same attention, including when the
+    block exceeds T (256 > 192: single padded tile) and when it divides
+    T unevenly (64 into 192)."""
+    t = 192
+    ks = jax.random.split(jax.random.key(9), 3)
+    q, k, v = (jax.random.normal(kk, (1, t, 2, 16), jnp.float32) for kk in ks)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block=block) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    out = flash_attention(q, k, v, causal=causal, block=block)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.parametrize("t", [49, 200])
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_grad_unaligned_lengths(t, causal):

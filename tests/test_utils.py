@@ -30,6 +30,8 @@ def test_step_timer_counts_only_measured_phases():
     assert t.images == 2000 and t.steps == 2
     assert t.images_per_sec == pytest.approx(2000 / t.elapsed)
     assert t.images_per_sec_per_chip == pytest.approx(t.images_per_sec / 2)
+    assert t.last_images_per_sec_per_chip == pytest.approx(
+        t.last_images_per_sec / 2)
 
 
 def test_step_timer_last_phase_rate_is_not_cumulative():

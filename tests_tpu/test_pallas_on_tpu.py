@@ -96,4 +96,11 @@ def test_fused_xent_on_tpu_matches_oracle():
         assert float(jnp.max(jnp.abs(got - want))) < 1e-5
         dl_want = vjp_o(g)[0]
         dl_got = vjp_k(g)[0]
-        assert float(jnp.max(jnp.abs(dl_got - dl_want))) < 1e-5
+        # Backward tolerance is wider than interpret mode's 1e-5
+        # (tests/test_pallas_kernels.py): the kernel computes softmax as
+        # one exp(l - lse) while the oracle's autodiff divides
+        # exp(l - m) by the saved sum, and the chip's f32 transcendental
+        # rounding differs from the host's — measured max divergence
+        # 9.5e-5 on these x5-scaled logits (2026-07-31), algorithmic
+        # regressions are caught at 1e-5 hermetically.
+        assert float(jnp.max(jnp.abs(dl_got - dl_want))) < 2e-4

@@ -25,8 +25,11 @@ def test_cnn_trains_on_tpu(tmp_path):
     assert summary["history"][-1]["test_acc"] > 0.5
     # chip-scale throughput: even through the tunnel the v5e does
     # hundreds of thousands of images/sec; 10k is a generous floor that
-    # still catches a silent CPU fallback (~10-1000 img/s).
-    assert summary["images_per_sec_per_chip"] > 10_000
+    # still catches a silent CPU fallback (~10-1000 img/s). Assert on the
+    # LAST epoch's rate: this smoke run is 8 steps/epoch, so the
+    # cumulative figure is ~95% epoch-0 compile time (measured 661 img/s
+    # on a chip benching 375k — the 2026-07-31 capture).
+    assert summary["images_per_sec_per_chip_last_epoch"] > 10_000
     assert (tmp_path / "ckpt" / "model_best.npz").exists()
 
 
@@ -48,7 +51,7 @@ def test_device_gather_on_tpu(tmp_path):
         common + ["--checkpoint-dir", str(tmp_path / "d"),
                   "--epoch-gather", "device"]))
     assert dev["history"] == host["history"]
-    assert dev["images_per_sec_per_chip"] > 10_000
+    assert dev["images_per_sec_per_chip_last_epoch"] > 10_000
 
 
 def test_all_first_party_kernels_train_on_tpu(tmp_path):

@@ -1,0 +1,91 @@
+"""On-chip flash-attention block-size sweep.
+
+The round-3 kernel capture (tools/captured/kernels.json, 2026-07-31)
+showed flash beating dense XLA attention at T=1024 (1.31x) but trailing
+at T=4096 (0.86x) with the then-fixed 128 tile: 32 small fori_loop
+matmuls per q-block cannot match one huge fused XLA matmul when the
+(T, T) scores still fit HBM comfortably. ``flash_attention(block=...)``
+now exposes the tile edge; this sweep measures fwd+bwd wall-clock per
+(T, block) pair against the dense path so ``_block_sizes``'s heuristic
+is a measured choice, not a guess (the hermetic suite pins numerics for
+non-default blocks — tests/test_pallas_kernels.py
+``test_flash_attention_block_override``).
+
+Prints ONE JSON line; run on chip (the follow-up watcher invokes it
+after the northstar warm rerun).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # tools/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--quick", action="store_true",
+                   help="tiny shapes for the hermetic CPU smoke test")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import configure_jax
+    from bench_kernels import _timeit
+    from pytorch_distributed_mnist_tpu.ops.attention import full_attention
+    from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+
+    configure_jax(jax)
+    device = jax.devices()[0]
+
+    # Same constant ~8k-token budget as bench_kernels.py so rows are
+    # directly comparable with the captured kernels.json.
+    configs = [(64, 2)] if args.quick else [(1024, 8), (2048, 4), (4096, 2)]
+    blocks = [32] if args.quick else [128, 256, 512]
+    heads, dim = (2, 16) if args.quick else (8, 128)
+
+    def make_grad(attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    rows = []
+    for t, b in configs:
+        kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+        shape = (b, t, heads, dim)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        dense_s = _timeit(make_grad(full_attention), (q, k, v),
+                          args.reps, args.iters)
+        row = {"seq_len": t, "batch": b, "dense_ms": round(dense_s * 1e3, 3)}
+        for blk in blocks:
+            if blk > ((t + 7) // 8) * 8:
+                continue
+            fn = make_grad(
+                functools.partial(flash_attention, block=blk))
+            s = _timeit(fn, (q, k, v), args.reps, args.iters)
+            row[f"flash_b{blk}_ms"] = round(s * 1e3, 3)
+            row[f"flash_b{blk}_speedup"] = round(dense_s / s, 3)
+        rows.append(row)
+
+    print(json.dumps({
+        "metric": "flash_block_sweep_fwd_bwd",
+        "backend": device.platform,
+        "device_kind": device.device_kind,
+        "heads": heads, "head_dim": dim,
+        "quick": args.quick,
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

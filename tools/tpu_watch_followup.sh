@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-3 follow-up capture: the main watcher (tools/tpu_watch.sh) already
+# landed bench.json + northstar.json + kernels.json in the 03:48Z recovery
+# window; the link wedged again before (a) the tests_tpu suite could re-run
+# with the session's test fixes and (b) a warm-compile-cache north-star
+# could demonstrate the steady-state (sub-60s) figure. Poll for the next
+# recovery and capture exactly those two, then exit. Safe to re-run.
+set -u
+OUT=/root/repo/tools/captured
+mkdir -p "$OUT"
+export BENCH_COMPILE_CACHE=/root/repo/.xla_cache
+while true; do
+  if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) TPU alive - followup capturing" >> "$OUT/watch.log"
+    # Wait out any hermetic-suite run: this host has ONE core, and a
+    # concurrent pytest would pollute the wall-clock measurements below.
+    for _ in $(seq 1 60); do
+      pgrep -f "pytest /root/repo/tests/" >/dev/null 2>&1 || \
+        pgrep -f "pytest tests/" >/dev/null 2>&1 || break
+      sleep 30
+    done
+    # Separate file: tests_tpu.log is the 03:48Z capture BASELINE.md
+    # cites (6/9 pre-fix); the re-run must not overwrite that evidence.
+    timeout 1800 python -m pytest /root/repo/tests_tpu/ -q \
+      > "$OUT/tests_tpu_rerun.log" 2>&1
+    TT_RC=$?
+    echo "$(date -u +%FT%TZ) followup tests_tpu rc=$TT_RC (tests_tpu_rerun.log)" >> "$OUT/watch.log"
+    # Warm-cache north star: same config as the cold capture; the compile
+    # cache persisted from the 03:48Z run, so this measures the wall-clock
+    # a user's SECOND run experiences (the cold figure stays in
+    # northstar.json — the two are labelled, never conflated).
+    timeout 1800 python /root/repo/tools/northstar.py \
+      --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
+      --compile-cache "$BENCH_COMPILE_CACHE" \
+      --root /tmp/ns_tpu_warm > "$OUT/northstar_warm.json.new" 2>> "$OUT/watch.log"
+    NS_RC=$?
+    if [ "$NS_RC" -eq 0 ]; then
+      mv "$OUT/northstar_warm.json.new" "$OUT/northstar_warm.json"
+    else
+      cat "$OUT/northstar_warm.json.new" >> "$OUT/watch.log" 2>/dev/null
+      rm -f "$OUT/northstar_warm.json.new"
+    fi
+    # Flash block-size sweep (fwd+bwd, T in {1k,2k,4k} x block in
+    # {128,256,512} vs dense): the data that turns _block_sizes's
+    # length-dependent heuristic into a measured choice.
+    timeout 1800 python /root/repo/tools/sweep_flash.py \
+      > "$OUT/flash_sweep.json.new" 2>> "$OUT/watch.log"
+    FS_RC=$?
+    if [ "$FS_RC" -eq 0 ]; then
+      mv "$OUT/flash_sweep.json.new" "$OUT/flash_sweep.json"
+    else
+      cat "$OUT/flash_sweep.json.new" >> "$OUT/watch.log" 2>/dev/null
+      rm -f "$OUT/flash_sweep.json.new"
+    fi
+    echo "$(date -u +%FT%TZ) followup done tests_tpu_rc=$TT_RC northstar_warm_rc=$NS_RC flash_sweep_rc=$FS_RC" >> "$OUT/watch.log"
+    git -C /root/repo add tools/captured \
+      && git -C /root/repo commit -q \
+        -m "tools/captured: followup capture tests_tpu rc=$TT_RC, warm northstar rc=$NS_RC, flash sweep rc=$FS_RC" \
+        -- tools/captured >> "$OUT/watch.log" 2>&1
+    if [ "$TT_RC" -ne 0 ] || [ "$NS_RC" -ne 0 ] || [ "$FS_RC" -ne 0 ]; then
+      echo "$(date -u +%FT%TZ) followup INCOMPLETE - will retry" >> "$OUT/watch.log"
+      sleep 300
+      continue
+    fi
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) tpu still down (followup)" >> "$OUT/watch.log"
+  sleep 300
+done
