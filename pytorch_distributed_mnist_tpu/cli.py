@@ -56,7 +56,10 @@ from pytorch_distributed_mnist_tpu.parallel.distributed import (
     process_count,
     process_index,
 )
-from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_mnist_tpu.parallel.mesh import (
+    data_replica_coords,
+    make_mesh,
+)
 from pytorch_distributed_mnist_tpu.train.checkpoint import save_checkpoint, try_resume
 from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
 from pytorch_distributed_mnist_tpu.train.state import create_train_state
@@ -262,7 +265,7 @@ def _vit_num_heads() -> int:
     )
 
 
-def _build_loaders(args, seed: int):
+def _build_loaders(args, seed: int, mesh):
     name = "mnist" if args.dataset == "synthetic" else args.dataset
     synthesize = args.dataset == "synthetic"
 
@@ -320,7 +323,13 @@ def _build_loaders(args, seed: int):
 
     train_images, train_labels = load_split(train=True)
     test_images, test_labels = load_split(train=False)
-    nproc, pid = process_count(), process_index()
+    # Batch rows shard over the mesh's DATA axis, not over processes: a
+    # host whose devices share a data coordinate with another host's
+    # (multi-host TP/PP/SP — the model/stage/seq axis spans processes)
+    # must feed IDENTICAL rows, or make_array_from_process_local_data
+    # assembles a "replicated" batch whose replicas silently disagree.
+    # Pure DP degenerates to (process_count, process_index) exactly.
+    nproc, pid = data_replica_coords(mesh)
     train_loader = MNISTDataLoader(
         normalize_images(train_images, workers=args.workers), train_labels,
         batch_size=args.batch_size, train=True,
@@ -817,7 +826,8 @@ def run(args, epoch_callback=None) -> dict:
             "--epoch-gather device requires --trainer-mode scan (the "
             "gather lives inside the scanned epoch program)"
         )
-    train_loader, test_loader, dataset_synthesized = _build_loaders(args, seed)
+    train_loader, test_loader, dataset_synthesized = _build_loaders(
+        args, seed, mesh)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
                       mode=args.trainer_mode, state_sharding=state_sharding,
                       grad_accum=grad_accum, epoch_gather=epoch_gather)

@@ -48,6 +48,65 @@ def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def data_replica_coords(mesh: Mesh, process_index: Optional[int] = None):
+    """How this process's devices partition the leading (data) mesh axis:
+    ``(num_replicas, rank)`` for the host-side batch sharder.
+
+    Batch rows shard over the DATA axis, not over processes. In classic
+    multi-host DP the two coincide (each host's devices sit at their own
+    data coordinates), but when another axis spans hosts — multi-host TP,
+    PP, SP: mesh ``data=1 x stage=2`` over 2 processes, say — the batch
+    is *replicated* with respect to those processes, and each must feed
+    IDENTICAL rows: ``jax.make_array_from_process_local_data`` builds an
+    ill-defined global array if nominal replicas disagree (no cross-host
+    value check exists, so the divergence is silent). Grouping processes
+    by the data coordinates their devices cover makes every composition
+    feed consistent input; pure DP degenerates to
+    ``(process_count, process_index)``.
+
+    Relies on the data-major device order ``make_mesh`` uses (the data
+    axis is axis 0 of every mesh this framework builds), and raises if a
+    process's devices do not cover a contiguous uniform block of it.
+    """
+    if mesh.axis_names[0] != "data":
+        # Grouping by axis 0 of a mesh whose data axis lives elsewhere
+        # would shard the batch over the wrong axis — the same silent
+        # divergence this function exists to prevent. Every mesh this
+        # framework builds is data-major; refuse anything else loudly.
+        raise ValueError(
+            f"data_replica_coords requires a data-major mesh; got axes "
+            f"{mesh.axis_names}")
+    if process_index is None:
+        process_index = jax.process_index()
+    return _data_groups(mesh.devices, process_index)
+
+
+def _data_groups(devices: np.ndarray, process_index: int):
+    """Core of ``data_replica_coords`` over a raw device ndarray (axis 0 =
+    data); split out so tests can drive it with fake device objects."""
+    data_size = devices.shape[0]
+    owned = [
+        i for i in range(data_size)
+        if any(d.process_index == process_index
+               for d in np.asarray(devices[i], dtype=object).flat)
+    ]
+    if not owned:
+        raise ValueError(
+            f"process {process_index} owns no devices in this mesh")
+    span = len(owned)
+    # Contiguous, uniform, AND block-aligned: coordinates [1,2] of 4 are
+    # contiguous with a dividing span yet straddle the shard boundary —
+    # rank 1//2 would feed shard-0 rows for shard-1 devices.
+    if (owned[-1] - owned[0] + 1 != span or data_size % span
+            or owned[0] % span):
+        raise ValueError(
+            f"process {process_index}'s devices cover data coordinates "
+            f"{owned} of {data_size}: not an aligned contiguous uniform "
+            "block — host batch sharding requires the data-major device "
+            "order make_mesh produces")
+    return data_size // span, owned[0] // span
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for params/opt state: fully replicated (DDP-style weights)."""
     return NamedSharding(mesh, P())

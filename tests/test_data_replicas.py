@@ -1,0 +1,114 @@
+"""Host batch sharding groups processes by DATA coordinate, not identity.
+
+``parallel/mesh.py data_replica_coords`` decides which rows each host
+feeds: processes whose devices differ only along model/stage/seq/expert
+axes are the SAME data replica and must load identical rows (the batch is
+replicated w.r.t. them), while processes at different data coordinates
+load disjoint DistributedSampler shards. Getting this wrong is silent —
+``jax.make_array_from_process_local_data`` never value-checks nominal
+replicas across hosts — which is exactly how the pre-fix loader fed
+half-sized, host-divergent batches to multi-host PP runs (mesh
+``data=1 x stage=2`` over 2 processes). The unit half drives the core
+``_data_groups`` with fake devices; the end-to-end half lives in
+tests/test_multiprocess.py::test_two_process_tensor_parallel_matches_single.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.parallel.mesh import (
+    _data_groups,
+    data_replica_coords,
+    make_mesh,
+)
+
+
+def _grid(shape, proc_of_flat):
+    """Device ndarray of the given mesh shape; flat device i belongs to
+    process proc_of_flat(i) — mirroring make_mesh's reshape of the
+    process-major jax.devices() order."""
+    n = int(np.prod(shape))
+    devs = np.array(
+        [SimpleNamespace(process_index=proc_of_flat(i)) for i in range(n)],
+        dtype=object,
+    ).reshape(shape)
+    return devs
+
+
+def test_classic_dp_one_device_per_process():
+    # mesh ('data',) over 4 single-device hosts: identity mapping.
+    devs = _grid((4,), lambda i: i)
+    assert [_data_groups(devs, p) for p in range(4)] == [
+        (4, 0), (4, 1), (4, 2), (4, 3)]
+
+
+def test_model_axis_spanning_processes_shares_the_batch():
+    # mesh (data=1, stage=2) over 2 single-device hosts — the multi-host
+    # PP/TP shape: both processes are the one data replica and must feed
+    # the full, identical batch.
+    devs = _grid((1, 2), lambda i: i)
+    assert _data_groups(devs, 0) == (1, 0)
+    assert _data_groups(devs, 1) == (1, 0)
+
+
+def test_mixed_dp_times_model_grid():
+    # mesh (data=2, model=2) over 4 single-device hosts, row-major device
+    # order: hosts {0,1} share data row 0, hosts {2,3} share row 1.
+    devs = _grid((2, 2), lambda i: i)
+    assert _data_groups(devs, 0) == (2, 0)
+    assert _data_groups(devs, 1) == (2, 0)
+    assert _data_groups(devs, 2) == (2, 1)
+    assert _data_groups(devs, 3) == (2, 1)
+
+
+def test_multi_device_hosts_span_data_blocks():
+    # 2 hosts x 4 devices, mesh (data=4, model=2): host 0's devices fill
+    # data rows {0,1}, host 1's {2,3} — two replicas of two rows each.
+    devs = _grid((4, 2), lambda i: i // 4)
+    assert _data_groups(devs, 0) == (2, 0)
+    assert _data_groups(devs, 1) == (2, 1)
+
+
+def test_process_without_devices_raises():
+    devs = _grid((2,), lambda i: 0)
+    with pytest.raises(ValueError, match="owns no devices"):
+        _data_groups(devs, 1)
+
+
+def test_non_contiguous_ownership_raises():
+    # Interleaved hosts along data (not a layout make_mesh produces).
+    devs = _grid((4,), lambda i: i % 2)
+    with pytest.raises(ValueError, match="contiguous"):
+        _data_groups(devs, 0)
+
+
+def test_misaligned_block_raises():
+    # Coordinates [1,2] of 4: contiguous, dividing span, but straddling
+    # the shard boundary — rank 1//2 == 0 would feed shard-0 rows to
+    # shard-1 devices. Must refuse, not mis-rank.
+    devs = _grid((4,), lambda i: {0: 0, 1: 1, 2: 1, 3: 2}[i])
+    with pytest.raises(ValueError, match="aligned"):
+        _data_groups(devs, 1)
+
+
+def test_non_data_major_mesh_raises():
+    mesh = make_mesh(("data", "model"), shape=(4, 2))
+    from jax.sharding import Mesh
+
+    swapped = Mesh(mesh.devices.T, ("model", "data"))
+    with pytest.raises(ValueError, match="data-major"):
+        data_replica_coords(swapped, process_index=0)
+
+
+def test_single_process_any_mesh_is_one_replica():
+    # The in-process (virtual 8-device) case: every mesh shape collapses
+    # to one replica, rank 0 — current single-host behavior unchanged.
+    for axes, shape in [
+        (("data",), None),
+        (("data", "model"), (4, 2)),
+        (("data", "stage", "model"), (2, 2, 2)),
+    ]:
+        mesh = make_mesh(axes, shape=shape)
+        assert data_replica_coords(mesh, process_index=0) == (1, 0)

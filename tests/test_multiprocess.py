@@ -256,6 +256,54 @@ def test_two_process_pipeline_zero1_train_and_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_tensor_parallel_matches_single(tmp_path):
+    """Multi-host TP: the model axis spans the 2 processes (mesh
+    data=1 x model=2), so BOTH hosts must feed the identical full batch —
+    the data_replica_coords grouping (parallel/mesh.py). The oracle is
+    the same config run in ONE process over 2 virtual devices: identical
+    data, identical program, so the training trajectory must agree to
+    f32 reduction tolerance. Before the grouping fix the loader fed each
+    host a disjoint half-shard (DistributedSampler semantics), silently
+    assembling a 'replicated' batch whose replicas disagreed — this test
+    pins the repaired semantics end to end."""
+    tp_flags = ["--model", "vit", "--tensor-parallel", "2",
+                "--batch-size", "32",
+                "--synthetic-train-size", "64", "--synthetic-test-size", "32"]
+    two_proc, _ = _spawn_workers(tmp_path / "ckpts", tp_flags)
+    # replicated metrics agree bit-for-bit across the two hosts
+    assert two_proc[0]["train_loss"] == pytest.approx(
+        two_proc[1]["train_loss"], abs=0.0)
+
+    # Oracle: one process, two virtual CPU devices, same flags/seed.
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    script = (
+        "import json, jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_distributed_mnist_tpu.cli import build_parser, run\n"
+        f"s = run(build_parser().parse_args({tp_flags!r} + [\n"
+        "    '--dataset', 'synthetic', '--trainer-mode', 'stepwise',\n"
+        "    '--epochs', '1', '--seed', '0',\n"
+        f"    '--checkpoint-dir', {str(tmp_path / 'oracle')!r}]))\n"
+        "print('SUMMARY' + json.dumps({'train_loss':"
+        " s['history'][0]['train_loss'],"
+        " 'test_acc': s['history'][0]['test_acc']}))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=_REPO)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-4000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("SUMMARY")][-1]
+    oracle = json.loads(line[len("SUMMARY"):])
+    # Same data, same global batch, same step count; only the psum's
+    # cross-process transport differs. f32 reduction-order tolerance.
+    assert two_proc[0]["train_loss"] == pytest.approx(
+        oracle["train_loss"], rel=1e-5)
+    assert two_proc[0]["test_acc"] == pytest.approx(
+        oracle["test_acc"], abs=1e-6)
+
+
+@pytest.mark.slow
 def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path):
     """Multi-host ZeRO-1: moments sharded ACROSS processes -> the npz path
     cannot save them (np.asarray would raise on non-addressable leaves);
