@@ -239,6 +239,14 @@ def test_two_process_pipeline_zero1_train_and_resume(tmp_path):
                 "--synthetic-train-size", "64", "--synthetic-test-size", "32"]
     first, _ = _spawn_workers(tmp_path / "ckpts", pp_flags)
     assert all(s["epochs_run"] == 1 for s in first)
+    # Ground truth: the same config in ONE process over 2 virtual devices.
+    # The mesh is data=1 x stage=2, so both hosts feed the identical full
+    # batch (data_replica_coords); before that grouping existed each host
+    # fed a disjoint half and this comparison was impossible — multi-host
+    # PP silently trained on different data than its single-host twin.
+    oracle = _single_process_oracle(pp_flags, 2, tmp_path / "oracle")
+    assert first[0]["train_loss"] == pytest.approx(
+        oracle["train_loss"], rel=1e-5)
     # Cross-process-sharded moments force the sharded directory layout,
     # with shard files from BOTH ranks.
     ckpt0 = tmp_path / "ckpts" / "checkpoint_0.ckpt"
@@ -253,6 +261,40 @@ def test_two_process_pipeline_zero1_train_and_resume(tmp_path):
     # composed layout across both hosts.
     assert all(s["epochs_run"] == 1 for s in second)
     assert all(s["start_epoch"] == 1 for s in second)
+
+
+def _single_process_oracle(flags, n_devices, ckpt_dir):
+    """Run the worker's exact config in ONE fresh process over
+    ``n_devices`` virtual CPU devices; return {train_loss, test_acc}.
+    The ground truth the 2-process runs must reproduce: same data, same
+    global batch, same programs — only the collective transport differs.
+    Defaults mirror multiproc_worker.py (stepwise, seed 0, synthetic)."""
+    # Start from the launcher's child env (preserves ambient XLA_FLAGS,
+    # strips only the device-count flag — the workers being compared
+    # against run under exactly this env) and re-append our count, so
+    # oracle and workers never drift on XLA configuration.
+    env = _child_env()
+    env["XLA_FLAGS"] = (
+        f"{env['XLA_FLAGS']} "
+        f"--xla_force_host_platform_device_count={n_devices}").strip()
+    script = (
+        "import json, jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_distributed_mnist_tpu.cli import build_parser, run\n"
+        f"s = run(build_parser().parse_args({list(flags)!r} + [\n"
+        "    '--dataset', 'synthetic', '--trainer-mode', 'stepwise',\n"
+        "    '--epochs', '1', '--seed', '0',\n"
+        f"    '--checkpoint-dir', {str(ckpt_dir)!r}]))\n"
+        "print('SUMMARY' + json.dumps({'train_loss':"
+        " s['history'][0]['train_loss'],"
+        " 'test_acc': s['history'][0]['test_acc']}))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=_REPO)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-4000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("SUMMARY")][-1]
+    return json.loads(line[len("SUMMARY"):])
 
 
 @pytest.mark.slow
@@ -275,28 +317,9 @@ def test_two_process_tensor_parallel_matches_single(tmp_path):
         two_proc[1]["train_loss"], abs=0.0)
 
     # Oracle: one process, two virtual CPU devices, same flags/seed.
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    script = (
-        "import json, jax; jax.config.update('jax_platforms', 'cpu')\n"
-        "from pytorch_distributed_mnist_tpu.cli import build_parser, run\n"
-        f"s = run(build_parser().parse_args({tp_flags!r} + [\n"
-        "    '--dataset', 'synthetic', '--trainer-mode', 'stepwise',\n"
-        "    '--epochs', '1', '--seed', '0',\n"
-        f"    '--checkpoint-dir', {str(tmp_path / 'oracle')!r}]))\n"
-        "print('SUMMARY' + json.dumps({'train_loss':"
-        " s['history'][0]['train_loss'],"
-        " 'test_acc': s['history'][0]['test_acc']}))\n"
-    )
-    proc = subprocess.run([sys.executable, "-c", script],
-                          capture_output=True, text=True, timeout=600,
-                          env=env, cwd=_REPO)
-    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-4000:]
-    line = [l for l in proc.stdout.splitlines()
-            if l.startswith("SUMMARY")][-1]
-    oracle = json.loads(line[len("SUMMARY"):])
     # Same data, same global batch, same step count; only the psum's
     # cross-process transport differs. f32 reduction-order tolerance.
+    oracle = _single_process_oracle(tp_flags, 2, tmp_path / "oracle")
     assert two_proc[0]["train_loss"] == pytest.approx(
         oracle["train_loss"], rel=1e-5)
     assert two_proc[0]["test_acc"] == pytest.approx(
