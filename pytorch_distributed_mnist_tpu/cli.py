@@ -21,6 +21,7 @@ for the TPU runtime:
   kernels: ``--optimizer adam_pallas``, ``--loss fused``,
   ``--attention flash``; parallelism: ``--tensor-parallel``,
   ``--sequence-parallel[-impl]``, ``--pipeline-stages``,
+  ``--expert-parallel`` (+ ``--moe-dispatch dense|capacity``),
   ``--optimizer-sharding zero1|zero3``, ``--grad-accum``, ``--remat``;
   checkpoint lifecycle: ``--resume auto``, ``--keep-last``,
   ``--async-checkpoint``; input path: ``--epoch-gather host|device``
@@ -164,6 +165,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "devices are split data x model; composes with "
                         "--optimizer-sharding zero1 and "
                         "--sequence-parallel)")
+    p.add_argument("--expert-parallel", type=int, default=1,
+                   help="expert-parallel width for --model moe_mlp: expert "
+                        "weights (leading num_experts dim) shard over an "
+                        "'expert' mesh axis (parallel/expert.py); devices "
+                        "split data x expert, expert count must divide "
+                        "evenly. Composes with --optimizer-sharding zero1 "
+                        "and --moe-dispatch")
+    p.add_argument("--moe-dispatch", type=str, default="dense",
+                   choices=["dense", "capacity"],
+                   help="moe_mlp routing: dense = algebraic one-hot "
+                        "combine (layout-exact); capacity = GShard-style "
+                        "physical dispatch into per-expert buffers "
+                        "bounded by the capacity factor, crossing the "
+                        "expert axis via all_to_all "
+                        "(parallel/moe_dispatch.py)")
     p.add_argument("--sequence-parallel", type=int, default=1,
                    help="sequence-parallel width for --model vit: the token "
                         "axis is sharded over a 'seq' mesh axis and every "
@@ -250,19 +266,28 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _vit_num_heads() -> int:
-    """The ViT's default head count, read from the model dataclass — the
-    single source for every head-divisibility flag check."""
+def _model_field_default(model_cls, name: str):
+    """A model dataclass field's default — the single source for the
+    divisibility flag checks (head/expert counts)."""
     import dataclasses
 
+    return next(
+        f.default for f in dataclasses.fields(model_cls) if f.name == name
+    )
+
+
+def _vit_num_heads() -> int:
     from pytorch_distributed_mnist_tpu.models.attention import (
         VisionTransformer,
     )
 
-    return next(
-        f.default for f in dataclasses.fields(VisionTransformer)
-        if f.name == "num_heads"
-    )
+    return _model_field_default(VisionTransformer, "num_heads")
+
+
+def _moe_num_experts() -> int:
+    from pytorch_distributed_mnist_tpu.models.moe import MoEClassifier
+
+    return _model_field_default(MoEClassifier, "num_experts")
 
 
 def _build_loaders(args, seed: int, mesh):
@@ -423,8 +448,57 @@ def run(args, epoch_callback=None) -> dict:
     pp = getattr(args, "pipeline_stages", 1)
     tp = getattr(args, "tensor_parallel", 1)
     sp = getattr(args, "sequence_parallel", 1)
+    ep = getattr(args, "expert_parallel", 1)
     patch = getattr(args, "patch_size", 4)
     grad_accum = getattr(args, "grad_accum", 1)
+    if ep > 1:
+        # EP targets the MoE family; TP/SP/PP target the ViT. The mesh
+        # families are disjoint (data x expert vs data x model/seq/stage),
+        # so the combinations are rejected at flag level, not discovered
+        # as a sharding trace error.
+        if tp > 1 or sp > 1 or pp > 1:
+            raise SystemExit(
+                "--expert-parallel does not combine with "
+                "--tensor-parallel/--sequence-parallel/--pipeline-stages: "
+                "EP shards the moe_mlp expert dim over a data x expert "
+                "mesh; the others shard the ViT"
+            )
+        if args.model != "moe_mlp":
+            raise SystemExit(
+                f"--expert-parallel requires --model moe_mlp (the EP rule "
+                f"table shards the leading num_experts weight dim; other "
+                f"models would silently stay replicated); got --model "
+                f"{args.model}"
+            )
+        if args.trainer_mode == "explicit":
+            raise SystemExit(
+                "--expert-parallel does not compose with --trainer-mode "
+                "explicit (the explicit shard_map owns the whole mesh as "
+                "a data axis); use scan or stepwise"
+            )
+        num_experts = _moe_num_experts()
+        if num_experts % ep:
+            raise SystemExit(
+                f"--expert-parallel {ep} must divide the moe_mlp's "
+                f"{num_experts} experts"
+            )
+        if jax.device_count() % ep:
+            raise SystemExit(
+                f"--expert-parallel {ep} does not divide the "
+                f"{jax.device_count()} available devices"
+            )
+    if getattr(args, "optimizer_sharding", "none") == "zero3" \
+            and (tp > 1 or sp > 1 or ep > 1):
+        # ZeRO-3 composes with plain DP (and is separately rejected under
+        # PP below): stacking param-sharding on top of a TP/SP/EP rule
+        # table is an untested layout the composition matrix (README)
+        # marks unsupported — reject it at flag level rather than let an
+        # undocumented composition run.
+        raise SystemExit(
+            "--optimizer-sharding zero3 composes with data parallelism "
+            "only; combine TP/SP/EP with zero1 instead (README "
+            "composition matrix)"
+        )
     if patch < 1 or 28 % patch:
         raise SystemExit(
             f"--patch-size {patch}: 28 must divide evenly into patches "
@@ -457,6 +531,19 @@ def run(args, epoch_callback=None) -> dict:
                     f"{dp_size} data slices into a per-slice batch "
                     f"divisible by {pp} pipeline microbatches"
                 )
+    if ep > 1 and getattr(args, "moe_dispatch", "dense") == "capacity" \
+            and (args.batch_size // grad_accum) % jax.device_count():
+        # After the grad-accum divisibility checks above, so the per-step
+        # batch in this message is exact. The capacity dispatch
+        # shard_maps tokens over every mesh axis (data x expert groups);
+        # shard_map needs exact divisibility — fail with flag language,
+        # not a trace error.
+        raise SystemExit(
+            f"--moe-dispatch capacity with --expert-parallel {ep}: "
+            f"the per-step batch ({args.batch_size // grad_accum}) "
+            f"must divide evenly over the {jax.device_count()} "
+            f"data x expert token groups"
+        )
     if pp > 1 and sp > 1:
         raise SystemExit(
             "--pipeline-stages does not compose with --sequence-parallel: "
@@ -568,6 +655,9 @@ def run(args, epoch_callback=None) -> dict:
                     )
         mesh = make_mesh(("data", "model", "seq"),
                          shape=(jax.device_count() // (tp * sp), tp, sp))
+    elif ep > 1:
+        mesh = make_mesh(("data", "expert"),
+                         shape=(jax.device_count() // ep, ep))
     else:
         mesh = make_mesh(("data",))
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
@@ -622,6 +712,7 @@ def run(args, epoch_callback=None) -> dict:
                 f"{args.model!r} does not accept one"
             )
         model_kwargs["patch_size"] = patch
+    moe_dispatch = getattr(args, "moe_dispatch", "dense")
     if getattr(args, "remat", False):
         if not model_accepts(args.model, "remat"):
             raise SystemExit(
@@ -701,6 +792,23 @@ def run(args, epoch_callback=None) -> dict:
             sharded_flash_attention, mesh=mesh, batch_axis="data",
             head_axis="model",
         )
+    if moe_dispatch != "dense":
+        if not model_accepts(args.model, "dispatch"):
+            raise SystemExit(
+                f"--moe-dispatch only applies to MoE models; "
+                f"{args.model!r} does not accept a dispatch mode"
+            )
+        if ep > 1:
+            # Params are dispatch-independent; init must use the dense
+            # twin (the batch-1 init trace can't divide the dispatch
+            # shard_map's token groups), then the capacity apply_fn is
+            # swapped in — the same pattern as the SP/flash branches.
+            # The mesh rides into the model for the all_to_all across
+            # the expert axis; at ep == 1 buffers stay local, no mesh.
+            init_model = get_model(args.model, **model_kwargs)
+            model_kwargs.update(mesh=mesh, expert_axis="expert",
+                                data_axis="data")
+        model_kwargs["dispatch"] = moe_dispatch
     model = get_model(args.model, **model_kwargs)
     pp_sharding = None
     # With ZeRO composing on top of the pipeline layout, the state must be
@@ -793,6 +901,17 @@ def run(args, epoch_callback=None) -> dict:
         if zero == "none":
             # With zero sharding, shard_state_zero below applies the TP
             # rules itself — placing here too would move the state twice.
+            state, state_sharding = shard_state(state, mesh, tp_rules)
+    elif ep > 1:
+        # Same rule-table machinery as TP, different table: expert
+        # weights shard their leading num_experts dim over 'expert'
+        # (parallel/expert.py); router/embed/head replicate. ZeRO
+        # composes identically (rules-first, moments claim the rest).
+        from pytorch_distributed_mnist_tpu.parallel.expert import moe_ep_rules
+        from pytorch_distributed_mnist_tpu.parallel.tensor import shard_state
+
+        tp_rules = moe_ep_rules("expert")
+        if zero == "none":
             state, state_sharding = shard_state(state, mesh, tp_rules)
     if zero != "none":
         if zero == "zero1" and args.optimizer not in ("adam", "adam_pallas"):

@@ -1,0 +1,130 @@
+"""--expert-parallel from the CLI: the EP analog of the TP/SP CLI tests.
+
+Expert parallelism existed as a library capability (parallel/expert.py +
+moe_dispatch, dryrun phase 3, tests/test_moe_pipeline.py); these tests pin
+the CLI surface added in round 3: a ``data x expert`` mesh from one flag,
+EP rule-table state sharding through the standard driver, capacity
+dispatch with the mesh threaded into the model, ZeRO-1 composition, and
+flag-level rejection of the ViT-family parallelism combinations.
+
+Equivalence logic mirrors tests/test_tensor_parallel.py: EP is a layout
+change, not a math change, so the EP run must match the plain-DP run's
+trajectory (dense dispatch is algebraically layout-exact; router math is
+pinned to f32 for exactly this reason, models/moe.py).
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+
+def _base(tmp_path, *extra):
+    return [
+        "--dataset", "synthetic", "--model", "moe_mlp", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0",
+        "--root", str(tmp_path / "data"), *extra,
+    ]
+
+
+def test_cli_expert_parallel_matches_dp(tmp_path):
+    ep = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "4",
+        "--checkpoint-dir", str(tmp_path / "ckpt_ep"))))
+    dp = run(build_parser().parse_args(_base(
+        tmp_path, "--checkpoint-dir", str(tmp_path / "ckpt_dp"))))
+    assert ep["history"][0]["train_loss"] == pytest.approx(
+        dp["history"][0]["train_loss"], rel=1e-4)
+    assert ep["history"][0]["test_acc"] == pytest.approx(
+        dp["history"][0]["test_acc"], abs=1e-6)
+
+
+@pytest.mark.slow
+def test_cli_expert_parallel_capacity_dispatch(tmp_path):
+    """EP x capacity dispatch end to end: the model's all_to_all dispatch
+    shard_map runs inside the jitted driver step on the data x expert
+    mesh. With a generous capacity factor nothing drops, so the
+    trajectory matches dense dispatch (the library-level guarantee,
+    tests/test_moe_dispatch.py, here through the CLI)."""
+    cap = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2", "--moe-dispatch", "capacity",
+        "--checkpoint-dir", str(tmp_path / "ckpt_cap"))))
+    dense = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt_dense"))))
+    assert np.isfinite(cap["history"][0]["train_loss"])
+    assert cap["history"][0]["train_loss"] == pytest.approx(
+        dense["history"][0]["train_loss"], rel=0.05)
+
+
+@pytest.mark.slow
+def test_cli_expert_parallel_composes_with_zero1(tmp_path):
+    summary = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2",
+        "--optimizer-sharding", "zero1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"))))
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_expert_parallel_composes_with_grad_accum_and_fused_loss(tmp_path):
+    """EP x --grad-accum x --loss fused in one run: the micro-batch scan
+    accumulates over the data x expert mesh and the Pallas loss kernel's
+    nested shard_map (P('data') in_specs, expert-replicated logits) embeds
+    in the same GSPMD program. Matches the plain EP run's trajectory
+    (grad-accum applies the exact full-batch gradient; the fused loss is
+    oracle-equal)."""
+    combo = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2", "--grad-accum", "2",
+        "--loss", "fused",
+        "--checkpoint-dir", str(tmp_path / "ckpt_combo"))))
+    plain = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt_plain"))))
+    assert combo["history"][0]["train_loss"] == pytest.approx(
+        plain["history"][0]["train_loss"], rel=1e-4)
+    assert combo["history"][0]["test_acc"] == pytest.approx(
+        plain["history"][0]["test_acc"], abs=1e-6)
+
+
+def test_cli_expert_parallel_rejects_non_moe(tmp_path):
+    args = build_parser().parse_args(_base(
+        tmp_path, "--checkpoint-dir", str(tmp_path / "ckpt")))
+    args.model = "cnn"
+    with pytest.raises(SystemExit, match="requires --model moe_mlp"):
+        args.expert_parallel = 2
+        run(args)
+
+
+def test_cli_expert_parallel_rejects_vit_family_combos(tmp_path):
+    args = build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2", "--tensor-parallel", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt")))
+    with pytest.raises(SystemExit, match="does not combine"):
+        run(args)
+
+
+def test_cli_rule_table_parallelism_rejects_zero3(tmp_path):
+    """EP/TP/SP x zero3 is marked unsupported in the README matrix;
+    the CLI must reject it at flag level, not run an untested layout."""
+    for extra in (["--model", "moe_mlp", "--expert-parallel", "2"],
+                  ["--model", "vit", "--tensor-parallel", "2"]):
+        args = build_parser().parse_args(_base(
+            tmp_path, "--optimizer-sharding", "zero3",
+            "--checkpoint-dir", str(tmp_path / "ckpt")))
+        for i in range(0, len(extra), 2):
+            setattr(args, extra[i].lstrip("-").replace("-", "_"),
+                    extra[i + 1] if not extra[i + 1].isdigit()
+                    else int(extra[i + 1]))
+        with pytest.raises(SystemExit, match="zero3 composes with data"):
+            run(args)
+
+
+def test_cli_expert_parallel_rejects_indivisible_experts(tmp_path):
+    # default moe_mlp has 8 experts; 3 does not divide them.
+    args = build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "3",
+        "--checkpoint-dir", str(tmp_path / "ckpt")))
+    with pytest.raises(SystemExit, match="must divide"):
+        run(args)

@@ -268,7 +268,13 @@ def _single_process_oracle(flags, n_devices, ckpt_dir):
     ``n_devices`` virtual CPU devices; return {train_loss, test_acc}.
     The ground truth the 2-process runs must reproduce: same data, same
     global batch, same programs — only the collective transport differs.
-    Defaults mirror multiproc_worker.py (stepwise, seed 0, synthetic)."""
+
+    Pinned defaults (dataset/trainer-mode/epochs/seed) come FIRST and the
+    caller's ``flags`` after, so caller flags override them — the same
+    last-wins precedence ``_spawn_workers`` gives its extras over the
+    worker defaults. Callers must still pass the model/batch/size flags
+    they passed the workers (multiproc_worker.py's --model linear /
+    --batch-size 64 / 256-sample defaults are NOT replicated here)."""
     # Start from the launcher's child env (preserves ambient XLA_FLAGS,
     # strips only the device-count flag — the workers being compared
     # against run under exactly this env) and re-append our count, so
@@ -280,10 +286,11 @@ def _single_process_oracle(flags, n_devices, ckpt_dir):
     script = (
         "import json, jax; jax.config.update('jax_platforms', 'cpu')\n"
         "from pytorch_distributed_mnist_tpu.cli import build_parser, run\n"
-        f"s = run(build_parser().parse_args({list(flags)!r} + [\n"
+        "s = run(build_parser().parse_args([\n"
         "    '--dataset', 'synthetic', '--trainer-mode', 'stepwise',\n"
         "    '--epochs', '1', '--seed', '0',\n"
-        f"    '--checkpoint-dir', {str(ckpt_dir)!r}]))\n"
+        f"    '--checkpoint-dir', {str(ckpt_dir)!r}]\n"
+        f"    + {list(flags)!r}))\n"
         "print('SUMMARY' + json.dumps({'train_loss':"
         " s['history'][0]['train_loss'],"
         " 'test_acc': s['history'][0]['test_acc']}))\n"
@@ -320,6 +327,27 @@ def test_two_process_tensor_parallel_matches_single(tmp_path):
     # Same data, same global batch, same step count; only the psum's
     # cross-process transport differs. f32 reduction-order tolerance.
     oracle = _single_process_oracle(tp_flags, 2, tmp_path / "oracle")
+    assert two_proc[0]["train_loss"] == pytest.approx(
+        oracle["train_loss"], rel=1e-5)
+    assert two_proc[0]["test_acc"] == pytest.approx(
+        oracle["test_acc"], abs=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_expert_parallel_matches_single(tmp_path):
+    """Multi-host EP: the expert axis spans the 2 processes (mesh
+    data=1 x expert=2) — each host computes only its local experts and
+    the combine's expert-sum AllReduce crosses the process boundary.
+    Both hosts feed the identical full batch (data_replica_coords), and
+    the trajectory must match the same config in one process over 2
+    virtual devices."""
+    ep_flags = ["--model", "moe_mlp", "--expert-parallel", "2",
+                "--batch-size", "32",
+                "--synthetic-train-size", "64", "--synthetic-test-size", "32"]
+    two_proc, _ = _spawn_workers(tmp_path / "ckpts", ep_flags)
+    assert two_proc[0]["train_loss"] == pytest.approx(
+        two_proc[1]["train_loss"], abs=0.0)
+    oracle = _single_process_oracle(ep_flags, 2, tmp_path / "oracle")
     assert two_proc[0]["train_loss"] == pytest.approx(
         oracle["train_loss"], rel=1e-5)
     assert two_proc[0]["test_acc"] == pytest.approx(
