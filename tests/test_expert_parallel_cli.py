@@ -29,6 +29,10 @@ def _base(tmp_path, *extra):
 
 
 def test_cli_expert_parallel_matches_dp(tmp_path):
+    # Unmarked deliberately (unlike the ViT TP/SP analogs, which are
+    # slow): the MoE runs are 256 samples on a small MLP, ~5s for both
+    # including compiles, and the fast tier keeps one end-to-end EP
+    # equivalence this way.
     ep = run(build_parser().parse_args(_base(
         tmp_path, "--expert-parallel", "4",
         "--checkpoint-dir", str(tmp_path / "ckpt_ep"))))
@@ -68,6 +72,7 @@ def test_cli_expert_parallel_composes_with_zero1(tmp_path):
     assert np.isfinite(summary["history"][0]["train_loss"])
 
 
+@pytest.mark.slow
 def test_cli_expert_parallel_composes_with_grad_accum_and_fused_loss(tmp_path):
     """EP x --grad-accum x --loss fused in one run: the micro-batch scan
     accumulates over the data x expert mesh and the Pallas loss kernel's
@@ -89,11 +94,11 @@ def test_cli_expert_parallel_composes_with_grad_accum_and_fused_loss(tmp_path):
 
 
 def test_cli_expert_parallel_rejects_non_moe(tmp_path):
+    # argparse last-wins: --model cnn overrides _base's moe_mlp.
     args = build_parser().parse_args(_base(
-        tmp_path, "--checkpoint-dir", str(tmp_path / "ckpt")))
-    args.model = "cnn"
+        tmp_path, "--expert-parallel", "2", "--model", "cnn",
+        "--checkpoint-dir", str(tmp_path / "ckpt")))
     with pytest.raises(SystemExit, match="requires --model moe_mlp"):
-        args.expert_parallel = 2
         run(args)
 
 
@@ -107,16 +112,13 @@ def test_cli_expert_parallel_rejects_vit_family_combos(tmp_path):
 
 def test_cli_rule_table_parallelism_rejects_zero3(tmp_path):
     """EP/TP/SP x zero3 is marked unsupported in the README matrix;
-    the CLI must reject it at flag level, not run an untested layout."""
-    for extra in (["--model", "moe_mlp", "--expert-parallel", "2"],
+    the CLI must reject it at flag level, not run an untested layout.
+    argparse last-wins lets the extras override _base's model."""
+    for extra in (["--expert-parallel", "2"],
                   ["--model", "vit", "--tensor-parallel", "2"]):
         args = build_parser().parse_args(_base(
             tmp_path, "--optimizer-sharding", "zero3",
-            "--checkpoint-dir", str(tmp_path / "ckpt")))
-        for i in range(0, len(extra), 2):
-            setattr(args, extra[i].lstrip("-").replace("-", "_"),
-                    extra[i + 1] if not extra[i + 1].isdigit()
-                    else int(extra[i + 1]))
+            "--checkpoint-dir", str(tmp_path / "ckpt"), *extra))
         with pytest.raises(SystemExit, match="zero3 composes with data"):
             run(args)
 

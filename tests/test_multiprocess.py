@@ -32,22 +32,23 @@ _WORKER = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn_workers(ckpt_dir, extra=()):
-    """Launch 2 worker ranks, wait, assert rc 0; return (summaries, outs).
+def _spawn_workers(ckpt_dir, extra=(), nprocs=2):
+    """Launch ``nprocs`` worker ranks, wait, assert rc 0; return
+    (summaries, outs).
 
     The one copy of the Popen/communicate/kill/SUMMARY-parse dance every
-    2-process test needs — fixes to timeout or output handling land here
-    once.
+    multi-process test needs — fixes to timeout or output handling land
+    here once.
     """
     port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(rank), "2", str(port),
+            [sys.executable, _WORKER, str(rank), str(nprocs), str(port),
              str(ckpt_dir)] + list(extra),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=_child_env(), cwd=_REPO,
         )
-        for rank in range(2)
+        for rank in range(nprocs)
     ]
     outs = []
     try:
@@ -330,6 +331,41 @@ def test_two_process_tensor_parallel_matches_single(tmp_path):
     assert two_proc[0]["train_loss"] == pytest.approx(
         oracle["train_loss"], rel=1e-5)
     assert two_proc[0]["test_acc"] == pytest.approx(
+        oracle["test_acc"], abs=1e-6)
+
+
+@pytest.mark.slow
+def test_four_process_pp_tp_zero1_matches_single(tmp_path):
+    """The deepest multi-host composition in the matrix: PP x TP x
+    ZeRO-1 over 4 real processes — mesh data=1 x stage=2 x model=2 with
+    BOTH non-data axes spanning process boundaries, so the GPipe
+    ppermute hops AND the Megatron stage-body psums cross real process
+    links, all four hosts feed the identical full batch
+    (data_replica_coords groups them into one data replica), and the
+    stage x model x data-sharded moments force the sharded .ckpt layout
+    from every rank. Trajectory pinned to the same config in one
+    process over 4 virtual devices."""
+    flags = ["--model", "vit", "--pipeline-stages", "2",
+             "--tensor-parallel", "2", "--optimizer-sharding", "zero1",
+             "--batch-size", "32",
+             "--synthetic-train-size", "64", "--synthetic-test-size", "32"]
+    four, _ = _spawn_workers(tmp_path / "ckpts", flags, nprocs=4)
+    assert all(s["process_count"] == 4 for s in four)
+    # replicated metrics bit-identical on every host
+    for s in four[1:]:
+        assert s["train_loss"] == pytest.approx(
+            four[0]["train_loss"], abs=0.0)
+    # cross-host-sharded state -> sharded directory layout, all 4 ranks
+    ckpt0 = tmp_path / "ckpts" / "checkpoint_0.ckpt"
+    assert ckpt0.is_dir()
+    names = sorted(os.listdir(ckpt0))
+    for rank in range(4):
+        assert any(n.startswith(f"shards_p0000{rank}") for n in names)
+
+    oracle = _single_process_oracle(flags, 4, tmp_path / "oracle")
+    assert four[0]["train_loss"] == pytest.approx(
+        oracle["train_loss"], rel=1e-5)
+    assert four[0]["test_acc"] == pytest.approx(
         oracle["test_acc"], abs=1e-6)
 
 
