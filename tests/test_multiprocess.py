@@ -426,6 +426,39 @@ def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_zero3_matches_single_and_resumes(tmp_path):
+    """Multi-host ZeRO-3: PARAMS (not just moments) shard across the 2
+    processes, so every step AllGathers weights across the real process
+    link and the checkpoint must use the sharded layout from both ranks.
+    Trajectory pinned to the single-process 2-virtual-device oracle, and
+    a second 2-process run resumes from the cross-host-sharded .ckpt."""
+    # One flag list for workers AND oracle (the worker's own defaults
+    # cover these, but the oracle's do not — a single source of truth
+    # keeps the two configs from drifting).
+    z3_flags = ["--optimizer-sharding", "zero3",
+                "--model", "linear", "--batch-size", "64",
+                "--synthetic-train-size", "256",
+                "--synthetic-test-size", "128"]
+    first, _ = _spawn_workers(tmp_path / "ckpts", z3_flags)
+    assert first[0]["train_loss"] == pytest.approx(
+        first[1]["train_loss"], abs=0.0)
+    ckpt0 = tmp_path / "ckpts" / "checkpoint_0.ckpt"
+    assert ckpt0.is_dir()
+    names = sorted(os.listdir(ckpt0))
+    assert any(n.startswith("shards_p00000") for n in names)
+    assert any(n.startswith("shards_p00001") for n in names)
+
+    oracle = _single_process_oracle(z3_flags, 2, tmp_path / "oracle")
+    assert first[0]["train_loss"] == pytest.approx(
+        oracle["train_loss"], rel=1e-5)
+
+    second, _ = _spawn_workers(
+        tmp_path / "ckpts", z3_flags + ["--resume", "auto", "--epochs", "2"])
+    assert all(s["start_epoch"] == 1 and s["epochs_run"] == 1
+               for s in second)
+
+
+@pytest.mark.slow
 def test_two_process_resume_auto(tmp_path):
     """--resume auto across a real 2-process world: run 1 trains fresh,
     run 2 resolves the newest checkpoint on process 0, broadcasts the
