@@ -172,6 +172,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "split data x expert, expert count must divide "
                         "evenly. Composes with --optimizer-sharding zero1 "
                         "and --moe-dispatch")
+    p.add_argument("--moe-aux-weight", type=float, default=0.0,
+                   metavar="W",
+                   help="weight of the MoE router's load-balance loss in "
+                        "the training objective (models/moe.py sows it "
+                        "under intermediates; top-1 routing can collapse "
+                        "onto one expert without it — 0.01 is a typical "
+                        "switch-transformer value). 0 (default) skips the "
+                        "capture entirely; metrics always report the "
+                        "cross-entropy alone")
     p.add_argument("--moe-dispatch", type=str, default="dense",
                    choices=["dense", "capacity"],
                    help="moe_mlp routing: dense = algebraic one-hot "
@@ -945,11 +954,24 @@ def run(args, epoch_callback=None) -> dict:
             "--epoch-gather device requires --trainer-mode scan (the "
             "gather lives inside the scanned epoch program)"
         )
+    aux_weight = getattr(args, "moe_aux_weight", 0.0)
+    if aux_weight:
+        if args.model != "moe_mlp":
+            raise SystemExit(
+                f"--moe-aux-weight applies to --model moe_mlp (the router "
+                f"sows the load-balance loss); got --model {args.model}"
+            )
+        if args.trainer_mode == "explicit":
+            raise SystemExit(
+                "--moe-aux-weight does not compose with --trainer-mode "
+                "explicit; use scan or stepwise"
+            )
     train_loader, test_loader, dataset_synthesized = _build_loaders(
         args, seed, mesh)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
                       mode=args.trainer_mode, state_sharding=state_sharding,
-                      grad_accum=grad_accum, epoch_gather=epoch_gather)
+                      grad_accum=grad_accum, epoch_gather=epoch_gather,
+                      aux_weight=aux_weight)
     lr_of = step_decay_schedule(args.lr)
 
     if args.evaluate:

@@ -16,6 +16,7 @@ names the reference's per-batch ``.item()`` syncs as the anti-pattern).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -27,21 +28,61 @@ from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
 from pytorch_distributed_mnist_tpu.ops.metrics import MetricState, metrics_init, metrics_update
 
 
-def _train_step(state, batch):
-    """One optimizer step on one (global) batch. Pure; jitted by the factory."""
+def _forward_with_aux(state, params, images, aux_weight: float):
+    """Training forward returning ``(logits, aux)`` where ``aux`` is the
+    sum of the ``aux_loss`` entries the model sowed under
+    ``intermediates`` (the MoE router's load-balance term, models/moe.py)
+    — 0.0 when ``aux_weight`` is 0, in which case the capture is skipped
+    entirely and the program is byte-identical to the plain path.
+
+    Only leaves whose key is literally ``aux_loss`` enter the objective;
+    any other sown intermediate raises, so a future diagnostic sow can
+    never silently join the loss. The aux statistic is computed by the
+    model over the full static batch — it cannot see the validity mask —
+    so it assumes fully-valid train batches, which the train loader
+    guarantees (``drop_last=train``, data/loader.py: the ragged tail is
+    dropped, never padded; only EVAL batches pad, and eval never runs
+    this path)."""
+    if not aux_weight:
+        return state.apply_fn(params, images, train=True), 0.0
+    logits, mods = state.apply_fn(
+        params, images, train=True, mutable=["intermediates"]
+    )
+    aux = jnp.float32(0.0)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(mods):
+        names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        if "aux_loss" not in names:
+            raise ValueError(
+                f"aux_weight is set but the model sowed a non-aux_loss "
+                f"intermediate at {jax.tree_util.keystr(path)}; only "
+                f"'aux_loss' entries may join the training objective"
+            )
+        aux = aux + jnp.sum(leaf)
+    return logits, aux
+
+
+def _train_step(state, batch, aux_weight: float = 0.0):
+    """One optimizer step on one (global) batch. Pure; jitted by the factory.
+
+    The objective is ``cross_entropy + aux_weight * sown_aux``; metrics
+    report the cross-entropy alone so loss curves stay comparable with
+    the reference (which has no auxiliary terms, ``:88``)."""
     mask = batch.get("mask")
 
     def loss_fn(params):
-        logits = state.apply_fn(params, batch["image"], train=True)
-        return cross_entropy(logits, batch["label"], mask), logits
+        logits, aux = _forward_with_aux(
+            state, params, batch["image"], aux_weight)
+        ce = cross_entropy(logits, batch["label"], mask)
+        return ce + aux_weight * aux, (ce, logits)
 
-    (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+    (_, (loss, logits)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params)
     new_state = state.apply_gradients(grads)
     metrics = metrics_update(metrics_init(), loss, logits, batch["label"], mask)
     return new_state, metrics
 
 
-def make_accum_train_step_fn(accum: int):
+def make_accum_train_step_fn(accum: int, aux_weight: float = 0.0):
     """Pure ``step(state, batch)`` with ``accum``-way gradient accumulation.
 
     The batch splits into ``accum`` equal micro-batches along dim 0; a
@@ -52,9 +93,15 @@ def make_accum_train_step_fn(accum: int):
     are preserved for any mask distribution across micro-batches. Peak
     activation memory drops by ~``accum`` while the optimizer cadence
     matches the reference's one-step-per-batch loop (``:90-92``).
+
+    ``aux_weight``: the sown-aux objective term (see ``_train_step``).
+    Under accumulation each micro-batch's aux is weighted by its example
+    count — the example-weighted mean of micro-batch aux values, an
+    approximation of the full-batch aux (the router's load fractions are
+    per-micro-batch statistics), standard for MoE grad accumulation.
     """
     if accum < 2:
-        return _train_step
+        return functools.partial(_train_step, aux_weight=aux_weight)
 
     def step(state, batch):
         b = batch["image"].shape[0]
@@ -73,13 +120,15 @@ def make_accum_train_step_fn(accum: int):
                  else jnp.asarray(float(mb["label"].shape[0])))
 
             def loss_fn(params):
-                logits = state.apply_fn(params, mb["image"], train=True)
+                logits, aux = _forward_with_aux(
+                    state, params, mb["image"], aux_weight)
                 # per-example SUM: micro-means weighted by real count so
                 # the accumulated gradient equals the full-batch gradient
                 # even when eval-style masks straddle micro-batches.
-                return cross_entropy(logits, mb["label"], mask) * n, logits
+                ce_sum = cross_entropy(logits, mb["label"], mask) * n
+                return ce_sum + aux_weight * aux * n, (ce_sum, logits)
 
-            (loss_sum_mb, logits), g = jax.value_and_grad(
+            (_, (loss_sum_mb, logits)), g = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
             g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
@@ -122,7 +171,7 @@ def _shardings(mesh: Optional[Mesh], axis: str):
 
 def make_train_step(
     mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None,
-    grad_accum: int = 1,
+    grad_accum: int = 1, aux_weight: float = 0.0,
 ):
     """Jitted ``step(state, batch) -> (state, MetricState)``.
 
@@ -135,7 +184,7 @@ def make_train_step(
     micro-batches before the single optimizer step
     (``make_accum_train_step_fn``).
     """
-    step_fn = make_accum_train_step_fn(grad_accum)
+    step_fn = make_accum_train_step_fn(grad_accum, aux_weight)
     repl, data = _shardings(mesh, axis)
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0,))
@@ -239,7 +288,7 @@ def _make_epoch(mesh, axis, state_sharding, step_fn, train, indexed):
 
 def make_train_epoch(
     mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None,
-    grad_accum: int = 1,
+    grad_accum: int = 1, aux_weight: float = 0.0,
 ):
     """Jitted ``epoch(state, batches) -> (state, MetricState)`` via lax.scan.
 
@@ -251,13 +300,13 @@ def make_train_epoch(
     ``parallel/tensor.py``, ZeRO-1 from ``parallel/zero.py``).
     """
     return _make_epoch(mesh, axis, state_sharding,
-                       make_accum_train_step_fn(grad_accum),
+                       make_accum_train_step_fn(grad_accum, aux_weight),
                        train=True, indexed=False)
 
 
 def make_train_epoch_indexed(
     mesh: Optional[Mesh] = None, axis: str = "data", state_sharding=None,
-    grad_accum: int = 1,
+    grad_accum: int = 1, aux_weight: float = 0.0,
 ):
     """Jitted ``epoch(state, data, ticks) -> (state, MetricState)`` where
     the per-step batch is gathered ON DEVICE.
@@ -274,7 +323,7 @@ def make_train_epoch_indexed(
     (S, B, ...) epoch.
     """
     return _make_epoch(mesh, axis, state_sharding,
-                       make_accum_train_step_fn(grad_accum),
+                       make_accum_train_step_fn(grad_accum, aux_weight),
                        train=True, indexed=True)
 
 

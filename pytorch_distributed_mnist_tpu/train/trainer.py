@@ -79,6 +79,7 @@ class Trainer:
         state_sharding=None,
         grad_accum: int = 1,
         epoch_gather: str = "host",
+        aux_weight: float = 0.0,
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
@@ -109,6 +110,11 @@ class Trainer:
                     "mode='explicit' does not support grad_accum; use "
                     "scan/stepwise"
                 )
+            if aux_weight:
+                raise ValueError(
+                    "mode='explicit' does not support aux_weight; use "
+                    "scan/stepwise"
+                )
             self._train_step = make_explicit_dp_train_step(mesh)
             # Explicit end to end: the eval step must be shard_map too, or
             # eval would silently run the auto-GSPMD path beside the
@@ -121,17 +127,20 @@ class Trainer:
             self._eval_step = make_explicit_dp_eval_step(mesh)
         else:
             self._train_step = make_train_step(
-                mesh, state_sharding=state_sharding, grad_accum=grad_accum
+                mesh, state_sharding=state_sharding, grad_accum=grad_accum,
+                aux_weight=aux_weight,
             )
             self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
         self.epoch_gather = epoch_gather
         if mode == "scan" and epoch_gather == "device":
             self._train_epoch = make_train_epoch_indexed(
-                mesh, state_sharding=state_sharding, grad_accum=grad_accum)
+                mesh, state_sharding=state_sharding, grad_accum=grad_accum,
+                aux_weight=aux_weight)
         else:
             self._train_epoch = (
                 make_train_epoch(mesh, state_sharding=state_sharding,
-                                 grad_accum=grad_accum)
+                                 grad_accum=grad_accum,
+                                 aux_weight=aux_weight)
                 if mode == "scan" else None
             )
         # Eval always uses the one-time device staging (_eval_staged):

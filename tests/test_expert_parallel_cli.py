@@ -93,6 +93,87 @@ def test_cli_expert_parallel_composes_with_grad_accum_and_fused_loss(tmp_path):
         plain["history"][0]["test_acc"], abs=1e-6)
 
 
+def test_aux_weight_gradient_flows_metrics_stay_ce():
+    """--moe-aux-weight changes the OBJECTIVE (router load-balance term
+    added, so router gradients differ) but not the REPORTED loss (metrics
+    are pure cross-entropy for reference parity, train/steps.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_mnist_tpu.models import get_model
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+    rng = np.random.default_rng(5)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(16, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32),
+    }
+    model = get_model("moe_mlp")
+    # Two identical states: the jitted steps donate their input state.
+    s_a = create_train_state(model, jax.random.key(0))
+    s_b = create_train_state(model, jax.random.key(0))
+    s0, m0 = make_train_step()(s_a, batch)
+    sw, mw = make_train_step(aux_weight=0.1)(s_b, batch)
+    # identical reported CE
+    assert float(m0.loss_sum) == pytest.approx(float(mw.loss_sum), rel=1e-6)
+    # but the aux gradient flowed into the router
+    r0 = np.asarray(s0.params["params"]["moe"]["router"]["kernel"])
+    rw = np.asarray(sw.params["params"]["moe"]["router"]["kernel"])
+    assert not np.allclose(r0, rw, atol=1e-9)
+    # The HEAD has no aux path (aux = f(router probs), upstream of it):
+    # from identical initial Adam moments, the first step must move the
+    # head identically. (The embed is NOT aux-free — it feeds the router.)
+    h0 = np.asarray(s0.params["params"]["head"]["kernel"])
+    hw = np.asarray(sw.params["params"]["head"]["kernel"])
+    np.testing.assert_allclose(h0, hw, atol=1e-6)
+
+
+def test_aux_weight_rejects_non_aux_intermediates():
+    """Only 'aux_loss'-named sows may join the objective: a diagnostic
+    sow must raise at trace time, not silently enter the loss."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+    from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+    class Sneaky(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train=False):
+            x = x.reshape((x.shape[0], -1))
+            y = nn.Dense(10)(x)
+            self.sow("intermediates", "expert_load", jnp.mean(y))
+            return y
+
+    state = create_train_state(Sneaky(), jax.random.key(0))
+    batch = {
+        "image": jnp.zeros((8, 28, 28, 1), jnp.float32),
+        "label": jnp.zeros((8,), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="non-aux_loss intermediate"):
+        make_train_step(aux_weight=0.1)(state, batch)
+
+
+@pytest.mark.slow
+def test_cli_moe_aux_weight_end_to_end(tmp_path):
+    summary = run(build_parser().parse_args(_base(
+        tmp_path, "--expert-parallel", "2", "--moe-aux-weight", "0.01",
+        "--grad-accum", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"))))
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["history"][0]["train_loss"])
+
+
+def test_cli_moe_aux_weight_rejects_non_moe(tmp_path):
+    args = build_parser().parse_args(_base(
+        tmp_path, "--moe-aux-weight", "0.01", "--model", "cnn",
+        "--checkpoint-dir", str(tmp_path / "ckpt")))
+    with pytest.raises(SystemExit, match="applies to --model moe_mlp"):
+        run(args)
+
+
 def test_cli_expert_parallel_rejects_non_moe(tmp_path):
     # argparse last-wins: --model cnn overrides _base's moe_mlp.
     args = build_parser().parse_args(_base(
