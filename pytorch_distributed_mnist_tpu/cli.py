@@ -21,7 +21,8 @@ for the TPU runtime:
   kernels: ``--optimizer adam_pallas``, ``--loss fused``,
   ``--attention flash``; parallelism: ``--tensor-parallel``,
   ``--sequence-parallel[-impl]``, ``--pipeline-stages``,
-  ``--expert-parallel`` (+ ``--moe-dispatch dense|capacity``),
+  ``--expert-parallel`` (+ ``--moe-dispatch dense|capacity``,
+  ``--moe-aux-weight``),
   ``--optimizer-sharding zero1|zero3``, ``--grad-accum``, ``--remat``;
   checkpoint lifecycle: ``--resume auto``, ``--keep-last``,
   ``--async-checkpoint``; input path: ``--epoch-gather host|device``
