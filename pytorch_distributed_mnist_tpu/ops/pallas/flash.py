@@ -116,16 +116,17 @@ def _block_sizes(t: int, block: int | None = None):
     # Pad T up to a tile-friendly block multiple (never shrink the block to
     # a divisor of T — a prime T would degrade to block 1); padded K
     # positions are masked inside the kernels, padded Q rows sliced off.
-    # Block choice: 128 matches the MXU tile; the 256-at-long-T default
-    # is a HYPOTHESIS (bigger tiles amortize loop/pipeline overhead;
-    # s/p scratch grows as block^2 f32 — 256 is 256 KB, well inside
-    # VMEM) motivated by the measured 0.86x-vs-dense at T=4096 with the
-    # old fixed 128 tile (tools/captured/kernels.json, 2026-07-31). The
-    # on-chip sweep (tools/sweep_flash.py, queued in the follow-up
-    # watcher) decides it; revisit this default when flash_sweep.json
-    # lands.
+    # Default block 128 = the MXU tile, and the configuration every
+    # captured measurement used (tools/captured/kernels.json: 1.31x
+    # dense at T=1024, 0.86x at T=4096). Bigger tiles at long T are a
+    # plausible win (amortized loop/pipeline overhead; s/p scratch is
+    # block^2 f32, 256 KB at 256 — well inside VMEM) but UNMEASURED:
+    # the on-chip sweep (tools/sweep_flash.py, queued in the follow-up
+    # watcher) exists to decide it. Until flash_sweep.json lands, the
+    # default stays the measured config and the hypothesis is reachable
+    # via the explicit ``block=`` override.
     if block is None:
-        block = 256 if t >= 2048 else 128 if t >= 128 else ((t + 7) // 8) * 8
+        block = 128 if t >= 128 else ((t + 7) // 8) * 8
     t_pad = ((t + block - 1) // block) * block
     return block, t_pad
 
@@ -366,9 +367,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     Tq must equal Tk (the kernel's start-aligned causal mask and the dense
     oracle's end-aligned mask agree exactly there).
 
-    ``block`` overrides the q/k tile edge (multiple of 8; default is the
-    measured length-dependent heuristic in ``_block_sizes`` — exposed for
-    the on-chip sweep, tools/sweep_flash.py).
+    ``block`` overrides the q/k tile edge (multiple of 8; default 128 —
+    the MXU tile and the configuration all captured measurements used.
+    The override exists for the on-chip block sweep,
+    tools/sweep_flash.py, which decides whether long sequences get a
+    bigger default).
     """
     if q.shape[1] != k.shape[1]:
         raise ValueError(
