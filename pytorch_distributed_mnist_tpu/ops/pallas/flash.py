@@ -116,15 +116,16 @@ def _block_sizes(t: int, block: int | None = None):
     # Pad T up to a tile-friendly block multiple (never shrink the block to
     # a divisor of T — a prime T would degrade to block 1); padded K
     # positions are masked inside the kernels, padded Q rows sliced off.
-    # Default block 128 = the MXU tile, and the configuration every
-    # captured measurement used (tools/captured/kernels.json: 1.31x
-    # dense at T=1024, 0.86x at T=4096). Bigger tiles at long T are a
-    # plausible win (amortized loop/pipeline overhead; s/p scratch is
-    # block^2 f32, 256 KB at 256 — well inside VMEM) but UNMEASURED:
-    # the on-chip sweep (tools/sweep_flash.py, queued in the follow-up
-    # watcher) exists to decide it. Until flash_sweep.json lands, the
-    # default stays the measured config and the hypothesis is reachable
-    # via the explicit ``block=`` override.
+    # Default block 128 = the MXU tile. No flash-vs-dense ratio is
+    # currently established at any T: the round-3 capture that timed
+    # this config was invalidated (sync returned early; BASELINE.md,
+    # tools/captured/kernels_r3_invalid.json). Bigger tiles at long T
+    # are a plausible win (amortized loop/pipeline overhead; s/p
+    # scratch is block^2 f32, 256 KB at 256 — well inside VMEM) but
+    # UNMEASURED: the on-chip sweep (tools/sweep_flash.py, queued in
+    # tools/tpu_watch_r4.sh) exists to decide it. Until a valid
+    # flash_sweep.json lands, the default stays the MXU tile and the
+    # hypothesis is reachable via the explicit ``block=`` override.
     if block is None:
         block = 128 if t >= 128 else ((t + 7) // 8) * 8
     t_pad = ((t + block - 1) // block) * block

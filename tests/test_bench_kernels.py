@@ -34,6 +34,60 @@ def test_bench_kernels_quick_emits_json():
 
 
 @pytest.mark.slow
+def test_bench_kernels_impossible_mfu_fails_loudly():
+    """Round-4 guard: a measurement faster than the chip's peak FLOPs
+    (sync failure — how round 3's kernels.json went bad) must exit
+    nonzero, stamp "invalid", and NOT carry the "sync": "host_read"
+    validity marker. Peak is faked to 1 FLOP/s so any real timing
+    violates it."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COMPILE_CACHE="",
+               BENCH_FAKE_PEAK_FLOPS="1.0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_kernels.py"),
+         "--quick", "--reps", "1", "--iters", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 1, (proc.stdout, proc.stderr[-2000:])
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    out = json.loads(line)
+    assert "impossible" in out["invalid"]
+    assert "sync" not in out
+
+
+@pytest.mark.slow
+def test_bench_kernels_adam_hbm_guard_fails_loudly():
+    """Same contract for the HBM-bandwidth bound on the (attention-MFU-
+    blind) Adam rows: faked 1 byte/s bandwidth makes any timing
+    impossible."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COMPILE_CACHE="",
+               BENCH_FAKE_HBM_BW="1.0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_kernels.py"),
+         "--quick", "--reps", "1", "--iters", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 1, (proc.stdout, proc.stderr[-2000:])
+    out = json.loads([l for l in proc.stdout.splitlines()
+                      if l.strip().startswith("{")][-1])
+    assert "impossible adam" in out["invalid"]
+    assert "sync" not in out
+
+
+@pytest.mark.slow
+def test_sweep_flash_impossible_mfu_fails_loudly():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COMPILE_CACHE="",
+               BENCH_FAKE_PEAK_FLOPS="1.0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep_flash.py"),
+         "--quick", "--reps", "1", "--iters", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 1, (proc.stdout, proc.stderr[-2000:])
+    out = json.loads([l for l in proc.stdout.splitlines()
+                      if l.strip().startswith("{")][-1])
+    assert "impossible" in out["invalid"]
+    assert "sync" not in out
+
+
+@pytest.mark.slow
 def test_sweep_flash_quick_emits_json():
     """Same rot guard for the flash block-size sweep: the follow-up
     watcher runs it unattended in a rare chip-recovery window, and it

@@ -31,18 +31,100 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _timeit(fn, args, reps: int, iters: int) -> float:
-    """Seconds per call: warmup (compile) then best-of-``reps`` means."""
+class MeasurementInvalid(RuntimeError):
+    """A timing that violates a physical bound (MFU or HBM-bandwidth
+    utilization above 100%): the device sync did not actually wait for
+    execution, so every number in the run is garbage. Raised past the
+    partial-result handlers in ``main`` — the process exits nonzero and
+    the output carries ``"invalid"`` instead of the ``"sync":
+    "host_read"`` validity marker, so a watcher gating on rc==0 can
+    never publish the capture as evidence."""
+
+
+# Per-chip peak HBM bandwidth, bytes/sec, by TPU generation (public spec
+# sheets). Used only as an impossibility bound for HBM-bound kernels
+# (the Adam update): measured time below bytes_moved/peak_bw is garbage.
+_PEAK_HBM_BW = [
+    ("v6", 1638e9),  # Trillium
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
+
+def _peak_hbm_bw(device_kind: str):
+    fake = os.environ.get("BENCH_FAKE_HBM_BW")
+    if fake:  # test-only: see bench._peak_flops
+        return float(fake)
+    kind = device_kind.lower()
+    for key, bw in _PEAK_HBM_BW:
+        if key in kind:
+            return bw
+    return None
+
+
+def check_mfu(label: str, secs: float, flops: float, peak):
+    """MFU for a row, guarded: >100% of peak is physically impossible —
+    it means the device sync did not wait for execution (exactly how
+    round 3's kernels.json capture went bad). Shared by this file and
+    tools/sweep_flash.py so the bound and its message can never
+    diverge. Returns None when the device kind has no known peak."""
+    if not peak:
+        return None
+    mfu = flops / secs / peak
+    if mfu > 1.0:
+        raise MeasurementInvalid(
+            f"impossible {label} MFU {mfu:.4g} (>100% of peak): "
+            f"device sync did not wait for execution")
+    return round(mfu, 4)
+
+
+def _fake_bounds() -> dict:
+    """Test-only physical-bound overrides present in the environment.
+    They must never silently shape a real capture: callers stamp them
+    into the output JSON and refuse to run on a real TPU with them
+    set."""
+    return {k: os.environ[k]
+            for k in ("BENCH_FAKE_PEAK_FLOPS", "BENCH_FAKE_HBM_BW")
+            if os.environ.get(k)}
+
+
+def _host_read(out) -> float:
+    """Force a device→host roundtrip on one element of ``out``.
+
+    Round-3 postmortem: ``jax.block_until_ready`` returned early on the
+    proxied TPU link, and kernels.json recorded times 4-120× too small
+    (up to 11,793% MFU).  A scalar read back to the host can only
+    complete after every program queued ahead of it on the device stream
+    has executed — the device runs programs in order — so a timestamp
+    taken after this call is a true upper bound on execution end.  The
+    scalar-index op is compiled during warmup (``_timeit`` calls this on
+    the warmup output too), leaving only the ~2-byte transfer in the
+    timed region.
+    """
     import jax
 
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(leaf[(0,) * leaf.ndim])
+
+
+def _timeit(fn, args, reps: int, iters: int) -> float:
+    """Seconds per call: warmup (compile) then best-of-``reps`` means.
+
+    Sync protocol is a host read of the last output (see ``_host_read``),
+    never ``block_until_ready`` alone.
+    """
     out = fn(*args)
-    jax.block_until_ready(out)
+    _host_read(out)
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(*args)
-        jax.block_until_ready(out)
+        _host_read(out)
         best = min(best, (time.perf_counter() - t0) / iters)
     return best
 
@@ -89,8 +171,8 @@ def bench_attention(quick: bool, reps: int, iters: int) -> list:
             "flash_ms": round(flash_s * 1e3, 3),
             "dense_ms": round(dense_s * 1e3, 3),
             "flash_over_dense_speedup": round(dense_s / flash_s, 3),
-            "flash_mfu": round(flops / flash_s / peak, 4) if peak else None,
-            "dense_mfu": round(flops / dense_s / peak, 4) if peak else None,
+            "flash_mfu": check_mfu(f"flash T={t}", flash_s, flops, peak),
+            "dense_mfu": check_mfu(f"dense T={t}", dense_s, flops, peak),
         })
     return rows
 
@@ -128,12 +210,28 @@ def bench_adam(quick: bool, reps: int, iters: int) -> dict:
 
     optax_s = step_time(optax.adam(1e-3))
     fused_s = step_time(pallas_adam(1e-3))
-    return {
+    out = {
         "n_params": n_params,
         "optax_ms": round(optax_s * 1e3, 3),
         "fused_ms": round(fused_s * 1e3, 3),
         "fused_over_optax_speedup": round(optax_s / fused_s, 3),
     }
+    # Impossibility bound for this HBM-bound kernel (the attention MFU
+    # check can't see it): any correct f32 Adam step must move at least
+    # reads of p,g,m,v plus writes of p,m,v = 7 arrays x 4 bytes/param
+    # through HBM. Faster than peak bandwidth allows = the sync lied.
+    bw = _peak_hbm_bw(jax.devices()[0].device_kind)
+    if bw:
+        floor_s = 28.0 * n_params / bw
+        for name, secs in (("optax", optax_s), ("fused", fused_s)):
+            frac = floor_s / secs  # fraction of peak HBM bw; must be <= 1
+            out[f"{name}_hbm_frac"] = round(frac, 4)
+            if frac > 1.0:
+                raise MeasurementInvalid(
+                    f"impossible adam {name} time {secs * 1e3:.3f} ms: "
+                    f"{frac:.2f}x peak HBM bandwidth for the minimum "
+                    f"{28 * n_params} bytes moved; sync did not wait")
+    return out
 
 
 def main() -> None:
@@ -151,22 +249,57 @@ def main() -> None:
     configure_jax(jax)
 
     device = jax.devices()[0]
+    fakes = _fake_bounds()
+    if fakes and device.platform == "tpu":
+        # A leaked test override would make a real capture's physical
+        # bounds meaningless while still carrying the validity marker.
+        print(json.dumps({
+            "metric": "pallas_kernel_vs_xla", "backend": device.platform,
+            "invalid": f"test-only bound overrides set on a real TPU "
+                       f"run: {sorted(fakes)}"}))
+        sys.exit(1)
     out = {
         "metric": "pallas_kernel_vs_xla",
         "backend": device.platform,
         "device_kind": device.device_kind,
         "quick": args.quick,
+        # Provenance: which sync protocol produced these times. host_read
+        # = a scalar fetched from device per rep (cannot complete before
+        # execution does); the round-3 capture that lacked this field
+        # used block_until_ready and is invalid (see _host_read).
+        "sync": "host_read",
     }
+    if fakes:
+        out["fake_bounds"] = fakes  # test-only run, never evidence
     try:
-        out["attention_fwd_bwd"] = bench_attention(
-            args.quick, args.reps, args.iters)
-    except Exception as exc:  # noqa: BLE001 - partial results still print
-        out["attention_error"] = repr(exc)
-    try:
-        out["adam_update"] = bench_adam(args.quick, args.reps, args.iters)
-    except Exception as exc:  # noqa: BLE001
-        out["adam_error"] = repr(exc)
+        try:
+            out["attention_fwd_bwd"] = bench_attention(
+                args.quick, args.reps, args.iters)
+        except MeasurementInvalid:
+            raise  # physical-bound violation: whole run is garbage
+        except Exception as exc:  # noqa: BLE001 - partial results still print
+            out["attention_error"] = repr(exc)
+        try:
+            out["adam_update"] = bench_adam(args.quick, args.reps, args.iters)
+        except MeasurementInvalid:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            out["adam_error"] = repr(exc)
+    except MeasurementInvalid as exc:
+        # Strip the validity marker, stamp the diagnosis, exit nonzero:
+        # a watcher that gates publication on rc==0 can never turn this
+        # run into kernels.json, and even a raw stdout redirect carries
+        # "invalid" instead of "sync": "host_read".
+        out.pop("sync", None)
+        out["invalid"] = str(exc)
+        print(json.dumps(out))
+        sys.exit(1)
     print(json.dumps(out))
+    if "attention_error" in out or "adam_error" in out:
+        # Partial results printed for diagnosis, but a capture missing
+        # rows must not pass an rc==0 publication gate (the watcher
+        # would mark the item done and never retry a transient failure).
+        sys.exit(2)
 
 
 if __name__ == "__main__":

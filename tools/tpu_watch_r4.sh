@@ -1,0 +1,128 @@
+#!/bin/bash
+# Round-4 capture watcher. Supersedes tools/tpu_watch_followup.sh (round 3).
+#
+# What must land at the next chip recovery, in priority order:
+#   1. kernels.json        — tools/bench_kernels.py with the FIXED sync
+#                            (host-read per rep + impossibility guards);
+#                            the round-3 capture is invalid (BASELINE.md)
+#                            and was renamed kernels_r3_invalid.json.
+#   2. tests_tpu_rerun.log — the on-chip suite with the round-3 staged
+#                            test fixes (expect green; 6/9 pre-fix).
+#   3. northstar_warm.json — warm-compile-cache north star (<60 s target).
+#   4. flash_sweep.json    — block-size sweep behind the T=4096 decision.
+#   5. bench.json          — fresh headline line from the round-4 bench.py.
+#
+# Publication is gated on the producer's exit code (bench_kernels.py and
+# sweep_flash.py exit nonzero on physically impossible measurements, so a
+# broken-sync run can never be published as evidence). Each item is
+# skipped once captured, so a retry cycle only re-runs what failed.
+# Retry cycles are CAPPED (round-3 advisor finding: the uncapped followup
+# loop could churn one commit per ~30-min attempt forever on a
+# persistently failing test).
+set -u
+OUT=/root/repo/tools/captured
+STATE=/tmp/tpu_watch_r4_state
+mkdir -p "$OUT" "$STATE"
+export BENCH_COMPILE_CACHE=/root/repo/.xla_cache
+MAX_CYCLES=6
+CYCLES=0
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
+
+# run_capture <name> <timeout> <dest> <cmd...>
+# Runs cmd with stdout -> dest.new; publishes dest only on rc==0.
+# Marks $STATE/<name> on success so later cycles skip it.
+run_capture() {
+  local name=$1 tmo=$2 dest=$3; shift 3
+  [ -e "$STATE/$name" ] && return 0
+  timeout "$tmo" "$@" > "$dest.new" 2>> "$OUT/watch.log"
+  local rc=$?
+  if [ "$rc" -eq 0 ]; then
+    mv "$dest.new" "$dest"
+    touch "$STATE/$name"
+  else
+    cat "$dest.new" >> "$OUT/watch.log" 2>/dev/null
+    rm -f "$dest.new"
+  fi
+  log "r4 capture $name rc=$rc"
+  return "$rc"
+}
+
+while true; do
+  if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1; then
+    log "TPU alive - r4 capturing (cycle $((CYCLES + 1))/$MAX_CYCLES)"
+    # Wait out any hermetic-suite run: one host core; a concurrent
+    # pytest would pollute every wall-clock number below.
+    for _ in $(seq 1 60); do
+      pgrep -f "pytest /root/repo/tests/" >/dev/null 2>&1 || \
+        pgrep -f "pytest tests/" >/dev/null 2>&1 || break
+      sleep 30
+    done
+
+    run_capture kernels 1800 "$OUT/kernels.json" \
+      python /root/repo/tools/bench_kernels.py; K_RC=$?
+
+    # pytest writes its own log (stdout IS the artifact, failing or not)
+    # but only a green run marks the item done.
+    if [ ! -e "$STATE/tests_tpu" ]; then
+      timeout 1800 python -m pytest /root/repo/tests_tpu/ -q \
+        > "$OUT/tests_tpu_rerun.log" 2>&1
+      T_RC=$?
+      [ "$T_RC" -eq 0 ] && touch "$STATE/tests_tpu"
+      log "r4 capture tests_tpu rc=$T_RC (tests_tpu_rerun.log)"
+    else
+      T_RC=0
+    fi
+
+    run_capture northstar_warm 1800 "$OUT/northstar_warm.json" \
+      python /root/repo/tools/northstar.py \
+        --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
+        --compile-cache "$BENCH_COMPILE_CACHE" \
+        --root /tmp/ns_tpu_warm; N_RC=$?
+
+    run_capture flash_sweep 2400 "$OUT/flash_sweep.json" \
+      python /root/repo/tools/sweep_flash.py; F_RC=$?
+
+    # Fresh headline bench line from the round-4 bench.py. Same
+    # TPU-backed/no-self-re-emission gate as tpu_watch.sh round 3.
+    if [ ! -e "$STATE/bench" ]; then
+      BENCH_CAPTURE_PATH= timeout 2400 python /root/repo/bench.py \
+        > "$OUT/bench.json.new" 2>> "$OUT/watch.log"
+      B_RC=$?
+      if [ "$B_RC" -eq 0 ] \
+          && grep -q '"backend": "tpu"' "$OUT/bench.json.new" 2>/dev/null \
+          && ! grep -q '"source": "watcher_capture"' "$OUT/bench.json.new" 2>/dev/null; then
+        mv "$OUT/bench.json.new" "$OUT/bench.json"
+        touch "$STATE/bench"
+      else
+        cat "$OUT/bench.json.new" >> "$OUT/watch.log" 2>/dev/null
+        rm -f "$OUT/bench.json.new"
+        B_RC=1
+      fi
+      log "r4 capture bench rc=$B_RC"
+    else
+      B_RC=0
+    fi
+
+    log "r4 cycle done kernels=$K_RC tests_tpu=$T_RC northstar_warm=$N_RC flash_sweep=$F_RC bench=$B_RC"
+    git -C /root/repo add tools/captured \
+      && git -C /root/repo commit -q \
+        -m "tools/captured: r4 capture kernels=$K_RC tests_tpu=$T_RC northstar_warm=$N_RC flash_sweep=$F_RC bench=$B_RC" \
+        -- tools/captured >> "$OUT/watch.log" 2>&1
+    if [ "$K_RC" -eq 0 ] && [ "$T_RC" -eq 0 ] && [ "$N_RC" -eq 0 ] \
+        && [ "$F_RC" -eq 0 ] && [ "$B_RC" -eq 0 ]; then
+      log "r4 capture COMPLETE"
+      exit 0
+    fi
+    CYCLES=$((CYCLES + 1))
+    if [ "$CYCLES" -ge "$MAX_CYCLES" ]; then
+      log "r4 capture INCOMPLETE after $MAX_CYCLES cycles - giving up"
+      exit 1
+    fi
+    log "r4 capture INCOMPLETE - will retry ($CYCLES/$MAX_CYCLES used)"
+    sleep 300
+    continue
+  fi
+  echo "$(date -u +%FT%TZ) tpu still down (r4)" >> "$OUT/watch.log"
+  sleep 390
+done
