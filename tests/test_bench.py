@@ -171,6 +171,74 @@ def test_secondary_measurements_plumbing_cpu():
 
 
 @pytest.mark.slow
+def test_vit_child_tpu_branch_smoke_cpu():
+    """The --vit child's exact TPU branch (flash attention + remat +
+    bf16 + dense-attention secondary) at tiny interpret-mode shapes
+    (BENCH_VIT_TPU_SMOKE): a latent bug there must surface here, not in
+    a rare chip-recovery window."""
+    env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_VIT="1",
+               BENCH_VIT_TPU_SMOKE="1", BENCH_COMPILE_CACHE="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child", "2", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    result = json.loads(line)
+    assert result["ok"], result
+    assert result["attention"] == "flash" and result["remat"]
+    assert result["sync"] == "host_read"
+    assert "dense_attn_error" not in result, result
+    assert result["images_per_sec_per_chip_dense_attn"] > 0
+    assert result["flash_over_dense_speedup"] > 0
+
+
+@pytest.mark.slow
+def test_vit_main_line_cpu():
+    """bench.py --vit end-to-end on CPU: the parent ladder, JSON-line
+    contract, and field pass-through (value/mfu/model_config/sync)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COMPILE_CACHE="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--vit"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    out = json.loads(line)
+    assert out["metric"] == "mnist_vit_train_images_per_sec_per_chip"
+    assert out["value"] > 0
+    assert out["sync"] == "host_read"
+    assert out["model_config"]["embed_dim"] > 0
+    assert out["measured_at"].endswith("Z")
+
+
+def test_vit_model_flops_count():
+    """Pin the analytic ViT FLOPs count against a hand-derived value so a
+    future edit can't silently change the MFU denominator: one block at
+    T=4, C=8, r=4 is (8+16)*4*64 + 4*16*8 = 6656; embed (p=14: 2*4*196*8
+    = 12544) and head (2*8*10 = 160) add, x3 for the step."""
+    got = bench._vit_model_flops_per_image(4, 8, 1, 14)
+    assert got == 3.0 * (6656 + 12544 + 160)
+
+
+@pytest.mark.slow
+def test_vit_impossible_mfu_rejected(monkeypatch):
+    """The ViT child's MFU guard: a fake 1-FLOP/s peak makes any timing
+    impossible; the child must return ok=False, never a number."""
+    import subprocess as sp
+    env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_VIT="1",
+               BENCH_VIT_TPU_SMOKE="1", BENCH_COMPILE_CACHE="",
+               BENCH_FAKE_PEAK_FLOPS="1.0")
+    proc = sp.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child", "1", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    line = [l for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")][-1]
+    result = json.loads(line)
+    assert not result["ok"]
+    assert "impossible ViT MFU" in result["error"]
+
+
+@pytest.mark.slow
 def test_compile_cache_config_plumbing(tmp_path):
     """BENCH_COMPILE_CACHE reaches jax_compilation_cache_dir in the child."""
     env = dict(os.environ, BENCH_FORCE_CPU="1",
