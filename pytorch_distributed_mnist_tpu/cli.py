@@ -137,6 +137,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "when absent (reference :137-138 download=True; for "
                         "multi-host runs, pre-download with a single-process "
                         "run first, as the reference README does)")
+    p.add_argument("--allow-synthetic", action="store_true",
+                   help="if the real dataset is missing (and --download "
+                        "absent or failed), fall back to the labelled "
+                        "synthetic dataset instead of exiting. Without "
+                        "this flag a missing dataset is a hard error — "
+                        "the reference always downloads (:137-138), so "
+                        "silently training on fake data would invert its "
+                        "contract and produce fake accuracy numbers")
     p.add_argument("--dtype", type=str, default=None,
                    choices=["bf16", "f32"],
                    help="compute dtype override. linear/cnn/vit default to "
@@ -303,6 +311,8 @@ def _moe_num_experts() -> int:
 def _build_loaders(args, seed: int, mesh):
     name = "mnist" if args.dataset == "synthetic" else args.dataset
     synthesize = args.dataset == "synthetic"
+    # Default False for programmatic callers that build args by hand.
+    allow_synthetic = getattr(args, "allow_synthetic", False)
 
     if args.download and not synthesize:
         # Every process attempts the (idempotent, atomically-published)
@@ -329,6 +339,15 @@ def _build_loaders(args, seed: int, mesh):
                 np.asarray([have], dtype=np.bool_)
             )
             if not bool(np.all(everyone)):
+                if not allow_synthetic:
+                    raise SystemExit(
+                        f"{name!r} is not present on every host "
+                        f"({int(np.sum(everyone))}/{everyone.size} have "
+                        f"it) and --allow-synthetic was not given. "
+                        f"Pre-download on every host, or pass "
+                        f"--allow-synthetic to train on labelled fake "
+                        f"data, or --dataset synthetic."
+                    )
                 log0(
                     f"WARNING: {name!r} is not present on every host "
                     f"({int(np.sum(everyone))}/{everyone.size} have it); "
@@ -349,6 +368,20 @@ def _build_loaders(args, seed: int, mesh):
                                     synthesize_if_missing=False)
             except FileNotFoundError:
                 split = "train" if train else "test"
+                # Fail-fast contract (reference :137-138 always downloads
+                # a missing dataset): a user reproducing the reference's
+                # command line must never silently train on fake data
+                # and report a fake accuracy.
+                if not allow_synthetic:
+                    hint = ("the download may have failed (see the "
+                            "warning above)" if args.download else
+                            "pass --download to fetch it")
+                    raise SystemExit(
+                        f"no {name} {split}-split IDX files under "
+                        f"{args.root!r} — {hint}, or pass "
+                        f"--allow-synthetic to train on labelled fake "
+                        f"data, or --dataset synthetic."
+                    )
                 log0(f"WARNING: no {name} {split}-split IDX files under "
                      f"{args.root!r}; using the synthetic fallback dataset")
                 used_synthetic = True
@@ -1026,9 +1059,15 @@ def run(args, epoch_callback=None) -> dict:
                 train_loss, train_acc = trainer.train()
             with phase("eval", epoch=epoch):
                 test_loss, test_acc = trainer.evaluate()
+            # Synthetic data is stamped on EVERY epoch line (not just the
+            # startup warning): a fake-data accuracy must never read as a
+            # real one in a scrolled log. Real-data lines stay
+            # byte-compatible with the reference's format (:216-224).
+            synth_tag = ", dataset: synthetic" if dataset_synthesized else ""
             log0(f"Epoch: {epoch}/{args.epochs}, lr: {lr_of(epoch):g},"
                  f" train loss: {train_loss}, train acc: {train_acc},"
-                 f" test loss: {test_loss}, test acc: {test_acc}")
+                 f" test loss: {test_loss}, test acc: {test_acc}"
+                 f"{synth_tag}")
             is_best = test_acc.accuracy > best_acc  # (:245-246)
             best_acc = max(test_acc.accuracy, best_acc)
             ckpt_kwargs = dict(
@@ -1057,6 +1096,8 @@ def run(args, epoch_callback=None) -> dict:
                         # THIS epoch's train rate, not the cumulative
                         # average (epoch 0's compile would drag it down).
                         "images_per_sec": timer.last_images_per_sec,
+                        "dataset": ("synthetic" if dataset_synthesized
+                                    else args.dataset),
                     }) + "\n")
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break

@@ -71,10 +71,45 @@ def test_cnn_overfits_synthetic(tmp_path):
 
 
 def test_fashion_mnist_dataset_flag(tmp_path):
-    # No real FashionMNIST on disk -> synthetic fallback via the same path
-    # (BASELINE config 5's dataset swap-in is a flag, not a code edit).
-    out = run(make_args(tmp_path, dataset="fashion_mnist", epochs=1))
+    # No real FashionMNIST on disk -> --allow-synthetic opts into the
+    # labelled fallback (BASELINE config 5's dataset swap-in is a flag,
+    # not a code edit).
+    out = run(make_args(tmp_path, dataset="fashion_mnist", epochs=1,
+                        allow_synthetic=True))
     assert out["epochs_run"] == 1
+    assert out["dataset_synthesized"]
+
+
+def test_missing_dataset_fails_fast(tmp_path):
+    # The reference ALWAYS downloads a missing dataset (:137-138); a
+    # missing dataset here without --download/--allow-synthetic must be
+    # a hard error, never a silent synthetic run with fake accuracy.
+    with pytest.raises(SystemExit, match="allow-synthetic"):
+        run(make_args(tmp_path, dataset="fashion_mnist", epochs=1))
+
+
+def test_synthetic_tag_on_epoch_lines_and_metrics(tmp_path, capsys):
+    mf = tmp_path / "metrics.jsonl"
+    out = run(make_args(tmp_path, dataset="fashion_mnist", epochs=1,
+                        allow_synthetic=True, metrics_file=str(mf)))
+    assert out["dataset_synthesized"]
+    printed = capsys.readouterr().out
+    epoch_lines = [l for l in printed.splitlines() if l.startswith("Epoch:")]
+    assert epoch_lines and all(
+        "dataset: synthetic" in l for l in epoch_lines)
+    import json
+
+    rows = [json.loads(l) for l in mf.read_text().splitlines()]
+    assert rows and all(r["dataset"] == "synthetic" for r in rows)
+
+
+def test_explicit_synthetic_needs_no_flag_and_is_tagged(tmp_path, capsys):
+    out = run(make_args(tmp_path, epochs=1))  # --dataset synthetic
+    assert out["dataset_synthesized"]
+    printed = capsys.readouterr().out
+    epoch_lines = [l for l in printed.splitlines() if l.startswith("Epoch:")]
+    assert epoch_lines and all(
+        "dataset: synthetic" in l for l in epoch_lines)
 
 
 def test_debug_nans_flag(tmp_path):

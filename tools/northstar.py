@@ -77,6 +77,10 @@ def main() -> None:
         # path, tests/test_device_gather.py) — wall-clock-to-target is
         # this measurement's whole point.
         "--epoch-gather", "device",
+        # This runner labels the dataset in its own output (the
+        # "synthetic (mnist files unavailable)" relabel below), so the
+        # fallback is safe here where the bare CLI now fails fast.
+        "--allow-synthetic",
     ]
     if args.download:
         cli_args.append("--download")
