@@ -262,9 +262,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--async-checkpoint", action="store_true",
                    help="write checkpoints on a background thread, "
                         "overlapping file I/O with the next epoch "
-                        "(leaves are snapshotted to host memory first, so "
-                        "the saved state is exactly the epoch's; sharded "
-                        "multi-host layouts fall back to synchronous saves)")
+                        "(leaves — or, for sharded multi-host layouts, "
+                        "this host's owned shards — are snapshotted to "
+                        "host memory first, so the saved state is exactly "
+                        "the epoch's; a sharded directory is published at "
+                        "the next epoch's save via a main-thread barrier, "
+                        "Orbax-style deferred commit)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace here")
     p.add_argument("--compile-cache", type=str, default=None, metavar="DIR",

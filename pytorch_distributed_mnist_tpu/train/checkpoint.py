@@ -147,20 +147,11 @@ def _shard_slices(leaf, shard) -> Tuple[list, list]:
     return starts, stops
 
 
-def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
-                  directory: str, pid: int, keep_last: int = 0) -> str:
-    """Every process writes its owned shards; process 0 publishes the dir.
+def _sharded_prepare(directory: str, epoch: int, pid: int) -> Tuple[str, str]:
+    """Phase 1 (main thread, collective): clean + create the tmp dir.
 
-    Ownership = ``shard.replica_id == 0``: exactly one device globally
-    holds replica 0 of each distinct shard, so replicated leaves (and the
-    replicated dims of partially-sharded ones) are written once, not
-    once per host.
-
-    ``directory`` must be a filesystem shared by all hosts (the same
-    assumption the reference makes for every rank loading rank 0's file,
-    ``:202``); process 0 verifies that after the write barrier by checking
-    every host's index file is visible before publishing.
-    """
+    Returns ``(tmp, final)``. Contains a cross-host barrier, so it must
+    run on the thread that owns the device (never a writer thread)."""
     final = os.path.join(directory, f"checkpoint_{epoch}.ckpt")
     tmp = final + ".tmp"  # same deterministic name on every process
     if pid == 0:
@@ -172,7 +163,18 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
         os.makedirs(tmp)
     _barrier(f"ckpt_tmp_clean_{epoch}")  # nobody writes into a dir being rm'd
     os.makedirs(tmp, exist_ok=True)
+    return tmp, final
 
+
+def _sharded_collect(named, pid: int) -> Tuple[Dict[str, np.ndarray], list]:
+    """Phase 2 (main thread, device reads): host copies of OWNED shards.
+
+    Ownership = ``shard.replica_id == 0``: exactly one device globally
+    holds replica 0 of each distinct shard, so replicated leaves (and the
+    replicated dims of partially-sharded ones) are written once, not once
+    per host. ``np.asarray(shard.data)`` is a D2H copy, so the returned
+    payload is a consistent snapshot — the train loop may donate the
+    device buffers the moment this returns."""
     payload: Dict[str, np.ndarray] = {}
     index = []
     for i, (_, leaf) in enumerate(named):
@@ -194,7 +196,25 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
             starts, stops = _shard_slices(leaf, shard)
             index.append({"leaf": i, "key": key, "start": starts,
                           "stop": stops})
+    return payload, index
 
+
+def _sharded_meta(named, epoch: int, best_acc: float) -> Dict[str, Any]:
+    return {
+        "epoch": epoch + 1,
+        "best_acc": float(best_acc),
+        "leaf_names": [k for k, _ in named],
+        "global_shapes": [list(np.shape(v)) for _, v in named],
+        "dtypes": [np.dtype(getattr(v, "dtype", np.float32)).name
+                   for _, v in named],
+        "format_version": 2,
+    }
+
+
+def _sharded_write_files(tmp: str, pid: int, payload, index,
+                         meta: Optional[Dict[str, Any]]) -> None:
+    """Phase 3 (any thread): pure file I/O, no device or collective use —
+    the part the AsyncCheckpointer overlaps with the next epoch."""
     shard_file = f"shards_p{pid:05d}.npz"
     if payload:
         with open(os.path.join(tmp, shard_file), "wb") as f:
@@ -202,19 +222,20 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
     with open(os.path.join(tmp, f"index_p{pid:05d}.json"), "w") as f:
         json.dump({"file": shard_file if payload else None,
                    "shards": index}, f)
-    if pid == 0:
-        meta = {
-            "epoch": epoch + 1,
-            "best_acc": float(best_acc),
-            "leaf_names": [k for k, _ in named],
-            "global_shapes": [list(np.shape(v)) for _, v in named],
-            "dtypes": [np.dtype(getattr(v, "dtype", np.float32)).name
-                       for _, v in named],
-            "format_version": 2,
-        }
+    if meta is not None:  # pid 0 only
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
 
+
+def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
+                     is_best: bool, keep_last: int, pid: int) -> str:
+    """Phase 4 (main thread, collective): barrier until every host's
+    files are on disk, then process 0 atomically publishes the dir.
+
+    ``directory`` must be a filesystem shared by all hosts (the same
+    assumption the reference makes for every rank loading rank 0's file,
+    ``:202``); process 0 verifies that after the write barrier by checking
+    every host's index file is visible before publishing."""
     _barrier(f"ckpt_save_{epoch}")  # all shard files are on disk
     if pid == 0:
         # Shared-filesystem check: every host's index file must be visible
@@ -245,6 +266,21 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
         prune_checkpoints(directory, keep_last)
     _barrier(f"ckpt_publish_{epoch}")  # no reader races a half-published dir
     return final
+
+
+def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
+                  directory: str, pid: int, keep_last: int = 0) -> str:
+    """Every process writes its owned shards; process 0 publishes the dir.
+
+    Synchronous composition of the four phases; the AsyncCheckpointer
+    runs phases 1-2 inline, phase 3 on its writer thread, and phase 4 at
+    the next main-thread drain point."""
+    tmp, final = _sharded_prepare(directory, epoch, pid)
+    payload, index = _sharded_collect(named, pid)
+    meta = _sharded_meta(named, epoch, best_acc) if pid == 0 else None
+    _sharded_write_files(tmp, pid, payload, index, meta)
+    return _sharded_publish(tmp, final, directory, epoch, is_best,
+                            keep_last, pid)
 
 
 def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
@@ -403,30 +439,45 @@ def prune_checkpoints(directory: str, keep_last: int) -> None:
 class AsyncCheckpointer:
     """Overlap checkpoint file I/O with the next epoch's compute.
 
-    ``save()`` snapshots every leaf to host memory synchronously (the only
-    part that must see a consistent device state — the train loop is free
-    to donate/overwrite buffers the moment it returns), then runs the
-    actual ``save_checkpoint`` on a single worker thread. ``wait()`` joins
-    the in-flight write; it is called before the next ``save`` (one write
-    in flight at most, so a slow disk can delay training by at most one
-    checkpoint), at context exit, and returns the last written path.
+    ``save()`` snapshots every leaf (npz layout) or every OWNED shard
+    (sharded layout) to host memory synchronously — the only part that
+    must see a consistent device state; the train loop is free to
+    donate/overwrite buffers the moment it returns — then runs the file
+    writes on a single worker thread. ``wait()`` joins the in-flight
+    write; it is called before the next ``save`` (one write in flight at
+    most, so a slow disk can delay training by at most one checkpoint),
+    at context exit, and returns the last written path.
 
-    Cross-host sharded states fall back to a synchronous save: the sharded
-    layout's correctness barriers are device collectives, and running
-    those on a side thread while the main thread launches train steps
-    could interleave two collective programs — a deadlock, not a speedup.
+    Sharded (multi-host) layout: the layout's correctness barriers are
+    device collectives, and running those on a side thread while the
+    main thread launches train steps could interleave two collective
+    programs — a deadlock. So the phases split (Orbax-style commit):
+    tmp-dir prepare (barrier) + shard snapshot run inline in ``save()``,
+    the shard/index/meta file writes run on the writer thread, and the
+    publish barrier + atomic rename run at the NEXT main-thread drain
+    point (the next ``save()`` or the context exit). Every process
+    drains at the same logical step, so the deferred collectives match.
+    Net effect: epoch N's directory is published at epoch N+1's save —
+    a crash loses at most the one unpublished write, the same guarantee
+    the async npz path gives for its in-flight file.
     """
 
     def __init__(self) -> None:
         self._thread = None
         self._result: Optional[str] = None
         self._error: Optional[BaseException] = None
+        self._pending_publish: Optional[Dict[str, Any]] = None
 
     def save(self, state, **kwargs) -> None:
         self.wait()
         named = _leaves_with_names(_state_tree(state))
-        if not all(_npz_saveable(v) for _, v in named):
-            self._result = save_checkpoint(state, **kwargs)
+        layout = kwargs.pop("layout", None)
+        if layout not in (None, "npz", "sharded"):
+            raise ValueError(f"unknown checkpoint layout {layout!r}")
+        if layout == "sharded" or (
+            layout is None and not all(_npz_saveable(v) for _, v in named)
+        ):
+            self._save_sharded_async(named, kwargs)
             return
         pid = kwargs.get("process_index")
         if (jax.process_index() if pid is None else pid) != 0:
@@ -454,13 +505,49 @@ class AsyncCheckpointer:
         self._thread = threading.Thread(target=_write, daemon=True)
         self._thread.start()
 
+    def _save_sharded_async(self, named, kwargs: Dict[str, Any]) -> None:
+        epoch = kwargs["epoch"]
+        directory = kwargs.get("directory", CHECKPOINT_DIR)
+        pid = kwargs.get("process_index")
+        pid = jax.process_index() if pid is None else pid
+        # Phases 1-2 inline: the tmp-clean barrier (collective) and the
+        # owned-shard D2H snapshot (device reads).
+        tmp, final = _sharded_prepare(directory, epoch, pid)
+        payload, index = _sharded_collect(named, pid)
+        meta = (_sharded_meta(named, epoch, kwargs["best_acc"])
+                if pid == 0 else None)
+
+        def _write() -> None:
+            try:
+                with jax.profiler.TraceAnnotation(
+                    "checkpoint_async_write", epoch=epoch
+                ):
+                    _sharded_write_files(tmp, pid, payload, index, meta)
+            except BaseException as exc:  # surfaced by the next wait()
+                self._error = exc
+
+        # Phase 4 runs at the next drain, on the main thread.
+        self._pending_publish = dict(
+            tmp=tmp, final=final, directory=directory, epoch=epoch,
+            is_best=kwargs.get("is_best", False),
+            keep_last=kwargs.get("keep_last", 0), pid=pid,
+        )
+        import threading
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
     def wait(self) -> Optional[str]:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
         if self._error is not None:
+            self._pending_publish = None
             exc, self._error = self._error, None
             raise exc
+        if self._pending_publish is not None:
+            pub, self._pending_publish = self._pending_publish, None
+            self._result = _sharded_publish(**pub)
         return self._result
 
     def __enter__(self) -> "AsyncCheckpointer":
@@ -471,9 +558,13 @@ class AsyncCheckpointer:
         # unless the body is already unwinding on its own exception.
         if exc_info[0] is None:
             self.wait()
-        elif self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        else:
+            if self._thread is not None:
+                self._thread.join()
+                self._thread = None
+            # Never run the deferred publish barrier while unwinding: the
+            # other hosts may be unwinding too and would never arrive.
+            self._pending_publish = None
 
 
 class _HostState:

@@ -239,6 +239,62 @@ def test_async_checkpointer_matches_sync(tmp_path, tiny_data):
     assert os.path.exists(tmp_path / "async" / "model_best.npz")
 
 
+def test_async_checkpointer_sharded_deferred_publish(tmp_path, mesh8):
+    """Async + sharded layout (round-4): the shard snapshot happens in
+    save(), the file writes on the worker thread, and the PUBLISH (the
+    collective barrier + atomic rename) at the next main-thread drain.
+    The published directory must be bitwise identical to a sync save."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        AsyncCheckpointer,
+    )
+
+    state = _zero1_state_on(mesh8)
+    sync_path = save_checkpoint(state, epoch=0, best_acc=0.4, is_best=True,
+                                directory=str(tmp_path / "sync"),
+                                process_index=0, layout="sharded")
+    adir = tmp_path / "async"
+    with AsyncCheckpointer() as saver:
+        saver.save(state, epoch=0, best_acc=0.4, is_best=True,
+                   directory=str(adir), process_index=0, layout="sharded")
+        # Not published yet: only the tmp dir may exist until the drain.
+        assert not os.path.isdir(adir / "checkpoint_0.ckpt")
+        # Next save drains epoch 0 (join + publish) before snapshotting.
+        saver.save(state, epoch=1, best_acc=0.4, is_best=False,
+                   directory=str(adir), process_index=0, layout="sharded")
+        assert os.path.isdir(adir / "checkpoint_0.ckpt")
+        assert not os.path.isdir(adir / "checkpoint_1.ckpt")
+        path1 = saver.wait()  # context exit would drain too; explicit here
+    assert path1.endswith("checkpoint_1.ckpt") and os.path.isdir(path1)
+    assert not os.path.exists(str(adir / "checkpoint_1.ckpt") + ".tmp")
+    assert os.path.isdir(adir / "model_best.ckpt")  # epoch 0 was best
+
+    ra, ea, ba = load_checkpoint(str(adir / "checkpoint_0.ckpt"),
+                                 _zero1_state_on(mesh8))
+    rs, es, bs = load_checkpoint(sync_path, _zero1_state_on(mesh8))
+    assert (ea, ba) == (es, bs) == (1, 0.4)
+    for a, b in zip(jax.tree.leaves(ra.opt_state),
+                    jax.tree.leaves(rs.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_checkpointer_sharded_publish_on_exit(tmp_path, mesh8):
+    """A single save followed by context exit still publishes (the drain
+    at __exit__), so the last epoch of a run is never lost."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        AsyncCheckpointer,
+    )
+
+    state = _zero1_state_on(mesh8)
+    with AsyncCheckpointer() as saver:
+        saver.save(state, epoch=2, best_acc=0.1, is_best=False,
+                   directory=str(tmp_path), process_index=0,
+                   layout="sharded")
+    assert os.path.isdir(tmp_path / "checkpoint_2.ckpt")
+    _, epoch, best = try_resume(str(tmp_path / "checkpoint_2.ckpt"),
+                                _zero1_state_on(mesh8))
+    assert (epoch, best) == (3, 0.1)
+
+
 def test_async_checkpointer_surfaces_write_error(tmp_path):
     from pytorch_distributed_mnist_tpu.train.checkpoint import (
         AsyncCheckpointer,

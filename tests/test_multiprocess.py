@@ -391,14 +391,22 @@ def test_two_process_expert_parallel_matches_single(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path):
+@pytest.mark.parametrize("async_ckpt", [False, True],
+                         ids=["sync", "async"])
+def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path, async_ckpt):
     """Multi-host ZeRO-1: moments sharded ACROSS processes -> the npz path
     cannot save them (np.asarray would raise on non-addressable leaves);
     the sharded .ckpt directory must be written by BOTH processes and
     restore in a second 2-process run. This executes the exact crash path
-    from the round-2 review finding (checkpoint.py + multi-host zero1)."""
+    from the round-2 review finding (checkpoint.py + multi-host zero1).
+    The async variant drives the round-4 deferred-publish path: shard
+    writes on each host's worker thread, the publish barrier at the next
+    main-thread drain — both REAL processes must still converge on one
+    published directory."""
 
     def spawn(extra):
+        if async_ckpt:
+            extra = list(extra) + ["--async-checkpoint"]
         return _spawn_workers(tmp_path / "ckpts", extra)[0]
 
     first = spawn(["--optimizer-sharding", "zero1"])
