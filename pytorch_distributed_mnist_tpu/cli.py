@@ -590,6 +590,27 @@ def run(args, epoch_callback=None) -> dict:
             f"must divide evenly over the {jax.device_count()} "
             f"data x expert token groups"
         )
+    # Flag-level aux/gather validation lives HERE with its siblings, not
+    # after mesh/model/state construction: a bad combo must be rejected
+    # before minutes of expensive init (round-3 advisor finding).
+    epoch_gather = getattr(args, "epoch_gather", "host")
+    if epoch_gather == "device" and args.trainer_mode != "scan":
+        raise SystemExit(
+            "--epoch-gather device requires --trainer-mode scan (the "
+            "gather lives inside the scanned epoch program)"
+        )
+    aux_weight = getattr(args, "moe_aux_weight", 0.0)
+    if aux_weight:
+        if args.model != "moe_mlp":
+            raise SystemExit(
+                f"--moe-aux-weight applies to --model moe_mlp (the router "
+                f"sows the load-balance loss); got --model {args.model}"
+            )
+        if args.trainer_mode == "explicit":
+            raise SystemExit(
+                "--moe-aux-weight does not compose with --trainer-mode "
+                "explicit; use scan or stepwise"
+            )
     if pp > 1 and sp > 1:
         raise SystemExit(
             "--pipeline-stages does not compose with --sequence-parallel: "
@@ -985,24 +1006,8 @@ def run(args, epoch_callback=None) -> dict:
             base_sharding=pp_sharding if pp > 1 else None,
         )
 
-    epoch_gather = getattr(args, "epoch_gather", "host")
-    if epoch_gather == "device" and args.trainer_mode != "scan":
-        raise SystemExit(
-            "--epoch-gather device requires --trainer-mode scan (the "
-            "gather lives inside the scanned epoch program)"
-        )
-    aux_weight = getattr(args, "moe_aux_weight", 0.0)
-    if aux_weight:
-        if args.model != "moe_mlp":
-            raise SystemExit(
-                f"--moe-aux-weight applies to --model moe_mlp (the router "
-                f"sows the load-balance loss); got --model {args.model}"
-            )
-        if args.trainer_mode == "explicit":
-            raise SystemExit(
-                "--moe-aux-weight does not compose with --trainer-mode "
-                "explicit; use scan or stepwise"
-            )
+    # epoch_gather / aux_weight were validated (and bound) up in the
+    # flag-check block, before mesh/model/state construction.
     train_loader, test_loader, dataset_synthesized = _build_loaders(
         args, seed, mesh)
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,

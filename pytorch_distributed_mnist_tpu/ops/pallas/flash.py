@@ -382,6 +382,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
         )
     if block is not None and (block < 8 or block % 8):
         raise ValueError(f"block must be a multiple of 8, got {block}")
+    if block is not None and block > 512:
+        # VMEM-derived cap: the bwd kernel's f32 scratch grows as block^2
+        # (s/p tiles — 1 MB each at 512) plus several block x D operands;
+        # past 512 the working set approaches the ~16 MB/core VMEM and
+        # Mosaic fails with an opaque allocation error rather than this
+        # message. The sweep (tools/sweep_flash.py) tops out at 512 too.
+        raise ValueError(
+            f"block must be <= 512 (block^2 f32 scratch exceeds VMEM "
+            f"beyond that), got {block}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash(q, k, v, causal, float(scale), block)

@@ -157,6 +157,20 @@ def test_flash_attention_block_override(block, causal):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+def test_flash_attention_block_bounds_rejected():
+    """The block override is bounded on both ends: non-multiple-of-8
+    below, and >512 above (the block^2 f32 VMEM scratch would blow the
+    ~16 MB/core budget with an opaque Mosaic error instead of this
+    message — round-3 advisor finding)."""
+    ks = jax.random.split(jax.random.key(9), 3)
+    q, k, v = (jax.random.normal(kk, (1, 64, 2, 16), jnp.float32)
+               for kk in ks)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        flash_attention(q, k, v, block=20)
+    with pytest.raises(ValueError, match="<= 512"):
+        flash_attention(q, k, v, block=1024)
+
+
 @pytest.mark.parametrize("t", [49, 200])
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_grad_unaligned_lengths(t, causal):
