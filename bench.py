@@ -417,9 +417,25 @@ def child_bench(steps: int, reps: int, probe: bool = False) -> dict:
                 batch * steps, reps)
             result["images_per_sec_per_chip_device_gather"] = (
                 batch * steps / best_ix / n_chips)
+            # Hypothesis probe for the round-3 10%-slower finding: the
+            # random-row gather's HBM locality. Same batch MEMBERSHIP
+            # (identical loss/grad up to fp reduction order), indices
+            # sorted within each tick — if this closes the gap, the
+            # fix is sort-in-sampler; if not, the gather itself is the
+            # cost and the north-star default should flip to host.
+            ticks_sorted = {
+                "idx": jnp.asarray(np.sort(
+                    perm.reshape(steps, batch), axis=1)),
+                "mask": jnp.ones((steps, batch), jnp.float32)}
+            state_ix2 = create_train_state(model, jax.random.key(0))
+            state_ix2, best_ix2 = _warmup_and_time(
+                lambda st: epoch_ix(st, data, ticks_sorted), state_ix2,
+                batch * steps, reps)
+            result["images_per_sec_per_chip_device_gather_sorted"] = (
+                batch * steps / best_ix2 / n_chips)
             # Free the ~320 MB resident dataset before the next secondary
             # measures: dead bench arrays must not skew its HBM headroom.
-            del data, ticks, state_ix
+            del data, ticks, ticks_sorted, state_ix, state_ix2
         except Exception as exc:  # noqa: BLE001 - secondary only
             result["device_gather_error"] = repr(exc)
 
@@ -741,6 +757,7 @@ def main() -> None:
                     "mode", "images_per_sec_per_chip_fused_kernels",
                     "fused_kernels_error",
                     "images_per_sec_per_chip_device_gather",
+                    "images_per_sec_per_chip_device_gather_sorted",
                     "device_gather_error", "tpu_error", "notes"):
             if result.get(key) is not None:
                 val = result[key]

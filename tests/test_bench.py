@@ -168,6 +168,7 @@ def test_secondary_measurements_plumbing_cpu():
     assert "device_gather_error" not in result, result
     assert result["images_per_sec_per_chip_fused_kernels"] > 0
     assert result["images_per_sec_per_chip_device_gather"] > 0
+    assert result["images_per_sec_per_chip_device_gather_sorted"] > 0
 
 
 @pytest.mark.slow
