@@ -93,6 +93,33 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _fake_bounds() -> dict:
+    """Test-only physical-bound overrides present in the environment.
+    They must never silently shape a real capture: every child refuses
+    to run on a real TPU with them set (``_refuse_fakes_on_tpu``) and
+    stamps them into its output otherwise."""
+    return {k: os.environ[k]
+            for k in ("BENCH_FAKE_PEAK_FLOPS", "BENCH_FAKE_HBM_BW")
+            if os.environ.get(k)}
+
+
+def _refuse_fakes_on_tpu(result: dict, platform: str):
+    """Returns an error dict when a test-only bound override leaked into
+    a real TPU run (the capture would carry a valid-looking sync marker
+    with bounds computed against a fake peak); stamps the overrides into
+    ``result`` on non-TPU backends so a test run can never pass as
+    evidence. Returns None when the run may proceed."""
+    fakes = _fake_bounds()
+    if not fakes:
+        return None
+    if platform == "tpu":
+        return {"ok": False,
+                "error": f"test-only bound overrides set on a real TPU "
+                         f"run: {sorted(fakes)}"}
+    result["fake_bounds"] = fakes
+    return None
+
+
 def configure_jax(jax_module, force_cpu: bool = False) -> None:
     """Shared jax prologue for every bench entry point (this file's
     children and tools/bench_kernels.py): honor an explicit CPU request
@@ -176,6 +203,10 @@ def child_bench_vit(steps: int, reps: int) -> dict:
 
     n_chips = jax.device_count()
     device = jax.devices()[0]
+    fake_stamp: dict = {}
+    refused = _refuse_fakes_on_tpu(fake_stamp, device.platform)
+    if refused:
+        return refused
     mesh = make_mesh(("data",)) if n_chips > 1 else None
     on_tpu = device.platform == "tpu"
     # Test-only: drive the exact TPU branch (flash attention + remat +
@@ -246,14 +277,27 @@ def child_bench_vit(steps: int, reps: int) -> dict:
         "mfu": mfu,
         "sync": "host_read",
     }
+    result.update(fake_stamp)
     if flash_path:
         # Baseline ratio: byte-identical model/step with dense XLA
         # attention. Secondary — a failure here never harms the primary.
         try:
             dense_s = measure(None)
-            result["images_per_sec_per_chip_dense_attn"] = (
-                batch * steps / dense_s / n_chips)
-            result["flash_over_dense_speedup"] = dense_s / flash_s
+            dense_mfu = (flops_per_image * batch * steps
+                         / dense_s / n_chips / peak) if peak else None
+            if dense_mfu is not None and dense_mfu > 1.0:
+                # The dense twin is the DENOMINATOR of the headline
+                # flash_over_dense ratio; an early-sync dense time would
+                # publish a garbage speedup under a valid-looking flash
+                # line. Record the violation, never the ratio.
+                result["dense_attn_error"] = (
+                    f"impossible dense ViT MFU {dense_mfu:.3g} (>100% "
+                    f"of peak): device sync did not wait for execution")
+            else:
+                result["images_per_sec_per_chip_dense_attn"] = (
+                    batch * steps / dense_s / n_chips)
+                result["flash_over_dense_speedup"] = dense_s / flash_s
+                result["dense_attn_mfu"] = dense_mfu
         except Exception as exc:  # noqa: BLE001
             result["dense_attn_error"] = repr(exc)
     return result
@@ -293,6 +337,10 @@ def child_bench(steps: int, reps: int, probe: bool = False) -> dict:
 
     n_chips = jax.device_count()
     device = jax.devices()[0]
+    fake_stamp: dict = {}
+    refused = _refuse_fakes_on_tpu(fake_stamp, device.platform)
+    if refused:
+        return refused
     mesh = make_mesh(("data",)) if n_chips > 1 else None
     # Stepwise = time the per-batch jitted step instead of the scan epoch:
     # the CPU fallback needs it (XLA:CPU pessimizes convs inside scanned
@@ -366,6 +414,12 @@ def child_bench(steps: int, reps: int, probe: bool = False) -> dict:
     steps_per_sec = steps / best
     peak = _peak_flops(device.device_kind)
     mfu = (flops_per_step * steps_per_sec / n_chips / peak) if peak else None
+    if mfu is not None and mfu > 1.0:
+        # Same physical bound as tools/bench_kernels.py: >100% of peak
+        # means the sync failed; the number must not survive as evidence.
+        return {"ok": False,
+                "error": f"impossible CNN MFU {mfu:.3g} (>100% of peak): "
+                         f"device sync did not wait for execution"}
     result = {
         "ok": True,
         "images_per_sec_per_chip": batch * steps / best / n_chips,
@@ -378,6 +432,7 @@ def child_bench(steps: int, reps: int, probe: bool = False) -> dict:
         "peak_flops_per_chip": peak,
         "mfu": mfu,
     }
+    result.update(fake_stamp)
     if probe:
         result["mode"] = "probe"
     if os.environ.get("BENCH_FORCE_SECONDARIES"):

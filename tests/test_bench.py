@@ -212,6 +212,25 @@ def test_vit_main_line_cpu():
     assert out["measured_at"].endswith("Z")
 
 
+def test_refuse_fake_bounds_on_tpu(monkeypatch):
+    """A test-only peak override leaking into a real-TPU child must
+    refuse the run (an evidence line with fake physical bounds would
+    still carry the host_read marker); on other backends it is stamped
+    into the output so the line can never pass as evidence."""
+    monkeypatch.setenv("BENCH_FAKE_PEAK_FLOPS", "1.0")
+    result = {}
+    refused = bench._refuse_fakes_on_tpu(result, "tpu")
+    assert refused is not None and not refused["ok"]
+    assert "BENCH_FAKE_PEAK_FLOPS" in refused["error"]
+    result = {}
+    assert bench._refuse_fakes_on_tpu(result, "cpu") is None
+    assert result["fake_bounds"] == {"BENCH_FAKE_PEAK_FLOPS": "1.0"}
+    monkeypatch.delenv("BENCH_FAKE_PEAK_FLOPS")
+    result = {}
+    assert bench._refuse_fakes_on_tpu(result, "tpu") is None
+    assert result == {}
+
+
 def test_vit_model_flops_count():
     """Pin the analytic ViT FLOPs count against a hand-derived value so a
     future edit can't silently change the MFU denominator: one block at

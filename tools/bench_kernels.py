@@ -82,14 +82,8 @@ def check_mfu(label: str, secs: float, flops: float, peak):
     return round(mfu, 4)
 
 
-def _fake_bounds() -> dict:
-    """Test-only physical-bound overrides present in the environment.
-    They must never silently shape a real capture: callers stamp them
-    into the output JSON and refuse to run on a real TPU with them
-    set."""
-    return {k: os.environ[k]
-            for k in ("BENCH_FAKE_PEAK_FLOPS", "BENCH_FAKE_HBM_BW")
-            if os.environ.get(k)}
+from bench import _fake_bounds  # noqa: E402 - single source for the
+# test-only bound-override set (bench.py's children use the same one)
 
 
 def _host_read(out) -> float:

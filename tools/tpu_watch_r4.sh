@@ -11,14 +11,19 @@
 #   3. northstar_warm.json — warm-compile-cache north star (<60 s target).
 #   4. flash_sweep.json    — block-size sweep behind the T=4096 decision.
 #   5. bench.json          — fresh headline line from the round-4 bench.py.
+#   6. bench_vit.json      — end-to-end MXU-bound ViT line (bench.py --vit).
 #
-# Publication is gated on the producer's exit code (bench_kernels.py and
-# sweep_flash.py exit nonzero on physically impossible measurements, so a
-# broken-sync run can never be published as evidence). Each item is
-# skipped once captured, so a retry cycle only re-runs what failed.
-# Retry cycles are CAPPED (round-3 advisor finding: the uncapped followup
-# loop could churn one commit per ~30-min attempt forever on a
-# persistently failing test).
+# Publication gates, per item: producer exit code 0 (bench_kernels.py and
+# sweep_flash.py exit nonzero on physically impossible measurements), a
+# required '"backend": "tpu"' marker (a producer whose jax init fell back
+# to CPU exits 0 with an honest CPU line — that must never become the
+# round's capture), and for bench.json the absence of the
+# watcher-capture re-emission marker. Each item is skipped once
+# captured, so a retry cycle only re-runs what failed; a 90s liveness
+# re-probe before each item skips the rest of a cycle when the link
+# wedges mid-way (instead of burning every timeout against a dead
+# chip). Retry cycles are CAPPED (round-3 advisor finding: the uncapped
+# followup loop could churn one commit per ~30-min attempt forever).
 set -u
 OUT=/root/repo/tools/captured
 STATE=/tmp/tpu_watch_r4_state
@@ -29,19 +34,16 @@ CYCLES=0
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
-# Quick liveness re-probe between items: when the link wedges mid-cycle,
-# skipping the remaining producers (each would burn its full 30-40 min
-# timeout against a dead chip) gets the watcher back to polling — and to
-# the next real recovery window — hours sooner.
 probe_tpu() {
   timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1
 }
 
-# run_capture <name> <timeout> <dest> <cmd...>
-# Runs cmd with stdout -> dest.new; publishes dest only on rc==0.
+# run_capture <name> <timeout> <dest> <require_pat> <forbid_pat> <cmd...>
+# stdout -> dest.new; published to dest only when rc==0 AND require_pat
+# (if non-empty) is present AND forbid_pat (if non-empty) is absent.
 # Marks $STATE/<name> on success so later cycles skip it.
 run_capture() {
-  local name=$1 tmo=$2 dest=$3; shift 3
+  local name=$1 tmo=$2 dest=$3 require=$4 forbid=$5; shift 5
   [ -e "$STATE/$name" ] && return 0
   if ! probe_tpu; then
     log "r4 capture $name skipped: link re-probe failed"
@@ -49,6 +51,16 @@ run_capture() {
   fi
   timeout "$tmo" "$@" > "$dest.new" 2>> "$OUT/watch.log"
   local rc=$?
+  if [ "$rc" -eq 0 ] && [ -n "$require" ] \
+      && ! grep -q "$require" "$dest.new" 2>/dev/null; then
+    log "r4 capture $name rejected: missing required marker $require"
+    rc=1
+  fi
+  if [ "$rc" -eq 0 ] && [ -n "$forbid" ] \
+      && grep -q "$forbid" "$dest.new" 2>/dev/null; then
+    log "r4 capture $name rejected: forbidden marker $forbid"
+    rc=1
+  fi
   if [ "$rc" -eq 0 ]; then
     mv "$dest.new" "$dest"
     touch "$STATE/$name"
@@ -60,8 +72,10 @@ run_capture() {
   return "$rc"
 }
 
+TPU='"backend": "tpu"'
+
 while true; do
-  if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1; then
+  if probe_tpu; then
     log "TPU alive - r4 capturing (cycle $((CYCLES + 1))/$MAX_CYCLES)"
     # Wait out any hermetic-suite run: one host core; a concurrent
     # pytest would pollute every wall-clock number below.
@@ -71,7 +85,7 @@ while true; do
       sleep 30
     done
 
-    run_capture kernels 1800 "$OUT/kernels.json" \
+    run_capture kernels 1800 "$OUT/kernels.json" "$TPU" "" \
       python /root/repo/tools/bench_kernels.py; K_RC=$?
 
     # pytest writes its own log (stdout IS the artifact, failing or not)
@@ -91,61 +105,23 @@ while true; do
       T_RC=0
     fi
 
-    run_capture northstar_warm 1800 "$OUT/northstar_warm.json" \
+    run_capture northstar_warm 1800 "$OUT/northstar_warm.json" "$TPU" "" \
       python /root/repo/tools/northstar.py \
         --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
         --compile-cache "$BENCH_COMPILE_CACHE" \
         --root /tmp/ns_tpu_warm; N_RC=$?
 
-    run_capture flash_sweep 2400 "$OUT/flash_sweep.json" \
+    run_capture flash_sweep 2400 "$OUT/flash_sweep.json" "$TPU" "" \
       python /root/repo/tools/sweep_flash.py; F_RC=$?
 
-    # Fresh headline bench line from the round-4 bench.py. Same
-    # TPU-backed/no-self-re-emission gate as tpu_watch.sh round 3.
-    if [ ! -e "$STATE/bench" ] && ! probe_tpu; then
-      B_RC=1
-      log "r4 capture bench skipped: link re-probe failed"
-    elif [ ! -e "$STATE/bench" ]; then
-      BENCH_CAPTURE_PATH= timeout 2400 python /root/repo/bench.py \
-        > "$OUT/bench.json.new" 2>> "$OUT/watch.log"
-      B_RC=$?
-      if [ "$B_RC" -eq 0 ] \
-          && grep -q '"backend": "tpu"' "$OUT/bench.json.new" 2>/dev/null \
-          && ! grep -q '"source": "watcher_capture"' "$OUT/bench.json.new" 2>/dev/null; then
-        mv "$OUT/bench.json.new" "$OUT/bench.json"
-        touch "$STATE/bench"
-      else
-        cat "$OUT/bench.json.new" >> "$OUT/watch.log" 2>/dev/null
-        rm -f "$OUT/bench.json.new"
-        B_RC=1
-      fi
-      log "r4 capture bench rc=$B_RC"
-    else
-      B_RC=0
-    fi
+    # BENCH_CAPTURE_PATH= disables bench.py's own watcher-capture
+    # fallback so it can never re-emit this watcher's prior output; the
+    # forbid marker rejects it even if that plumbing regresses.
+    run_capture bench 2400 "$OUT/bench.json" "$TPU" '"source": "watcher_capture"' \
+      env BENCH_CAPTURE_PATH= python /root/repo/bench.py; B_RC=$?
 
-    # End-to-end MXU-bound ViT line (VERDICT round-3 weak item 6):
-    # published only when TPU-backed, like the headline bench.
-    if [ ! -e "$STATE/bench_vit" ] && ! probe_tpu; then
-      V_RC=1
-      log "r4 capture bench_vit skipped: link re-probe failed"
-    elif [ ! -e "$STATE/bench_vit" ]; then
-      BENCH_CAPTURE_PATH= timeout 2400 python /root/repo/bench.py --vit \
-        > "$OUT/bench_vit.json.new" 2>> "$OUT/watch.log"
-      V_RC=$?
-      if [ "$V_RC" -eq 0 ] \
-          && grep -q '"backend": "tpu"' "$OUT/bench_vit.json.new" 2>/dev/null; then
-        mv "$OUT/bench_vit.json.new" "$OUT/bench_vit.json"
-        touch "$STATE/bench_vit"
-      else
-        cat "$OUT/bench_vit.json.new" >> "$OUT/watch.log" 2>/dev/null
-        rm -f "$OUT/bench_vit.json.new"
-        V_RC=1
-      fi
-      log "r4 capture bench_vit rc=$V_RC"
-    else
-      V_RC=0
-    fi
+    run_capture bench_vit 2400 "$OUT/bench_vit.json" "$TPU" "" \
+      env BENCH_CAPTURE_PATH= python /root/repo/bench.py --vit; V_RC=$?
 
     log "r4 cycle done kernels=$K_RC tests_tpu=$T_RC northstar_warm=$N_RC flash_sweep=$F_RC bench=$B_RC bench_vit=$V_RC"
     git -C /root/repo add tools/captured \
