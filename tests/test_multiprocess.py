@@ -335,6 +335,33 @@ def test_two_process_tensor_parallel_matches_single(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_two_process_sequence_parallel_matches_single(tmp_path, impl):
+    """Multi-host SP: the seq axis spans the 2 processes (mesh
+    data=1 x seq=2), so the ring attention's ppermute hops (or the
+    Ulysses all_to_alls) cross a REAL process link — the transport the
+    virtual-device tests and dryrun phases 2/6 cannot reach — and both
+    hosts must feed the identical full batch (data_replica_coords).
+    Trajectory pinned to the single-process 2-virtual-device oracle.
+    Completes the multi-process twin matrix for SURVEY section 2c: DP,
+    TP, EP, PP x TP x ZeRO, ZeRO-3, and now both SP impls."""
+    sp_flags = ["--model", "vit", "--patch-size", "7",
+                "--sequence-parallel", "2",
+                "--sequence-parallel-impl", impl,
+                "--batch-size", "32",
+                "--synthetic-train-size", "64", "--synthetic-test-size", "32"]
+    two_proc, _ = _spawn_workers(tmp_path / "ckpts", sp_flags)
+    assert two_proc[0]["train_loss"] == pytest.approx(
+        two_proc[1]["train_loss"], abs=0.0)
+
+    oracle = _single_process_oracle(sp_flags, 2, tmp_path / "oracle")
+    assert two_proc[0]["train_loss"] == pytest.approx(
+        oracle["train_loss"], rel=1e-5)
+    assert two_proc[0]["test_acc"] == pytest.approx(
+        oracle["test_acc"], abs=1e-6)
+
+
+@pytest.mark.slow
 def test_four_process_pp_tp_zero1_matches_single(tmp_path):
     """The deepest multi-host composition in the matrix: PP x TP x
     ZeRO-1 over 4 real processes — mesh data=1 x stage=2 x model=2 with
