@@ -558,6 +558,34 @@ def _run_child(env_extra: dict, steps: int, reps: int, timeout: float):
     return None, f"rc={proc.returncode}: " + " | ".join(tail)
 
 
+def _read_tpu_capture(env_var: str):
+    """Shared reader for watcher capture files (both consumers below):
+    resolve the path (``env_var`` overrides; set-but-empty = explicitly
+    disabled), parse the LAST line as JSON, and validate it is a dict
+    that really ran on TPU with a nonzero value. Returns
+    ``(captured, path, mtime)`` or ``None`` — never raises: a corrupt or
+    truncated capture must degrade, not crash the always-emit-JSON
+    contract of ``main``."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if env_var in os.environ:
+        path = os.environ[env_var]
+        if not path:
+            return None
+    else:
+        path = os.path.join(repo, "tools", "captured", "bench.json")
+    try:
+        with open(path) as f:
+            captured = json.loads(f.read().strip().splitlines()[-1])
+        mtime = os.path.getmtime(path)
+    except (OSError, IndexError, UnicodeDecodeError, json.JSONDecodeError):
+        return None
+    if not isinstance(captured, dict):  # e.g. a truncated write leaving
+        return None                     # 'null' — still valid JSON
+    if captured.get("backend") != "tpu" or not captured.get("value"):
+        return None
+    return captured, path, mtime
+
+
 def _load_watcher_capture() -> dict | None:
     """Freshest mid-session TPU capture from tools/tpu_watch.sh, if any.
 
@@ -566,24 +594,14 @@ def _load_watcher_capture() -> dict | None:
     JSON line) is the round's evidence when the end-of-round live attempt
     hits a wedged link again. Only a capture that actually ran on TPU
     qualifies — a CPU-fallback capture is no better than a live CPU run.
+    BENCH_CAPTURE_PATH overrides the path; tpu_watch_r5.sh sets it EMPTY
+    so bench.py can never re-emit the watcher's own file.
     """
     repo = os.path.dirname(os.path.abspath(__file__))
-    if "BENCH_CAPTURE_PATH" in os.environ:
-        path = os.environ["BENCH_CAPTURE_PATH"]
-        if not path:  # empty = fallback disabled (tpu_watch.sh sets this so
-            return None  # bench.py can never re-emit the watcher's own file)
-    else:
-        path = os.path.join(repo, "tools", "captured", "bench.json")
-    try:
-        with open(path) as f:
-            captured = json.loads(f.read().strip().splitlines()[-1])
-        mtime = os.path.getmtime(path)
-    except (OSError, IndexError, json.JSONDecodeError):
+    loaded = _read_tpu_capture("BENCH_CAPTURE_PATH")
+    if loaded is None:
         return None
-    if not isinstance(captured, dict):  # e.g. a truncated write leaving
-        return None                     # 'null' — still valid JSON
-    if captured.get("backend") != "tpu" or not captured.get("value"):
-        return None
+    captured, _, mtime = loaded
     # Freshness: only a capture from THIS round is evidence. The round
     # boundary markers are the driver's own artifacts (VERDICT.md /
     # BENCH_r*.json, written at round start); a stale capture restored by
@@ -609,6 +627,50 @@ def _load_watcher_capture() -> dict | None:
         captured["capture_timestamp"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
     return captured
+
+
+def _last_valid_tpu_capture() -> dict | None:
+    """Provenance pointer for chip-dead rounds (round-4 VERDICT weak #5).
+
+    The freshness gate in ``_load_watcher_capture`` is right to refuse a
+    prior round's capture as THIS round's measurement — but the resulting
+    CPU-fallback artifact then looks like a 0.58x regression to anyone
+    reading only ``BENCH_r*.json``. This returns a small, clearly
+    non-headline pointer to the newest watcher capture that really ran on
+    TPU, regardless of age: value + when it was measured + the commit
+    that recorded it. Attached ONLY to lines whose own backend is not
+    ``tpu`` (see ``main``); never a substitute for a fresh measurement.
+    BENCH_LAST_CAPTURE_PATH overrides the path (empty = disabled; the r5
+    watcher sets it empty so a capture never points at its predecessor).
+    """
+    repo = os.path.dirname(os.path.abspath(__file__))
+    loaded = _read_tpu_capture("BENCH_LAST_CAPTURE_PATH")
+    if loaded is None:
+        return None
+    captured, path, mtime = loaded
+    pointer = {
+        "value": captured["value"],
+        "unit": captured.get("unit", "images/sec/chip"),
+        "measured_at": captured.get("measured_at"),
+        "note": "newest real-TPU capture on record; NOT this round's "
+                "measurement (this round's line ran on the backend above)",
+    }
+    if pointer["measured_at"] is None:
+        # Legacy capture without an embedded time: file mtime is the best
+        # remaining provenance (weaker — a git checkout restamps it).
+        pointer["measured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+        pointer["measured_at_source"] = "file_mtime"
+    try:
+        commit = subprocess.run(
+            ["git", "log", "-1", "--format=%h", "--", path],
+            capture_output=True, text=True, timeout=10, cwd=repo,
+        ).stdout.strip()
+        if commit:
+            pointer["commit"] = commit
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return pointer
 
 
 def bench_accelerator() -> dict:
@@ -733,6 +795,12 @@ def main_vit() -> None:
         out["error"] = result.get("error", "unknown failure")
     out["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     print(json.dumps(out))
+    if not result.get("ok"):
+        # Same convention as tools/bench_kernels.py / tools/sweep_flash.py
+        # (round-4 advisor): a fully failed run never exits 0, so rc-gated
+        # consumers (tools/tpu_watch_r5.sh run_capture) reject the line
+        # without having to parse it.
+        sys.exit(1)
 
 
 def bench_torch_reference() -> float:
@@ -823,6 +891,12 @@ def main() -> None:
         out["error"] = result.get("error", "unknown failure")
     if baseline > 0:
         out["baseline_images_per_sec"] = round(baseline, 1)
+    if out.get("backend") != "tpu":
+        # Chip-dead round: the honest CPU/error line still records where
+        # the newest real TPU evidence lives (non-headline pointer).
+        pointer = _last_valid_tpu_capture()
+        if pointer is not None:
+            out["last_valid_tpu_capture"] = pointer
     # Measurement provenance travels inside the line itself so a later
     # re-emission (watcher-capture fallback) can never restamp it.
     out["measured_at"] = time.strftime(

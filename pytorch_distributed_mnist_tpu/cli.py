@@ -320,45 +320,56 @@ def _build_loaders(args, seed: int, mesh):
     if args.download and not synthesize:
         # Every process attempts the (idempotent, atomically-published)
         # download — correct whether hosts share a filesystem or have their
-        # own. The outcome is then AGREED across hosts: unless every host
-        # ended up with the files, all hosts fall back to synthetic
-        # together. A split outcome would train on silently different data
-        # per host — a barrier alone only synchronizes timing, not results.
+        # own.
         from pytorch_distributed_mnist_tpu.data.download import (
-            dataset_present,
             download_dataset,
         )
-        from pytorch_distributed_mnist_tpu.data.mnist import dataset_dir
 
         try:
             download_dataset(args.root, name)
         except (OSError, ValueError) as exc:
             log0(f"WARNING: download of {name!r} failed: {exc}")
-        if process_count() > 1:
-            from jax.experimental import multihost_utils
 
-            have = dataset_present(dataset_dir(args.root, name))
-            everyone = multihost_utils.process_allgather(
-                np.asarray([have], dtype=np.bool_)
-            )
-            if not bool(np.all(everyone)):
-                if not allow_synthetic:
-                    raise SystemExit(
-                        f"{name!r} is not present on every host "
-                        f"({int(np.sum(everyone))}/{everyone.size} have "
-                        f"it) and --allow-synthetic was not given. "
-                        f"Pre-download on every host, or pass "
-                        f"--allow-synthetic to train on labelled fake "
-                        f"data, or --dataset synthetic."
-                    )
-                log0(
-                    f"WARNING: {name!r} is not present on every host "
-                    f"({int(np.sum(everyone))}/{everyone.size} have it); "
-                    "all hosts will use the synthetic fallback so training "
-                    "data stays consistent across the job"
+    if not synthesize and process_count() > 1:
+        # The presence outcome is AGREED across hosts whether or not
+        # --download ran: unless every host has the files, every host takes
+        # the SAME exit — fail fast together (no --allow-synthetic) or fall
+        # back to synthetic together. Deciding per host inside load_split
+        # (the pre-round-5 behavior for runs without --download) would let
+        # one host train on real rows while another trains on fake ones
+        # (silent cross-host data divergence), or raise SystemExit on one
+        # host while its peers hang at the next collective. A barrier alone
+        # only synchronizes timing, not results.
+        from jax.experimental import multihost_utils
+
+        from pytorch_distributed_mnist_tpu.data.download import (
+            dataset_present,
+        )
+        from pytorch_distributed_mnist_tpu.data.mnist import dataset_dir
+
+        have = dataset_present(dataset_dir(args.root, name))
+        everyone = multihost_utils.process_allgather(
+            np.asarray([have], dtype=np.bool_)
+        )
+        if not bool(np.all(everyone)):
+            if not allow_synthetic:
+                hint = ("the download may have failed (see any warning "
+                        "above)" if args.download else
+                        "pre-download on every host, or pass --download")
+                raise SystemExit(
+                    f"{name!r} is not present on every host "
+                    f"({int(np.sum(everyone))}/{everyone.size} have it) "
+                    f"— {hint}, or pass --allow-synthetic to train on "
+                    f"labelled fake data, or --dataset synthetic."
                 )
-                synthesize = True
-                name = "mnist"
+            log0(
+                f"WARNING: {name!r} is not present on every host "
+                f"({int(np.sum(everyone))}/{everyone.size} have it); "
+                "all hosts will use the synthetic fallback so training "
+                "data stays consistent across the job"
+            )
+            synthesize = True
+            name = "mnist"
 
     used_synthetic = synthesize
 

@@ -47,6 +47,7 @@ import json
 import os
 import re
 import shutil
+import sys
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -541,13 +542,39 @@ class AsyncCheckpointer:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
-        if self._error is not None:
-            self._pending_publish = None
-            exc, self._error = self._error, None
-            raise exc
         if self._pending_publish is not None:
             pub, self._pending_publish = self._pending_publish, None
-            self._result = _sharded_publish(**pub)
+            write_ok = self._error is None
+            if jax.process_count() > 1:
+                # Agree the per-host write outcome BEFORE the publish
+                # barrier: ``_sharded_publish``'s sync_global_devices has
+                # no timeout, so a host raising its local write error
+                # while its peers enter the barrier would hang the job
+                # forever (round-4 advisor). Every host drains at the same
+                # logical step, so this allgather lines up; afterwards all
+                # hosts either publish together or raise together.
+                from jax.experimental import multihost_utils
+
+                everyone = multihost_utils.process_allgather(
+                    np.asarray([write_ok], dtype=np.bool_)
+                ).reshape(-1)
+                if not bool(np.all(everyone)):
+                    failed = [int(i) for i in np.nonzero(~everyone)[0]]
+                    if write_ok:
+                        # Our shards landed but a peer's write failed:
+                        # drop the publish (tmp dir left for postmortem)
+                        # and fail in step with the raising host(s).
+                        raise RuntimeError(
+                            f"sharded checkpoint write for epoch "
+                            f"{pub['epoch']} failed on host(s) {failed}; "
+                            f"dropping unpublished {pub['tmp']}"
+                        )
+                    write_ok = False
+            if write_ok:
+                self._result = _sharded_publish(**pub)
+        if self._error is not None:
+            exc, self._error = self._error, None
+            raise exc
         return self._result
 
     def __enter__(self) -> "AsyncCheckpointer":
@@ -562,9 +589,30 @@ class AsyncCheckpointer:
             if self._thread is not None:
                 self._thread.join()
                 self._thread = None
-            # Never run the deferred publish barrier while unwinding: the
-            # other hosts may be unwinding too and would never arrive.
-            self._pending_publish = None
+            if self._error is not None:
+                # The with-body is unwinding on its own exception, which
+                # must not be masked — but a silently dropped write error
+                # makes the lost checkpoint invisible to postmortems
+                # (round-4 advisor). Say what failed before discarding.
+                print(
+                    "WARNING: async checkpoint write failed while the "
+                    f"run was unwinding; the write error is discarded in "
+                    f"favor of the run's own exception: {self._error!r}",
+                    file=sys.stderr,
+                )
+                self._error = None
+            if self._pending_publish is not None:
+                # Never run the deferred publish barrier while unwinding:
+                # the other hosts may be unwinding too and would never
+                # arrive. The unpublished tmp dir is named so the epoch's
+                # loss is visible, not silent.
+                print(
+                    "WARNING: unpublished checkpoint "
+                    f"{self._pending_publish['tmp']} dropped during "
+                    "unwind (publish barrier skipped)",
+                    file=sys.stderr,
+                )
+                self._pending_publish = None
 
 
 class _HostState:

@@ -132,6 +132,92 @@ def test_main_emits_watcher_capture(tmp_path, monkeypatch, capsys):
     assert out["backend"] == "tpu"
 
 
+def _fake_run_child_cpu_only(env_extra, steps, reps, timeout):
+    """TPU children fail; the CPU-fallback child returns a tiny result."""
+    if env_extra.get("BENCH_FORCE_CPU"):
+        return ({"ok": True, "images_per_sec_per_chip": 100.0,
+                 "steps_per_sec": 1.0, "global_batch": 4, "n_chips": 1,
+                 "backend": "cpu", "device_kind": "cpu"}, None)
+    return (None, "simulated dead link")
+
+
+def test_cpu_fallback_line_carries_last_valid_tpu_pointer(
+        tmp_path, monkeypatch, capsys):
+    """Round-4 VERDICT weak #5: a chip-dead round's artifact must surface
+    the evidence trail. The CPU-fallback line carries a non-headline
+    last_valid_tpu_capture pointer to the newest real-TPU capture on
+    record (any age — the freshness gate rightly keeps it off the
+    headline), with value + measured_at provenance."""
+    payload = {"value": 375868.0, "unit": "images/sec/chip",
+               "backend": "tpu", "measured_at": "2026-07-29T12:00:00Z"}
+    path = tmp_path / "old_capture.json"
+    path.write_text(json.dumps(payload) + "\n")
+    monkeypatch.setenv("BENCH_LAST_CAPTURE_PATH", str(path))
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", "")  # no watcher re-emission
+    monkeypatch.setattr(bench, "_run_child", _fake_run_child_cpu_only)
+    monkeypatch.setattr(bench, "bench_torch_reference", lambda: 50.0)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["backend"] == "cpu"
+    ptr = out["last_valid_tpu_capture"]
+    assert ptr["value"] == 375868.0
+    assert ptr["measured_at"] == "2026-07-29T12:00:00Z"
+    assert "NOT this round's measurement" in ptr["note"]
+    # Headline fields are untouched by the pointer.
+    assert out["value"] == 100.0
+
+
+def test_tpu_line_never_carries_pointer(tmp_path, monkeypatch, capsys):
+    """The pointer is for chip-dead lines only: a line whose own backend
+    is tpu (live or watcher capture) must not carry it."""
+    payload = {"value": 1.0, "backend": "tpu",
+               "measured_at": "2026-07-29T12:00:00Z"}
+    path = tmp_path / "old_capture.json"
+    path.write_text(json.dumps(payload) + "\n")
+    monkeypatch.setenv("BENCH_LAST_CAPTURE_PATH", str(path))
+
+    def fake_tpu_child(env_extra, steps, reps, timeout):
+        return ({"ok": True, "images_per_sec_per_chip": 9.0,
+                 "steps_per_sec": 1.0, "global_batch": 4, "n_chips": 1,
+                 "backend": "tpu", "device_kind": "TPU v5 lite"}, None)
+
+    monkeypatch.setattr(bench, "_run_child", fake_tpu_child)
+    monkeypatch.setattr(bench, "bench_torch_reference", lambda: 50.0)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["backend"] == "tpu"
+    assert "last_valid_tpu_capture" not in out
+
+
+def test_pointer_rejects_cpu_and_garbage_captures(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_LAST_CAPTURE_PATH", "")
+    assert bench._last_valid_tpu_capture() is None
+    path = tmp_path / "cap.json"
+    path.write_text(json.dumps({"value": 5.0, "backend": "cpu"}) + "\n")
+    monkeypatch.setenv("BENCH_LAST_CAPTURE_PATH", str(path))
+    assert bench._last_valid_tpu_capture() is None
+    path.write_text("not json\n")
+    assert bench._last_valid_tpu_capture() is None
+    path.write_text(json.dumps({"value": 5.0, "backend": "tpu"}) + "\n")
+    ptr = bench._last_valid_tpu_capture()
+    assert ptr is not None
+    # No embedded measured_at: mtime stands in, and says so.
+    assert ptr["measured_at_source"] == "file_mtime"
+
+
+def test_vit_main_exits_nonzero_on_full_failure(monkeypatch, capsys):
+    """Round-4 advisor: a fully failed --vit run must not exit 0 — the
+    watcher's rc gate (tools/tpu_watch_r5.sh) rejects it without parsing,
+    matching the bench_kernels.py / sweep_flash.py convention."""
+    monkeypatch.setattr(bench, "bench_vit_accelerator",
+                        lambda: {"ok": False, "error": "all children died"})
+    with pytest.raises(SystemExit) as exc_info:
+        bench.main_vit()
+    assert exc_info.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "all children died" in out["error"]
+
+
 @pytest.mark.slow
 def test_probe_child_stepwise_cpu():
     """The probe path end-to-end in a real child process on CPU: it must

@@ -313,6 +313,82 @@ def test_async_checkpointer_surfaces_write_error(tmp_path):
         saver.wait()
 
 
+def test_async_sharded_peer_failure_agreed_before_publish_barrier(
+        monkeypatch, tmp_path):
+    """Round-4 advisor: when one host's writer thread fails, the hosts
+    whose writes succeeded must NOT enter the publish barrier (it has no
+    timeout — they would hang forever waiting for the raising host).
+    The write outcome is allgathered first; all hosts fail together.
+    Hermetic twin: process_count/allgather stubbed to simulate host 1
+    failing while we (host 0) succeeded."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from pytorch_distributed_mnist_tpu.train import checkpoint as ckpt
+
+    saver = ckpt.AsyncCheckpointer()
+    saver._pending_publish = dict(
+        tmp=str(tmp_path / "checkpoint_3.ckpt.tmp"),
+        final=str(tmp_path / "checkpoint_3.ckpt"),
+        directory=str(tmp_path), epoch=3, is_best=False, keep_last=0,
+        pid=0)
+    monkeypatch.setattr(ckpt.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x: np.concatenate([np.asarray(x), np.asarray([False])]))
+    published = []
+    monkeypatch.setattr(ckpt, "_sharded_publish",
+                        lambda **kw: published.append(kw))
+    with pytest.raises(RuntimeError, match=r"failed on host\(s\) \[1\]"):
+        saver.wait()
+    assert not published
+    assert saver._pending_publish is None
+
+    # Local-failure twin: our own write failed — the local error is what
+    # surfaces (after the agreement), and the publish never runs.
+    saver = ckpt.AsyncCheckpointer()
+    saver._pending_publish = dict(
+        tmp=str(tmp_path / "checkpoint_4.ckpt.tmp"),
+        final=str(tmp_path / "checkpoint_4.ckpt"),
+        directory=str(tmp_path), epoch=4, is_best=False, keep_last=0,
+        pid=0)
+    saver._error = OSError("disk full on this host")
+    with pytest.raises(OSError, match="disk full"):
+        saver.wait()
+    assert not published
+
+
+def test_async_exit_logs_swallowed_error_and_dropped_publish(
+        tmp_path, capsys):
+    """Round-4 advisor: the unwinding __exit__ must not silently discard
+    a write failure or an unpublished checkpoint — postmortems need to
+    see that epoch N's save was lost."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        AsyncCheckpointer,
+    )
+
+    blocked = tmp_path / "blocked"
+    blocked.write_text("not a directory")
+    with pytest.raises(ValueError, match="body exception"):
+        with AsyncCheckpointer() as saver:
+            saver.save(fresh_state(), epoch=0, best_acc=0.0, is_best=False,
+                       directory=str(blocked / "sub"), process_index=0)
+            raise ValueError("body exception")
+    err = capsys.readouterr().err
+    assert "async checkpoint write failed" in err
+
+    with pytest.raises(ValueError, match="body exception"):
+        with AsyncCheckpointer() as saver:
+            saver.save(fresh_state(), epoch=1, best_acc=0.0, is_best=False,
+                       directory=str(tmp_path), process_index=0,
+                       layout="sharded")
+            raise ValueError("body exception")
+    err = capsys.readouterr().err
+    assert "unpublished checkpoint" in err
+    # The publish barrier was skipped: the directory was never renamed.
+    assert not (tmp_path / "checkpoint_1.ckpt").exists()
+
+
 def test_resume_auto_cli(tmp_path, capsys):
     """--resume auto: fresh when the dir is empty, newest checkpoint after."""
     from pytorch_distributed_mnist_tpu.cli import build_parser, run

@@ -99,6 +99,48 @@ def test_missing_dataset_fails_fast(tmp_path):
         run(make_args(tmp_path, dataset="fashion_mnist", epochs=1))
 
 
+def test_multihost_presence_decision_is_agreed_without_download(
+        tmp_path, monkeypatch):
+    """Round-4 advisor (medium): the dataset-presence decision must be
+    agreed across hosts in EVERY multi-host path, not only under
+    --download — otherwise a host missing the IDX files either falls back
+    to synthetic alone (silent cross-host data divergence) or raises
+    SystemExit alone while its peers hang at the next collective.
+    Hermetic twin: process_count/allgather stubbed to simulate a 2-host
+    job where the peer host lacks the files."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from pytorch_distributed_mnist_tpu import cli
+
+    monkeypatch.setattr(cli, "process_count", lambda: 2)
+    calls = []
+
+    def fake_allgather(x):
+        calls.append(np.asarray(x))
+        return np.concatenate([np.asarray(x), np.asarray([False])])
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+
+    from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(("data",))
+
+    # Without --allow-synthetic: every host raises the same fail-fast —
+    # and the agreement allgather really ran (no --download given).
+    with pytest.raises(SystemExit, match="not present on every host"):
+        cli._build_loaders(
+            make_args(tmp_path, dataset="fashion_mnist"), seed=0, mesh=mesh)
+    assert calls, "presence agreement must run even without --download"
+
+    # With --allow-synthetic: all hosts take the synthetic fallback
+    # together instead of deciding per host inside load_split.
+    _, _, used_synth = cli._build_loaders(
+        make_args(tmp_path, dataset="fashion_mnist", allow_synthetic=True),
+        seed=0, mesh=mesh)
+    assert used_synth
+
+
 def test_synthetic_tag_on_epoch_lines_and_metrics(tmp_path, capsys):
     mf = tmp_path / "metrics.jsonl"
     out = run(make_args(tmp_path, dataset="fashion_mnist", epochs=1,

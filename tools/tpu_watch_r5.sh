@@ -1,0 +1,184 @@
+#!/bin/bash
+# Round-5 capture watcher. Supersedes tools/tpu_watch_r4.sh (whose slate
+# never landed: the chip was down from 04:10Z Jul 30 through the whole of
+# round 4 — tools/captured/watch.log).
+#
+# What must land at the next chip recovery, in priority order (round-4
+# VERDICT "Next round" items 1-5):
+#   1. kernels.json          — tools/bench_kernels.py with host-read sync
+#                              + impossibility guards (the only prior
+#                              capture, kernels_r3_invalid.json, recorded
+#                              a physically impossible sync and was
+#                              invalidated — flash/fused-Adam claims rest
+#                              on NO valid measurement until this lands).
+#   2. tests_tpu_rerun.log   — the on-chip suite with the staged fixes
+#                              (expect green; 6/9 pre-fix).
+#   3. northstar_cold_r5.json — cold start against the shipped .xla_cache
+#                              (primed-cache cold: the honest "first run"
+#                              figure; also (re)warms the cache), with the
+#                              round-5 host-gather default.
+#   4. northstar_warm.json   — the SAME command immediately after: compile
+#                              cache hot, the steady-state <60 s figure.
+#   5. flash_sweep.json      — block-size sweep behind the T=4096
+#                              flash-vs-dense decision.
+#   6. bench.json            — fresh headline line (also carries the
+#                              device-gather + sorted-index probe numbers
+#                              that decide VERDICT #4 by measurement).
+#   7. bench_vit.json        — end-to-end MXU-bound ViT line; --vit now
+#                              exits nonzero on full failure (round-4
+#                              advisor), so the rc gate is real.
+#
+# Publication gates per item: producer exit code 0, a required
+# '"backend": "tpu"' marker (a producer whose jax init fell back to CPU
+# exits 0 with an honest CPU line — that must never become the round's
+# capture), and for bench.json the absence of the watcher-capture
+# re-emission marker. Each item is skipped once captured; a 90s liveness
+# re-probe before each item skips the rest of a cycle when the link
+# wedges mid-way. Retry cycles are CAPPED (round-3 advisor: the uncapped
+# followup loop could churn one commit per attempt forever).
+set -u
+OUT=/root/repo/tools/captured
+STATE=/tmp/tpu_watch_r5_state
+mkdir -p "$OUT" "$STATE"
+export BENCH_COMPILE_CACHE=/root/repo/.xla_cache
+MAX_CYCLES=6
+CYCLES=0
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
+
+probe_tpu() {
+  timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; float(jnp.sum(jnp.ones((8,8))))" >/dev/null 2>&1
+}
+
+# run_capture <name> <timeout> <dest> <require_pat> <forbid_pat> <cmd...>
+# stdout -> dest.new; published to dest only when rc==0 AND require_pat
+# (if non-empty) is present AND forbid_pat (if non-empty) is absent.
+# Marks $STATE/<name> on success so later cycles skip it.
+run_capture() {
+  local name=$1 tmo=$2 dest=$3 require=$4 forbid=$5; shift 5
+  [ -e "$STATE/$name" ] && return 0
+  if ! probe_tpu; then
+    log "r5 capture $name skipped: link re-probe failed"
+    return 1
+  fi
+  timeout "$tmo" "$@" > "$dest.new" 2>> "$OUT/watch.log"
+  local rc=$?
+  if [ "$rc" -eq 0 ] && [ -n "$require" ] \
+      && ! grep -q "$require" "$dest.new" 2>/dev/null; then
+    log "r5 capture $name rejected: missing required marker $require"
+    rc=1
+  fi
+  if [ "$rc" -eq 0 ] && [ -n "$forbid" ] \
+      && grep -q "$forbid" "$dest.new" 2>/dev/null; then
+    log "r5 capture $name rejected: forbidden marker $forbid"
+    rc=1
+  fi
+  if [ "$rc" -eq 0 ]; then
+    mv "$dest.new" "$dest"
+    touch "$STATE/$name"
+  else
+    cat "$dest.new" >> "$OUT/watch.log" 2>/dev/null
+    rm -f "$dest.new"
+  fi
+  log "r5 capture $name rc=$rc"
+  return "$rc"
+}
+
+TPU='"backend": "tpu"'
+
+while true; do
+  if probe_tpu; then
+    log "TPU alive - r5 capturing (cycle $((CYCLES + 1))/$MAX_CYCLES)"
+    # Wait out any hermetic-suite run: one host core; a concurrent
+    # pytest would pollute every wall-clock number below.
+    for _ in $(seq 1 60); do
+      pgrep -f "pytest /root/repo/tests/" >/dev/null 2>&1 || \
+        pgrep -f "pytest tests/" >/dev/null 2>&1 || break
+      sleep 30
+    done
+
+    run_capture kernels 1800 "$OUT/kernels.json" "$TPU" "" \
+      python /root/repo/tools/bench_kernels.py; K_RC=$?
+
+    # pytest writes its own log (stdout IS the artifact, failing or not)
+    # but only a green run marks the item done.
+    if [ ! -e "$STATE/tests_tpu" ]; then
+      if probe_tpu; then
+        timeout 1800 python -m pytest /root/repo/tests_tpu/ -q \
+          > "$OUT/tests_tpu_rerun.log" 2>&1
+        T_RC=$?
+        # The suite SKIPS (rc 0) when the link wedges between our probe
+        # and pytest's own; an all-skipped log is not a green run.
+        if [ "$T_RC" -eq 0 ] \
+            && grep -q "no TPU backend reachable" "$OUT/tests_tpu_rerun.log"; then
+          log "r5 capture tests_tpu rejected: suite skipped (link dropped)"
+          T_RC=1
+        fi
+        [ "$T_RC" -eq 0 ] && touch "$STATE/tests_tpu"
+        log "r5 capture tests_tpu rc=$T_RC (tests_tpu_rerun.log)"
+      else
+        T_RC=1
+        log "r5 capture tests_tpu skipped: link re-probe failed"
+      fi
+    else
+      T_RC=0
+    fi
+
+    # Cold/warm pair: SAME command twice, back to back. The first run is
+    # the primed-cache cold start (fresh process against whatever
+    # .xla_cache already holds — the honest "first run" a user pays, and
+    # it leaves the cache hot); the second is the steady-state warm
+    # number for the <60 s target. Both use the round-5 host-gather
+    # default (tools/northstar.py); --epoch-gather device stays
+    # measurable by hand if bench.json's probe says it wins after all.
+    run_capture northstar_cold 1800 "$OUT/northstar_cold_r5.json" "$TPU" "" \
+      python /root/repo/tools/northstar.py \
+        --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
+        --compile-cache "$BENCH_COMPILE_CACHE" \
+        --root /tmp/ns_tpu_cold_r5; NC_RC=$?
+
+    run_capture northstar_warm 1800 "$OUT/northstar_warm.json" "$TPU" "" \
+      python /root/repo/tools/northstar.py \
+        --dataset synthetic --epochs 20 --batch-size 512 --target 0.99 \
+        --compile-cache "$BENCH_COMPILE_CACHE" \
+        --root /tmp/ns_tpu_warm; N_RC=$?
+
+    run_capture flash_sweep 2400 "$OUT/flash_sweep.json" "$TPU" "" \
+      python /root/repo/tools/sweep_flash.py; F_RC=$?
+
+    # BENCH_CAPTURE_PATH= disables bench.py's own watcher-capture
+    # fallback so it can never re-emit this watcher's prior output; the
+    # forbid marker rejects it even if that plumbing regresses.
+    # BENCH_LAST_CAPTURE_PATH= disables the round-5 provenance pointer:
+    # a capture must never embed a pointer to its own predecessor.
+    run_capture bench 2400 "$OUT/bench.json" "$TPU" '"source": "watcher_capture"' \
+      env BENCH_CAPTURE_PATH= BENCH_LAST_CAPTURE_PATH= \
+        python /root/repo/bench.py; B_RC=$?
+
+    run_capture bench_vit 2400 "$OUT/bench_vit.json" "$TPU" "" \
+      env BENCH_CAPTURE_PATH= BENCH_LAST_CAPTURE_PATH= \
+        python /root/repo/bench.py --vit; V_RC=$?
+
+    log "r5 cycle done kernels=$K_RC tests_tpu=$T_RC northstar_cold=$NC_RC northstar_warm=$N_RC flash_sweep=$F_RC bench=$B_RC bench_vit=$V_RC"
+    git -C /root/repo add tools/captured \
+      && git -C /root/repo commit -q \
+        -m "tools/captured: r5 capture kernels=$K_RC tests_tpu=$T_RC northstar_cold=$NC_RC northstar_warm=$N_RC flash_sweep=$F_RC bench=$B_RC bench_vit=$V_RC" \
+        -- tools/captured >> "$OUT/watch.log" 2>&1
+    if [ "$K_RC" -eq 0 ] && [ "$T_RC" -eq 0 ] && [ "$NC_RC" -eq 0 ] \
+        && [ "$N_RC" -eq 0 ] && [ "$F_RC" -eq 0 ] && [ "$B_RC" -eq 0 ] \
+        && [ "$V_RC" -eq 0 ]; then
+      log "r5 capture COMPLETE"
+      exit 0
+    fi
+    CYCLES=$((CYCLES + 1))
+    if [ "$CYCLES" -ge "$MAX_CYCLES" ]; then
+      log "r5 capture INCOMPLETE after $MAX_CYCLES cycles - giving up"
+      exit 1
+    fi
+    log "r5 capture INCOMPLETE - will retry ($CYCLES/$MAX_CYCLES used)"
+    sleep 300
+    continue
+  fi
+  echo "$(date -u +%FT%TZ) tpu still down (r5)" >> "$OUT/watch.log"
+  sleep 390
+done
