@@ -586,6 +586,12 @@ def _read_tpu_capture(env_var: str):
     return captured, path, mtime
 
 
+def _mtime_iso(mtime: float) -> str:
+    """File-mtime fallback provenance for legacy captures without an
+    embedded ``measured_at`` — one formatter for both consumers."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+
+
 def _load_watcher_capture() -> dict | None:
     """Freshest mid-session TPU capture from tools/tpu_watch.sh, if any.
 
@@ -624,8 +630,7 @@ def _load_watcher_capture() -> dict | None:
         # Legacy capture without an embedded measurement time; file mtime
         # is the best remaining provenance (weaker: a rewrite or git
         # checkout restamps it, which is why new lines embed measured_at).
-        captured["capture_timestamp"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+        captured["capture_timestamp"] = _mtime_iso(mtime)
     return captured
 
 
@@ -658,8 +663,7 @@ def _last_valid_tpu_capture() -> dict | None:
     if pointer["measured_at"] is None:
         # Legacy capture without an embedded time: file mtime is the best
         # remaining provenance (weaker — a git checkout restamps it).
-        pointer["measured_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+        pointer["measured_at"] = _mtime_iso(mtime)
         pointer["measured_at_source"] = "file_mtime"
     try:
         commit = subprocess.run(
@@ -902,6 +906,10 @@ def main() -> None:
     out["measured_at"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     print(json.dumps(out))
+    if not result.get("ok"):
+        # Even the CPU fallback died: same failed-runs-never-exit-0
+        # convention as --vit / the kernel tools, after the JSON line.
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -330,41 +330,50 @@ def _build_loaders(args, seed: int, mesh):
         except (OSError, ValueError) as exc:
             log0(f"WARNING: download of {name!r} failed: {exc}")
 
+    preloaded = None
     if not synthesize and process_count() > 1:
-        # The presence outcome is AGREED across hosts whether or not
-        # --download ran: unless every host has the files, every host takes
-        # the SAME exit — fail fast together (no --allow-synthetic) or fall
-        # back to synthetic together. Deciding per host inside load_split
-        # (the pre-round-5 behavior for runs without --download) would let
+        # The real-vs-synthetic outcome is AGREED across hosts whether or
+        # not --download ran: unless every host can read the files, every
+        # host takes the SAME exit — fail fast together (no
+        # --allow-synthetic) or fall back to synthetic together. Deciding
+        # per host inside load_split (the pre-round-5 behavior) would let
         # one host train on real rows while another trains on fake ones
         # (silent cross-host data divergence), or raise SystemExit on one
-        # host while its peers hang at the next collective. A barrier alone
-        # only synchronizes timing, not results.
+        # host while its peers hang at the next collective. The agreement
+        # is on actual LOAD SUCCESS, not a dataset_present() check — a
+        # presence probe leaves a window between check and read in which
+        # one host's files can vanish (round-5 review), and on success the
+        # loaded arrays are kept, so nothing is read twice.
         from jax.experimental import multihost_utils
 
-        from pytorch_distributed_mnist_tpu.data.download import (
-            dataset_present,
-        )
-        from pytorch_distributed_mnist_tpu.data.mnist import dataset_dir
+        def _try_load(train: bool):
+            try:
+                return load_dataset(args.root, name, train=train,
+                                    synthesize_if_missing=False)
+            except FileNotFoundError:
+                return None
 
-        have = dataset_present(dataset_dir(args.root, name))
+        loaded = (_try_load(train=True), _try_load(train=False))
+        ok = all(split is not None for split in loaded)
         everyone = multihost_utils.process_allgather(
-            np.asarray([have], dtype=np.bool_)
+            np.asarray([ok], dtype=np.bool_)
         )
-        if not bool(np.all(everyone)):
+        if bool(np.all(everyone)):
+            preloaded = loaded
+        else:
             if not allow_synthetic:
                 hint = ("the download may have failed (see any warning "
                         "above)" if args.download else
                         "pre-download on every host, or pass --download")
                 raise SystemExit(
                     f"{name!r} is not present on every host "
-                    f"({int(np.sum(everyone))}/{everyone.size} have it) "
+                    f"({int(np.sum(everyone))}/{everyone.size} loaded it) "
                     f"— {hint}, or pass --allow-synthetic to train on "
                     f"labelled fake data, or --dataset synthetic."
                 )
             log0(
                 f"WARNING: {name!r} is not present on every host "
-                f"({int(np.sum(everyone))}/{everyone.size} have it); "
+                f"({int(np.sum(everyone))}/{everyone.size} loaded it); "
                 "all hosts will use the synthetic fallback so training "
                 "data stays consistent across the job"
             )
@@ -403,8 +412,11 @@ def _build_loaders(args, seed: int, mesh):
                             synthetic_train_size=n, synthetic_test_size=n,
                             seed=seed)
 
-    train_images, train_labels = load_split(train=True)
-    test_images, test_labels = load_split(train=False)
+    if preloaded is not None:
+        (train_images, train_labels), (test_images, test_labels) = preloaded
+    else:
+        train_images, train_labels = load_split(train=True)
+        test_images, test_labels = load_split(train=False)
     # Batch rows shard over the mesh's DATA axis, not over processes: a
     # host whose devices share a data coordinate with another host's
     # (multi-host TP/PP/SP — the model/stage/seq axis spans processes)

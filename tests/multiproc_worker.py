@@ -34,6 +34,18 @@ def main() -> None:
 
     from pytorch_distributed_mnist_tpu.cli import build_parser, run
 
+    if os.environ.get("TPUMNIST_TEST_CKPT_FAULT_RANK") == str(rank):
+        # Fault injection for test_two_process_ckpt_write_fault_fails_all:
+        # this rank's sharded shard-file write raises, exercising the
+        # write-ok allgather that keeps the OTHER rank out of the
+        # timeout-less publish barrier (round-4 advisor).
+        from pytorch_distributed_mnist_tpu.train import checkpoint as _ckpt
+
+        def _failing_write(*a, **kw):
+            raise OSError("injected checkpoint write fault (test)")
+
+        _ckpt._sharded_write_files = _failing_write
+
     args = build_parser().parse_args(
         [
             "--dataset", "synthetic",

@@ -205,6 +205,17 @@ def test_pointer_rejects_cpu_and_garbage_captures(tmp_path, monkeypatch):
     assert ptr["measured_at_source"] == "file_mtime"
 
 
+def test_capture_readers_tolerate_invalid_utf8(tmp_path, monkeypatch):
+    """A truncated/corrupt capture with invalid UTF-8 must degrade to
+    None in BOTH readers, never crash the always-emit-JSON contract."""
+    path = tmp_path / "cap.json"
+    path.write_bytes(b'{"backend": "tpu", "value": \xff\xfe garbage')
+    monkeypatch.setenv("BENCH_LAST_CAPTURE_PATH", str(path))
+    assert bench._last_valid_tpu_capture() is None
+    monkeypatch.setenv("BENCH_CAPTURE_PATH", str(path))
+    assert bench._load_watcher_capture() is None
+
+
 def test_vit_main_exits_nonzero_on_full_failure(monkeypatch, capsys):
     """Round-4 advisor: a fully failed --vit run must not exit 0 — the
     watcher's rc gate (tools/tpu_watch_r5.sh) rejects it without parsing,

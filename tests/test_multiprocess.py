@@ -461,6 +461,46 @@ def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path, async_ckpt):
 
 
 @pytest.mark.slow
+def test_two_process_ckpt_write_fault_fails_all_ranks(tmp_path):
+    """Round-4 advisor (checkpoint.py): one host's sharded write failing
+    must fail EVERY host at the next drain, not strand the healthy hosts
+    in the timeout-less publish barrier. Rank 1's shard-file write is
+    fault-injected (see multiproc_worker.py); with the write-ok
+    allgather, rank 1 exits on the injected OSError and rank 0 exits on
+    the peer-failure RuntimeError — before the fix, rank 0 would hang in
+    sync_global_devices until this test's communicate() timeout."""
+    port = _free_port()
+    ckpt = str(tmp_path / "ckpts")
+    env = dict(_child_env(), TPUMNIST_TEST_CKPT_FAULT_RANK="1")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), "2", str(port), ckpt,
+             "--optimizer-sharding", "zero1", "--async-checkpoint",
+             "--epochs", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=_REPO,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert len(outs) == 2, "a rank hung in the publish barrier"
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode not in (0, None), (
+            f"rank {rank} should have failed:\n{out[-4000:]}")
+    # Each rank names its own failure mode.
+    assert "injected checkpoint write fault" in outs[1]
+    assert "failed on host(s) [1]" in outs[0]
+
+
+@pytest.mark.slow
 def test_two_process_zero3_matches_single_and_resumes(tmp_path):
     """Multi-host ZeRO-3: PARAMS (not just moments) shard across the 2
     processes, so every step AllGathers weights across the real process

@@ -90,10 +90,17 @@ while true; do
   if probe_tpu; then
     log "TPU alive - r5 capturing (cycle $((CYCLES + 1))/$MAX_CYCLES)"
     # Wait out any hermetic-suite run: one host core; a concurrent
-    # pytest would pollute every wall-clock number below.
-    for _ in $(seq 1 60); do
+    # pytest would pollute every wall-clock number below. 80x30s covers
+    # the full suite (~35 min, README); if pytest is SOMEHOW still alive
+    # after that, say so in the log — silently capturing contended
+    # wall-clock numbers would violate the same no-silent-pollution rule
+    # the rc gates enforce.
+    for i in $(seq 1 80); do
       pgrep -f "pytest /root/repo/tests/" >/dev/null 2>&1 || \
         pgrep -f "pytest tests/" >/dev/null 2>&1 || break
+      if [ "$i" -eq 80 ]; then
+        log "r5 WARNING: pytest still running after 40 min wait - captures below may be CPU-contended"
+      fi
       sleep 30
     done
 
