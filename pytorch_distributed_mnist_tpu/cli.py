@@ -350,7 +350,12 @@ def _build_loaders(args, seed: int, mesh):
             try:
                 return load_dataset(args.root, name, train=train,
                                     synthesize_if_missing=False)
-            except FileNotFoundError:
+            except (FileNotFoundError, ValueError, OSError, EOFError):
+                # ANY local load failure — missing, corrupt ("not an IDX
+                # file" / count-mismatch ValueErrors), truncated gzip
+                # (EOFError/OSError) — must reach the allgather below,
+                # or this host dies alone while its peers block forever
+                # in the timeout-less collective.
                 return None
 
         loaded = (_try_load(train=True), _try_load(train=False))

@@ -269,6 +269,35 @@ def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
     return final
 
 
+def _agree_write_ok(write_error: Optional[BaseException], epoch: int,
+                    tmp: str) -> None:
+    """Agree the per-host shard-write outcome BEFORE the publish barrier.
+
+    ``_sharded_publish``'s ``sync_global_devices`` has no timeout, so a
+    host raising its local write error while its peers enter the barrier
+    would hang the job forever (round-4 advisor). Every host calls this
+    at the same logical step (sync: right after its write; async: at the
+    drain); afterwards all hosts either publish together or raise
+    together — peers of a failed host raise ``RuntimeError`` naming it,
+    the failed host re-raises its own error.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        ok = write_error is None
+        everyone = multihost_utils.process_allgather(
+            np.asarray([ok], dtype=np.bool_)
+        ).reshape(-1)
+        if not bool(np.all(everyone)) and ok:
+            failed = [int(i) for i in np.nonzero(~everyone)[0]]
+            raise RuntimeError(
+                f"sharded checkpoint write for epoch {epoch} failed on "
+                f"host(s) {failed}; dropping unpublished {tmp}"
+            )
+    if write_error is not None:
+        raise write_error
+
+
 def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
                   directory: str, pid: int, keep_last: int = 0) -> str:
     """Every process writes its owned shards; process 0 publishes the dir.
@@ -279,7 +308,12 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
     tmp, final = _sharded_prepare(directory, epoch, pid)
     payload, index = _sharded_collect(named, pid)
     meta = _sharded_meta(named, epoch, best_acc) if pid == 0 else None
-    _sharded_write_files(tmp, pid, payload, index, meta)
+    try:
+        _sharded_write_files(tmp, pid, payload, index, meta)
+        err: Optional[BaseException] = None
+    except BaseException as exc:
+        err = exc
+    _agree_write_ok(err, epoch, tmp)
     return _sharded_publish(tmp, final, directory, epoch, is_best,
                             keep_last, pid)
 
@@ -544,34 +578,13 @@ class AsyncCheckpointer:
             self._thread = None
         if self._pending_publish is not None:
             pub, self._pending_publish = self._pending_publish, None
-            write_ok = self._error is None
-            if jax.process_count() > 1:
-                # Agree the per-host write outcome BEFORE the publish
-                # barrier: ``_sharded_publish``'s sync_global_devices has
-                # no timeout, so a host raising its local write error
-                # while its peers enter the barrier would hang the job
-                # forever (round-4 advisor). Every host drains at the same
-                # logical step, so this allgather lines up; afterwards all
-                # hosts either publish together or raise together.
-                from jax.experimental import multihost_utils
-
-                everyone = multihost_utils.process_allgather(
-                    np.asarray([write_ok], dtype=np.bool_)
-                ).reshape(-1)
-                if not bool(np.all(everyone)):
-                    failed = [int(i) for i in np.nonzero(~everyone)[0]]
-                    if write_ok:
-                        # Our shards landed but a peer's write failed:
-                        # drop the publish (tmp dir left for postmortem)
-                        # and fail in step with the raising host(s).
-                        raise RuntimeError(
-                            f"sharded checkpoint write for epoch "
-                            f"{pub['epoch']} failed on host(s) {failed}; "
-                            f"dropping unpublished {pub['tmp']}"
-                        )
-                    write_ok = False
-            if write_ok:
-                self._result = _sharded_publish(**pub)
+            err, self._error = self._error, None
+            # Every host drains at the same logical step, so the
+            # agreement collective lines up; it raises (on every host)
+            # when any host's write failed, leaving the tmp dir for
+            # postmortem and the publish barrier unentered.
+            _agree_write_ok(err, pub["epoch"], pub["tmp"])
+            self._result = _sharded_publish(**pub)
         if self._error is not None:
             exc, self._error = self._error, None
             raise exc

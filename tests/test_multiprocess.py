@@ -461,37 +461,46 @@ def test_two_process_zero1_sharded_checkpoint_roundtrip(tmp_path, async_ckpt):
 
 
 @pytest.mark.slow
-def test_two_process_ckpt_write_fault_fails_all_ranks(tmp_path):
-    """Round-4 advisor (checkpoint.py): one host's sharded write failing
-    must fail EVERY host at the next drain, not strand the healthy hosts
-    in the timeout-less publish barrier. Rank 1's shard-file write is
+@pytest.mark.parametrize("async_ckpt", [False, True],
+                         ids=["sync", "async"])
+def test_two_process_ckpt_write_fault_fails_all_ranks(tmp_path, async_ckpt):
+    """Round-4/5 advisor (checkpoint.py): one host's sharded write
+    failing must fail EVERY host — at the write itself (sync) or at the
+    next drain (async) — never strand the healthy host in the
+    timeout-less publish barrier. Rank 1's shard-file write is
     fault-injected (see multiproc_worker.py); with the write-ok
-    allgather, rank 1 exits on the injected OSError and rank 0 exits on
+    agreement, rank 1 exits on the injected OSError and rank 0 exits on
     the peer-failure RuntimeError — before the fix, rank 0 would hang in
     sync_global_devices until this test's communicate() timeout."""
     port = _free_port()
     ckpt = str(tmp_path / "ckpts")
     env = dict(_child_env(), TPUMNIST_TEST_CKPT_FAULT_RANK="1")
+    flags = ["--optimizer-sharding", "zero1", "--epochs", "2"]
+    if async_ckpt:
+        flags.append("--async-checkpoint")
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(rank), "2", str(port), ckpt,
-             "--optimizer-sharding", "zero1", "--async-checkpoint",
-             "--epochs", "2"],
+            [sys.executable, _WORKER, str(rank), "2", str(port), ckpt]
+            + flags,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env, cwd=_REPO,
         )
         for rank in range(2)
     ]
-    outs = []
+    outs = [None] * len(procs)
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=300)
-            outs.append(out)
+        for i, p in enumerate(procs):
+            try:
+                outs[i], _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                pass  # recorded as None; asserted below after cleanup
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    assert len(outs) == 2, "a rank hung in the publish barrier"
+    assert all(o is not None for o in outs), (
+        "a rank hung in the publish barrier; collected output:\n"
+        + "\n---\n".join((o or "<hung>")[-2000:] for o in outs))
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode not in (0, None), (
             f"rank {rank} should have failed:\n{out[-4000:]}")

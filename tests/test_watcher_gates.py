@@ -1,0 +1,103 @@
+"""Publication gates of the capture watcher (tools/tpu_watch_r5.sh).
+
+The watcher is the machinery that turns a rare chip-recovery window into
+round evidence; its ``run_capture`` gating (producer exit code, required
+backend marker, forbidden re-emission marker, skip-once-captured,
+liveness re-probe) has to be right the one time it runs for real. These
+tests extract the function from the script and exercise each gate with
+stub producers — no TPU, no jax.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(REPO, "tools", "tpu_watch_r5.sh")
+
+
+def _extract_run_capture() -> str:
+    src = open(_SCRIPT).read()
+    start = src.index("run_capture() {")
+    end = src.index("\n}", start) + 2
+    return src[start:end]
+
+
+def _harness(tmp_path, probe_ok: bool, calls: str) -> subprocess.CompletedProcess:
+    """Run run_capture scenarios in a bash sandbox with stubbed deps."""
+    script = f"""
+set -u
+OUT={tmp_path}/out
+STATE={tmp_path}/state
+mkdir -p "$OUT" "$STATE"
+log() {{ echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }}
+probe_tpu() {{ {"true" if probe_ok else "false"}; }}
+{_extract_run_capture()}
+{calls}
+"""
+    return subprocess.run(["bash", "-c", script], capture_output=True,
+                          text=True, timeout=60, cwd=str(tmp_path))
+
+
+TPU = '"backend": "tpu"'
+
+
+def test_good_capture_published_and_skipped_next_cycle(tmp_path):
+    out = tmp_path / "out"
+    p = _harness(tmp_path, True, f"""
+run_capture item 30 "$OUT/item.json" '{TPU}' "" \
+  printf '%s' '{{"backend": "tpu", "value": 1.0}}'
+echo "first=$?"
+# Second cycle: producer would now FAIL, but the item is already
+# captured, so it must be skipped (rc 0) and the file untouched.
+run_capture item 30 "$OUT/item.json" '{TPU}' "" false
+echo "second=$?"
+""")
+    assert "first=0" in p.stdout and "second=0" in p.stdout
+    assert (out / "item.json").read_text() == '{"backend": "tpu", "value": 1.0}'
+    assert os.path.exists(tmp_path / "state" / "item")
+
+
+@pytest.mark.parametrize("producer,why", [
+    ("printf '%s' '{\"backend\": \"cpu\", \"value\": 1.0}'",
+     "missing required tpu marker (honest CPU fallback line)"),
+    ("false", "producer exit code nonzero"),
+    ("sh -c 'printf bad; exit 3'", "nonzero rc with output"),
+])
+def test_rejected_captures_never_published(tmp_path, producer, why):
+    p = _harness(tmp_path, True, f"""
+run_capture item 30 "$OUT/item.json" '{TPU}' "" {producer}
+echo "rc=$?"
+""")
+    # Rejection is ANY nonzero rc (the producer's own code passes through).
+    rc_line = [l for l in p.stdout.splitlines() if l.startswith("rc=")][0]
+    assert rc_line != "rc=0", why
+    assert not os.path.exists(tmp_path / "out" / "item.json")
+    assert not os.path.exists(tmp_path / "state" / "item")
+    # The rejected output is preserved in the log for postmortems, and
+    # no .new temp file leaks.
+    assert not os.path.exists(tmp_path / "out" / "item.json.new")
+
+
+def test_forbidden_marker_rejects_reemission(tmp_path):
+    """bench.json's forbid gate: a line that is itself a watcher-capture
+    re-emission must never be captured again."""
+    p = _harness(tmp_path, True, f"""
+run_capture bench 30 "$OUT/bench.json" '{TPU}' '"source": "watcher_capture"' \
+  printf '%s' '{{"backend": "tpu", "value": 2.0, "source": "watcher_capture"}}'
+echo "rc=$?"
+""")
+    assert "rc=1" in p.stdout
+    assert not os.path.exists(tmp_path / "out" / "bench.json")
+
+
+def test_dead_link_skips_without_running_producer(tmp_path):
+    p = _harness(tmp_path, False, """
+run_capture item 30 "$OUT/item.json" "" "" sh -c 'touch ran; true'
+echo "rc=$?"
+""")
+    assert "rc=1" in p.stdout
+    assert not os.path.exists(tmp_path / "ran")
+    log = (tmp_path / "out" / "watch.log").read_text()
+    assert "skipped: link re-probe failed" in log
