@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import random
+import sys
 from typing import Optional
 
 import jax
@@ -350,12 +351,20 @@ def _build_loaders(args, seed: int, mesh):
             try:
                 return load_dataset(args.root, name, train=train,
                                     synthesize_if_missing=False)
-            except (FileNotFoundError, ValueError, OSError, EOFError):
+            except (FileNotFoundError, ValueError, OSError, EOFError) as exc:
                 # ANY local load failure — missing, corrupt ("not an IDX
                 # file" / count-mismatch ValueErrors), truncated gzip
                 # (EOFError/OSError) — must reach the allgather below,
                 # or this host dies alone while its peers block forever
-                # in the timeout-less collective.
+                # in the timeout-less collective. Say WHICH host failed
+                # and why (every process, not log0): the joint message
+                # below can only report "not present".
+                split = "train" if train else "test"
+                print(
+                    f"process {process_index()}: failed to load {name} "
+                    f"{split} split: {exc!r}",
+                    file=sys.stderr, flush=True,
+                )
                 return None
 
         loaded = (_try_load(train=True), _try_load(train=False))

@@ -306,12 +306,18 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
     runs phases 1-2 inline, phase 3 on its writer thread, and phase 4 at
     the next main-thread drain point."""
     tmp, final = _sharded_prepare(directory, epoch, pid)
-    payload, index = _sharded_collect(named, pid)
-    meta = _sharded_meta(named, epoch, best_acc) if pid == 0 else None
+    err: Optional[BaseException] = None
     try:
+        # The WHOLE produce-this-host's-files phase is under the
+        # agreement — a collect (device read) or meta failure outside it
+        # would strand peers in the agreement collective just as a write
+        # failure once stranded them in the publish barrier. Exception,
+        # not BaseException: a KeyboardInterrupt on the main thread must
+        # propagate immediately, not be held hostage by an allgather.
+        payload, index = _sharded_collect(named, pid)
+        meta = _sharded_meta(named, epoch, best_acc) if pid == 0 else None
         _sharded_write_files(tmp, pid, payload, index, meta)
-        err: Optional[BaseException] = None
-    except BaseException as exc:
+    except Exception as exc:
         err = exc
     _agree_write_ok(err, epoch, tmp)
     return _sharded_publish(tmp, final, directory, epoch, is_best,
@@ -548,9 +554,24 @@ class AsyncCheckpointer:
         # Phases 1-2 inline: the tmp-clean barrier (collective) and the
         # owned-shard D2H snapshot (device reads).
         tmp, final = _sharded_prepare(directory, epoch, pid)
-        payload, index = _sharded_collect(named, pid)
-        meta = (_sharded_meta(named, epoch, kwargs["best_acc"])
-                if pid == 0 else None)
+        # Phase 4 bookkeeping is armed EVEN when the inline snapshot
+        # below fails: the next drain's write-ok agreement then fails
+        # every host together, instead of this host raising alone while
+        # its peers wait at that drain's collective forever (the same
+        # strand class _agree_write_ok closes for write failures).
+        pending = dict(
+            tmp=tmp, final=final, directory=directory, epoch=epoch,
+            is_best=kwargs.get("is_best", False),
+            keep_last=kwargs.get("keep_last", 0), pid=pid,
+        )
+        try:
+            payload, index = _sharded_collect(named, pid)
+            meta = (_sharded_meta(named, epoch, kwargs["best_acc"])
+                    if pid == 0 else None)
+        except Exception as exc:
+            self._error = exc
+            self._pending_publish = pending
+            return
 
         def _write() -> None:
             try:
@@ -562,11 +583,7 @@ class AsyncCheckpointer:
                 self._error = exc
 
         # Phase 4 runs at the next drain, on the main thread.
-        self._pending_publish = dict(
-            tmp=tmp, final=final, directory=directory, epoch=epoch,
-            is_best=kwargs.get("is_best", False),
-            keep_last=kwargs.get("keep_last", 0), pid=pid,
-        )
+        self._pending_publish = pending
         import threading
 
         self._thread = threading.Thread(target=_write, daemon=True)
