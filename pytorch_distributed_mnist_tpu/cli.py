@@ -966,30 +966,48 @@ def run(args, epoch_callback=None) -> dict:
             latest_checkpoint,
         )
 
-        resume_path = latest_checkpoint(args.checkpoint_dir) or ""
         if process_count() > 1:
             # Every host must resume from the SAME checkpoint: a stale NFS
             # attribute cache can hide the newest file from some hosts,
             # and hosts resuming at different epochs run different numbers
             # of collective programs — a silent hang, not an error.
-            # Process 0's resolution wins.
+            # ONLY process 0 resolves (its resolution wins anyway, and a
+            # local resolution failure on another host must not kill that
+            # host before the broadcast — peers would block in it
+            # forever); the broadcast payload carries an ok/error marker
+            # byte so a process-0 failure exits every host identically
+            # instead of process 0 raising alone (round-5 audit; this
+            # also covers the >4095-byte-path case, which previously
+            # raised asymmetrically before the collective).
             from jax.experimental import multihost_utils
 
-            encoded = resume_path.encode()
-            if len(encoded) > 4096:
-                # ljust would be a no-op and process 0's payload shape
-                # would diverge from the other hosts', failing the
-                # broadcast with a shape error far from the cause.
-                raise SystemExit(
-                    f"--resume auto: checkpoint path is {len(encoded)} "
-                    "bytes, over the 4096-byte multi-host broadcast "
-                    "buffer; use a shorter --checkpoint-dir"
-                )
+            payload_bytes = b""
+            if process_index() == 0:
+                try:
+                    resolved = latest_checkpoint(args.checkpoint_dir) or ""
+                    encoded = resolved.encode()
+                    if len(encoded) > 4095:
+                        raise ValueError(
+                            f"checkpoint path is {len(encoded)} bytes, "
+                            "over the 4095-byte multi-host broadcast "
+                            "buffer; use a shorter --checkpoint-dir"
+                        )
+                    payload_bytes = b"\x00" + encoded
+                except Exception as exc:  # noqa: BLE001 - broadcast it
+                    payload_bytes = b"\x01" + repr(exc).encode()[:4000]
             payload = np.frombuffer(
-                encoded.ljust(4096, b"\0"), dtype=np.uint8
+                payload_bytes.ljust(4096, b"\0"), dtype=np.uint8
             )
             agreed = multihost_utils.broadcast_one_to_all(payload)
-            resume_path = bytes(agreed).rstrip(b"\0").decode()
+            data = bytes(agreed).rstrip(b"\0")
+            if data[:1] == b"\x01":
+                raise SystemExit(
+                    "--resume auto: resolution failed on process 0: "
+                    + data[1:].decode(errors="replace")
+                )
+            resume_path = data[1:].decode()
+        else:
+            resume_path = latest_checkpoint(args.checkpoint_dir) or ""
         if not resume_path:
             log0(f"=> --resume auto: no checkpoint in "
                  f"'{args.checkpoint_dir}' yet, training fresh")

@@ -75,13 +75,6 @@ def _npz_saveable(leaf: Any) -> bool:
                 or getattr(leaf, "is_fully_replicated", False))
 
 
-def _barrier(name: str) -> None:
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(name)
-
-
 def save_checkpoint(
     state,
     *,
@@ -151,19 +144,30 @@ def _shard_slices(leaf, shard) -> Tuple[list, list]:
 def _sharded_prepare(directory: str, epoch: int, pid: int) -> Tuple[str, str]:
     """Phase 1 (main thread, collective): clean + create the tmp dir.
 
-    Returns ``(tmp, final)``. Contains a cross-host barrier, so it must
-    run on the thread that owns the device (never a writer thread)."""
+    Returns ``(tmp, final)``. Contains a cross-host collective, so it
+    must run on the thread that owns the device (never a writer thread).
+    Process 0's local filesystem work is wrapped in the phase agreement:
+    a cleanup failure fails every host together rather than process 0
+    raising alone while its peers block in the synchronization — the
+    agreement collective doubles as the nobody-writes-into-a-dir-
+    being-rm'd barrier. Creating each host's own view of ``tmp`` is left
+    to the callers' guarded produce phase for the same reason."""
     final = os.path.join(directory, f"checkpoint_{epoch}.ckpt")
     tmp = final + ".tmp"  # same deterministic name on every process
+    err: Optional[BaseException] = None
     if pid == 0:
-        # A crashed earlier attempt may have left stale shard files here;
-        # publishing those alongside fresh ones would silently corrupt the
-        # restore (stale index records overwrite freshly-stitched regions).
-        if os.path.isdir(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
-    _barrier(f"ckpt_tmp_clean_{epoch}")  # nobody writes into a dir being rm'd
-    os.makedirs(tmp, exist_ok=True)
+        try:
+            # A crashed earlier attempt may have left stale shard files
+            # here; publishing those alongside fresh ones would silently
+            # corrupt the restore (stale index records overwrite
+            # freshly-stitched regions).
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+        except Exception as exc:
+            err = exc
+    _agree_phase_ok(err, epoch, "prepare",
+                    f"tmp dir {tmp} could not be prepared")
     return tmp, final
 
 
@@ -228,6 +232,40 @@ def _sharded_write_files(tmp: str, pid: int, payload, index,
             json.dump(meta, f)
 
 
+def _publish_dir(tmp: str, final: str, directory: str, epoch: int,
+                 is_best: bool, keep_last: int) -> None:
+    """Process 0's publish body: shared-fs check, atomic rename, best
+    copy, GC. Factored out so the multi-process fault tests can inject a
+    failure here and pin that it fails EVERY host (see _sharded_publish).
+    """
+    # Shared-filesystem check: every host's index file must be visible
+    # here, or the published checkpoint would be missing their shards
+    # (and resume would diverge: host 0 errors, others start fresh).
+    missing = [
+        p for p in range(jax.process_count())
+        if not os.path.isfile(os.path.join(tmp, f"index_p{p:05d}.json"))
+    ]
+    if missing:
+        raise RuntimeError(
+            f"sharded checkpoint save: index files from processes "
+            f"{missing} are not visible in {tmp} — --checkpoint-dir "
+            f"must be a filesystem shared by all hosts"
+        )
+    if os.path.isdir(final):
+        shutil.rmtree(final)
+    os.replace(tmp, final)  # atomic publish of the complete directory
+    if is_best:
+        best = os.path.join(directory, "model_best.ckpt")
+        best_tmp = best + ".copy_tmp"
+        if os.path.isdir(best_tmp):
+            shutil.rmtree(best_tmp)
+        shutil.copytree(final, best_tmp)
+        if os.path.isdir(best):
+            shutil.rmtree(best)
+        os.replace(best_tmp, best)
+    prune_checkpoints(directory, keep_last)
+
+
 def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
                      is_best: bool, keep_last: int, pid: int) -> str:
     """Phase 4 (main thread, collective): barrier until every host's
@@ -236,66 +274,58 @@ def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
     ``directory`` must be a filesystem shared by all hosts (the same
     assumption the reference makes for every rank loading rank 0's file,
     ``:202``); process 0 verifies that after the write barrier by checking
-    every host's index file is visible before publishing."""
-    _barrier(f"ckpt_save_{epoch}")  # all shard files are on disk
+    every host's index file is visible before publishing. Process 0's
+    publish outcome is AGREED before anyone proceeds: that RuntimeError
+    (a real misconfiguration a user can hit) previously raised on
+    process 0 alone while every peer blocked in the trailing barrier
+    forever. The agreement collective doubles as the
+    no-reader-races-a-half-published-dir barrier.
+
+    ORDERING CONTRACT: callers must run the write-phase
+    ``_agree_phase_ok`` immediately before this function (both call
+    sites do) — that agreement is the all-shard-files-are-on-disk
+    barrier, so no extra collective runs here before process 0 checks
+    visibility."""
+    err: Optional[BaseException] = None
     if pid == 0:
-        # Shared-filesystem check: every host's index file must be visible
-        # here, or the published checkpoint would be missing their shards
-        # (and resume would diverge: host 0 errors, others start fresh).
-        missing = [
-            p for p in range(jax.process_count())
-            if not os.path.isfile(os.path.join(tmp, f"index_p{p:05d}.json"))
-        ]
-        if missing:
-            raise RuntimeError(
-                f"sharded checkpoint save: index files from processes "
-                f"{missing} are not visible in {tmp} — --checkpoint-dir "
-                f"must be a filesystem shared by all hosts"
-            )
-        if os.path.isdir(final):
-            shutil.rmtree(final)
-        os.replace(tmp, final)  # atomic publish of the complete directory
-        if is_best:
-            best = os.path.join(directory, "model_best.ckpt")
-            best_tmp = best + ".copy_tmp"
-            if os.path.isdir(best_tmp):
-                shutil.rmtree(best_tmp)
-            shutil.copytree(final, best_tmp)
-            if os.path.isdir(best):
-                shutil.rmtree(best)
-            os.replace(best_tmp, best)
-        prune_checkpoints(directory, keep_last)
-    _barrier(f"ckpt_publish_{epoch}")  # no reader races a half-published dir
+        try:
+            _publish_dir(tmp, final, directory, epoch, is_best, keep_last)
+        except Exception as exc:
+            err = exc
+    _agree_phase_ok(err, epoch, "publish",
+                    f"checkpoint dir {final} was not published")
     return final
 
 
-def _agree_write_ok(write_error: Optional[BaseException], epoch: int,
-                    tmp: str) -> None:
-    """Agree the per-host shard-write outcome BEFORE the publish barrier.
+def _agree_phase_ok(error: Optional[BaseException], epoch: int,
+                    phase: str, detail: str) -> None:
+    """Agree a per-host phase outcome before anyone proceeds past it.
 
-    ``_sharded_publish``'s ``sync_global_devices`` has no timeout, so a
-    host raising its local write error while its peers enter the barrier
-    would hang the job forever (round-4 advisor). Every host calls this
-    at the same logical step (sync: right after its write; async: at the
-    drain); afterwards all hosts either publish together or raise
-    together — peers of a failed host raise ``RuntimeError`` naming it,
-    the failed host re-raises its own error.
+    The sharded layout's barriers have no timeout, so a host raising its
+    local error while its peers enter the next collective would hang the
+    job forever (round-4/5 advisor — this held for shard writes, tmp-dir
+    prepare, and process 0's publish body alike). Every host calls this
+    at the same logical step; afterwards all hosts either proceed
+    together or raise together — peers of a failed host raise
+    ``RuntimeError`` naming it, the failed host re-raises its own error.
+    The allgather itself synchronizes, so callers may rely on this as a
+    barrier.
     """
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        ok = write_error is None
+        ok = error is None
         everyone = multihost_utils.process_allgather(
             np.asarray([ok], dtype=np.bool_)
         ).reshape(-1)
         if not bool(np.all(everyone)) and ok:
             failed = [int(i) for i in np.nonzero(~everyone)[0]]
             raise RuntimeError(
-                f"sharded checkpoint write for epoch {epoch} failed on "
-                f"host(s) {failed}; dropping unpublished {tmp}"
+                f"sharded checkpoint {phase} for epoch {epoch} failed on "
+                f"host(s) {failed}; {detail}"
             )
-    if write_error is not None:
-        raise write_error
+    if error is not None:
+        raise error
 
 
 def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
@@ -314,12 +344,13 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
         # failure once stranded them in the publish barrier. Exception,
         # not BaseException: a KeyboardInterrupt on the main thread must
         # propagate immediately, not be held hostage by an allgather.
+        os.makedirs(tmp, exist_ok=True)  # this host's view of the dir
         payload, index = _sharded_collect(named, pid)
         meta = _sharded_meta(named, epoch, best_acc) if pid == 0 else None
         _sharded_write_files(tmp, pid, payload, index, meta)
     except Exception as exc:
         err = exc
-    _agree_write_ok(err, epoch, tmp)
+    _agree_phase_ok(err, epoch, "write", f"dropping unpublished {tmp}")
     return _sharded_publish(tmp, final, directory, epoch, is_best,
                             keep_last, pid)
 
@@ -558,13 +589,14 @@ class AsyncCheckpointer:
         # below fails: the next drain's write-ok agreement then fails
         # every host together, instead of this host raising alone while
         # its peers wait at that drain's collective forever (the same
-        # strand class _agree_write_ok closes for write failures).
+        # strand class _agree_phase_ok closes for write failures).
         pending = dict(
             tmp=tmp, final=final, directory=directory, epoch=epoch,
             is_best=kwargs.get("is_best", False),
             keep_last=kwargs.get("keep_last", 0), pid=pid,
         )
         try:
+            os.makedirs(tmp, exist_ok=True)  # this host's view of the dir
             payload, index = _sharded_collect(named, pid)
             meta = (_sharded_meta(named, epoch, kwargs["best_acc"])
                     if pid == 0 else None)
@@ -600,7 +632,8 @@ class AsyncCheckpointer:
             # agreement collective lines up; it raises (on every host)
             # when any host's write failed, leaving the tmp dir for
             # postmortem and the publish barrier unentered.
-            _agree_write_ok(err, pub["epoch"], pub["tmp"])
+            _agree_phase_ok(err, pub["epoch"], "write",
+                            f"dropping unpublished {pub['tmp']}")
             self._result = _sharded_publish(**pub)
         if self._error is not None:
             exc, self._error = self._error, None

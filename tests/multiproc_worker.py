@@ -46,6 +46,18 @@ def main() -> None:
 
         _ckpt._sharded_write_files = _failing_write
 
+    if os.environ.get("TPUMNIST_TEST_CKPT_FAULT_PUBLISH") and rank == 0:
+        # Fault injection for test_two_process_ckpt_publish_fault: process
+        # 0's publish body raises (the shared-fs RuntimeError path),
+        # exercising the publish-phase agreement that keeps rank 1 out of
+        # the trailing collective (round-5 audit).
+        from pytorch_distributed_mnist_tpu.train import checkpoint as _ckpt
+
+        def _failing_publish(*a, **kw):
+            raise OSError("injected checkpoint publish fault (test)")
+
+        _ckpt._publish_dir = _failing_publish
+
     args = build_parser().parse_args(
         [
             "--dataset", "synthetic",

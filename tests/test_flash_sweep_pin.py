@@ -29,8 +29,15 @@ _TOLERANCE = 1.10
 def _load_valid_sweep():
     if not os.path.exists(_SWEEP):
         pytest.skip("no flash_sweep.json captured yet (chip-gated)")
-    with open(_SWEEP) as f:
-        sweep = json.loads(f.read().strip().splitlines()[-1])
+    try:
+        with open(_SWEEP) as f:
+            sweep = json.loads(f.read().strip().splitlines()[-1])
+    except (OSError, IndexError, UnicodeDecodeError,
+            json.JSONDecodeError) as exc:
+        # An empty/truncated/corrupt file is not evidence; it must not
+        # turn every hermetic run into an ERROR either.
+        pytest.skip(f"flash_sweep.json unreadable ({exc!r}); not valid "
+                    "evidence, pin stays unarmed")
     # The same validity gates the watcher's rc check enforces, re-checked
     # here so a hand-copied or invalidated file can never arm the pin.
     if sweep.get("invalid"):

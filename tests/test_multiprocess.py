@@ -510,6 +510,46 @@ def test_two_process_ckpt_write_fault_fails_all_ranks(tmp_path, async_ckpt):
 
 
 @pytest.mark.slow
+def test_two_process_ckpt_publish_fault_fails_all_ranks(tmp_path):
+    """Round-5 audit twin of the write-fault test, one phase later:
+    process 0's publish body failing (e.g. the real
+    not-a-shared-filesystem RuntimeError) must fail BOTH ranks — before
+    the publish-phase agreement, rank 0 raised alone while rank 1
+    blocked forever in the trailing ckpt_publish barrier."""
+    port = _free_port()
+    ckpt = str(tmp_path / "ckpts")
+    env = dict(_child_env(), TPUMNIST_TEST_CKPT_FAULT_PUBLISH="1")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), "2", str(port), ckpt,
+             "--optimizer-sharding", "zero1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=_REPO,
+        )
+        for rank in range(2)
+    ]
+    outs = [None] * len(procs)
+    try:
+        for i, p in enumerate(procs):
+            try:
+                outs[i], _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                pass
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(o is not None for o in outs), (
+        "a rank hung past the publish-phase agreement; collected output:\n"
+        + "\n---\n".join((o or "<hung>")[-2000:] for o in outs))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode not in (0, None), (
+            f"rank {rank} should have failed:\n{out[-4000:]}")
+    assert "injected checkpoint publish fault" in outs[0]
+    assert "publish for epoch 0 failed on host(s) [0]" in outs[1]
+
+
+@pytest.mark.slow
 def test_two_process_zero3_matches_single_and_resumes(tmp_path):
     """Multi-host ZeRO-3: PARAMS (not just moments) shard across the 2
     processes, so every step AllGathers weights across the real process
