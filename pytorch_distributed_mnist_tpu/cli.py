@@ -1011,7 +1011,46 @@ def run(args, epoch_callback=None) -> dict:
         if not resume_path:
             log0(f"=> --resume auto: no checkpoint in "
                  f"'{args.checkpoint_dir}' yet, training fresh")
-    state, start_epoch, best_acc = try_resume(resume_path, state)
+    if process_count() > 1 and resume_path:
+        # Agree the per-host resume OUTCOME, not just the path: a stale
+        # NFS attribute cache can hide the agreed checkpoint from one
+        # host — try_resume would then silently train fresh at epoch 0
+        # while its peers resume at N, so hosts run different numbers of
+        # collective programs (a silent hang, the exact threat the path
+        # broadcast above closes for resolution). A local load failure
+        # likewise must not kill one host before the next collective.
+        # All hosts proceed at the same epoch, or all exit loudly.
+        from jax.experimental import multihost_utils
+
+        resume_err: Optional[BaseException] = None
+        try:
+            state, start_epoch, best_acc = try_resume(resume_path, state)
+            local_outcome = start_epoch
+        except Exception as exc:  # noqa: BLE001 - agreed below
+            print(
+                f"process {process_index()}: resume from "
+                f"{resume_path!r} failed: {exc!r}",
+                file=sys.stderr, flush=True,
+            )
+            resume_err = exc
+            local_outcome = -1
+        everyone = multihost_utils.process_allgather(
+            np.asarray([local_outcome], dtype=np.int64)
+        ).reshape(-1)
+        if not bool(np.all(everyone == everyone[0])):
+            raise SystemExit(
+                f"resume outcome diverged across hosts for "
+                f"{resume_path!r}: start epochs {everyone.tolist()} "
+                f"(-1 = load failed). A host resuming at a different "
+                f"epoch runs different collective programs — a silent "
+                f"hang, not an error. Check that --checkpoint-dir is a "
+                f"filesystem shared by all hosts and the checkpoint is "
+                f"intact on every host."
+            )
+        if resume_err is not None:
+            raise resume_err  # identical on every host (agreed above)
+    else:
+        state, start_epoch, best_acc = try_resume(resume_path, state)
     resumed = resume_path and start_epoch > 0
     if not resumed:
         # Reference precedence (:204): a resumed checkpoint's epoch wins over

@@ -254,16 +254,25 @@ def _publish_dir(tmp: str, final: str, directory: str, epoch: int,
     if os.path.isdir(final):
         shutil.rmtree(final)
     os.replace(tmp, final)  # atomic publish of the complete directory
-    if is_best:
-        best = os.path.join(directory, "model_best.ckpt")
-        best_tmp = best + ".copy_tmp"
-        if os.path.isdir(best_tmp):
-            shutil.rmtree(best_tmp)
-        shutil.copytree(final, best_tmp)
-        if os.path.isdir(best):
-            shutil.rmtree(best)
-        os.replace(best_tmp, best)
-    prune_checkpoints(directory, keep_last)
+    try:
+        if is_best:
+            best = os.path.join(directory, "model_best.ckpt")
+            best_tmp = best + ".copy_tmp"
+            if os.path.isdir(best_tmp):
+                shutil.rmtree(best_tmp)
+            shutil.copytree(final, best_tmp)
+            if os.path.isdir(best):
+                shutil.rmtree(best)
+            os.replace(best_tmp, best)
+        prune_checkpoints(directory, keep_last)
+    except Exception as exc:
+        # The rename above already landed: say so, or the phase-failure
+        # message would misdirect a postmortem into discarding (or
+        # re-running) a checkpoint that IS valid on disk.
+        raise RuntimeError(
+            f"checkpoint {final} WAS published, but a post-publish step "
+            f"(best copy / prune) failed: {exc!r}"
+        ) from exc
 
 
 def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
@@ -293,7 +302,9 @@ def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
         except Exception as exc:
             err = exc
     _agree_phase_ok(err, epoch, "publish",
-                    f"checkpoint dir {final} was not published")
+                    f"checkpoint dir {final} may not have been published "
+                    f"— see the failed host's log (a post-publish "
+                    f"best-copy/prune failure leaves it valid on disk)")
     return final
 
 

@@ -46,6 +46,20 @@ def main() -> None:
 
         _ckpt._sharded_write_files = _failing_write
 
+    if os.environ.get("TPUMNIST_TEST_RESUME_HIDE_RANK") == str(rank):
+        # Fault injection for test_two_process_resume_divergence: this
+        # rank's view of the checkpoint dir is "stale" (NFS attribute
+        # cache) — try_resume silently reports no checkpoint, the exact
+        # silent-fresh-train divergence the resume-outcome agreement
+        # must turn into a loud symmetric exit. cli binds try_resume at
+        # import, so patch the cli-module binding.
+        from pytorch_distributed_mnist_tpu import cli as _cli
+
+        def _blind_try_resume(path, state):
+            return state, 0, 0.0
+
+        _cli.try_resume = _blind_try_resume
+
     if os.environ.get("TPUMNIST_TEST_CKPT_FAULT_PUBLISH") and rank == 0:
         # Fault injection for test_two_process_ckpt_publish_fault: process
         # 0's publish body raises (the shared-fs RuntimeError path),

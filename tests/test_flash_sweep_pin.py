@@ -38,6 +38,10 @@ def _load_valid_sweep():
         # turn every hermetic run into an ERROR either.
         pytest.skip(f"flash_sweep.json unreadable ({exc!r}); not valid "
                     "evidence, pin stays unarmed")
+    if not isinstance(sweep, dict):
+        # 'null'/'[]'/'42' parse as JSON but are not a capture.
+        pytest.skip("flash_sweep.json last line is not a JSON object; "
+                    "not valid evidence, pin stays unarmed")
     # The same validity gates the watcher's rc check enforces, re-checked
     # here so a hand-copied or invalidated file can never arm the pin.
     if sweep.get("invalid"):

@@ -510,19 +510,27 @@ def test_two_process_ckpt_write_fault_fails_all_ranks(tmp_path, async_ckpt):
 
 
 @pytest.mark.slow
-def test_two_process_ckpt_publish_fault_fails_all_ranks(tmp_path):
+@pytest.mark.parametrize("async_ckpt", [False, True],
+                         ids=["sync", "async"])
+def test_two_process_ckpt_publish_fault_fails_all_ranks(tmp_path,
+                                                        async_ckpt):
     """Round-5 audit twin of the write-fault test, one phase later:
     process 0's publish body failing (e.g. the real
     not-a-shared-filesystem RuntimeError) must fail BOTH ranks — before
     the publish-phase agreement, rank 0 raised alone while rank 1
-    blocked forever in the trailing ckpt_publish barrier."""
+    blocked forever in the trailing ckpt_publish barrier. The async
+    variant drives the drain-time publish (wait() -> _sharded_publish),
+    pinning the guarantee for --async-checkpoint too."""
     port = _free_port()
     ckpt = str(tmp_path / "ckpts")
     env = dict(_child_env(), TPUMNIST_TEST_CKPT_FAULT_PUBLISH="1")
+    flags = ["--optimizer-sharding", "zero1"]
+    if async_ckpt:
+        flags += ["--async-checkpoint", "--epochs", "2"]
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(rank), "2", str(port), ckpt,
-             "--optimizer-sharding", "zero1"],
+            [sys.executable, _WORKER, str(rank), "2", str(port), ckpt]
+            + flags,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env, cwd=_REPO,
         )
@@ -547,6 +555,49 @@ def test_two_process_ckpt_publish_fault_fails_all_ranks(tmp_path):
             f"rank {rank} should have failed:\n{out[-4000:]}")
     assert "injected checkpoint publish fault" in outs[0]
     assert "publish for epoch 0 failed on host(s) [0]" in outs[1]
+
+
+@pytest.mark.slow
+def test_two_process_resume_divergence_fails_loudly(tmp_path):
+    """Round-5 audit: agreeing on the resume PATH is not enough — a host
+    whose (stale-NFS) view lacks the agreed checkpoint would silently
+    train fresh at epoch 0 while peers resume at N, running different
+    collective programs (silent hang). With the resume-outcome
+    agreement, both ranks exit with the same loud SystemExit instead.
+    Rank 1's try_resume is blinded (see multiproc_worker.py)."""
+    ckpt = tmp_path / "ckpts"
+    _spawn_workers(ckpt, ["--optimizer-sharding", "zero1"])  # epoch 0 ckpt
+
+    port = _free_port()
+    env = dict(_child_env(), TPUMNIST_TEST_RESUME_HIDE_RANK="1")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), "2", str(port), str(ckpt),
+             "--optimizer-sharding", "zero1", "--epochs", "2",
+             "--resume", "auto"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=_REPO,
+        )
+        for rank in range(2)
+    ]
+    outs = [None] * len(procs)
+    try:
+        for i, p in enumerate(procs):
+            try:
+                outs[i], _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                pass
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(o is not None for o in outs), (
+        "a rank hung instead of exiting on resume divergence:\n"
+        + "\n---\n".join((o or "<hung>")[-2000:] for o in outs))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode not in (0, None), (
+            f"rank {rank} should have failed:\n{out[-4000:]}")
+        assert "resume outcome diverged across hosts" in out, out[-2000:]
 
 
 @pytest.mark.slow
