@@ -67,17 +67,14 @@ from pytorch_distributed_mnist_tpu.train.checkpoint import save_checkpoint, try_
 from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
 from pytorch_distributed_mnist_tpu.train.state import create_train_state
 from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+from pytorch_distributed_mnist_tpu.utils import compile_cache
 from pytorch_distributed_mnist_tpu.utils.logging import log0
 from pytorch_distributed_mnist_tpu.utils.profiling import (
     StepTimer,
+    compile_log,
     phase,
     profile_trace,
 )
-
-# The process-wide compile-cache config from before the first run() call
-# (dir, min_compile_secs, min_entry_bytes) — captured lazily so a harness's
-# own cache setup (tests/conftest.py) survives flag-less runs; see run().
-_AMBIENT_CACHE = None
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -277,7 +274,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "recompiling (~20-40s per program on TPU) — most "
                         "of the wall-clock of a short convergence run is "
                         "compile time, so this is the restart-latency "
-                        "lever for --resume auto workflows")
+                        "lever for --resume auto workflows. Default: the "
+                        "TPUMNIST_COMPILE_CACHE env var, else "
+                        "<repo>/.xla_cache (shared with bench.py and the "
+                        "watcher's pre-warm). Pass an empty string to "
+                        "disable caching entirely")
+    p.add_argument("--no-precompile", action="store_true",
+                   help="skip the AOT precompile: by default every program "
+                        "the run will execute (train epoch/step, eval "
+                        "twin) is .lower().compile()-d on background "
+                        "threads WHILE the first epoch's host staging "
+                        "runs, instead of serially at first use — the "
+                        "cold-start lever (VERDICT r5: compile time is "
+                        "the whole 62.4s-vs-60s north-star gap). This "
+                        "flag restores lazy first-use compilation "
+                        "(debugging, or measuring the unoverlapped cost)")
     p.add_argument("--metrics-file", type=str, default=None,
                    help="append one JSON line per epoch (process 0 only): "
                         "epoch, losses, accuracies, lr, images/sec — the "
@@ -347,18 +358,23 @@ def _build_loaders(args, seed: int, mesh):
         # loaded arrays are kept, so nothing is read twice.
         from jax.experimental import multihost_utils
 
+        import zlib
+
         def _try_load(train: bool):
             try:
                 return load_dataset(args.root, name, train=train,
                                     synthesize_if_missing=False)
-            except (FileNotFoundError, ValueError, OSError, EOFError) as exc:
+            except (FileNotFoundError, ValueError, OSError, EOFError,
+                    zlib.error) as exc:
                 # ANY local load failure — missing, corrupt ("not an IDX
                 # file" / count-mismatch ValueErrors), truncated gzip
-                # (EOFError/OSError) — must reach the allgather below,
-                # or this host dies alone while its peers block forever
-                # in the timeout-less collective. Say WHICH host failed
-                # and why (every process, not log0): the joint message
-                # below can only report "not present".
+                # (EOFError/OSError), or a corrupt MID-stream gzip
+                # (zlib.error is NOT an OSError subclass; round-5
+                # advisor) — must reach the allgather below, or this
+                # host dies alone while its peers block forever in the
+                # timeout-less collective. Say WHICH host failed and why
+                # (every process, not log0): the joint message below can
+                # only report "not present".
                 split = "train" if train else "test"
                 print(
                     f"process {process_index()}: failed to load {name} "
@@ -482,46 +498,15 @@ def run(args, epoch_callback=None) -> dict:
         _os.environ.get("JAX_DEBUG_NANS")
     )
     jax.config.update("jax_debug_nans", debug_nans)
-    # Unconditional, like jax_debug_nans above: run() is re-entered in one
-    # process (tests, tools), and a previous run's cache dir must not leak
-    # into a run that didn't ask for one. "Didn't ask" restores the
-    # AMBIENT config from before the first run() — not None — so a harness
-    # that set its own process-wide cache (tests/conftest.py's .xla_cache)
-    # keeps it across every flag-less run.
-    global _AMBIENT_CACHE
-    if _AMBIENT_CACHE is None:
-        _AMBIENT_CACHE = (
-            jax.config.jax_compilation_cache_dir,
-            jax.config.jax_persistent_cache_min_compile_time_secs,
-            jax.config.jax_persistent_cache_min_entry_size_bytes,
-        )
-    if getattr(args, "compile_cache", None):
-        if jax.config.jax_compilation_cache_dir != args.compile_cache:
-            # jax binds its cache object to the first dir that initializes
-            # it (e.g. a test harness's shared cache), and an earlier
-            # run() in this process may have compiled the same programs
-            # under another dir (or none); reset so THIS run's programs
-            # land in the requested dir. The in-memory jit cache must go
-            # too — a program it already holds would never reach XLA, so
-            # nothing would be written to the new dir.
-            from jax.experimental.compilation_cache import (
-                compilation_cache as _cc,
-            )
-
-            _cc.reset_cache()
-            jax.clear_caches()
-        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
-        # Cache every program, however small/fast-compiling (defaults
-        # skip sub-second compiles, which covers most CPU-test programs).
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    else:
-        amb_dir, amb_secs, amb_bytes = _AMBIENT_CACHE
-        jax.config.update("jax_compilation_cache_dir", amb_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          amb_secs)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
-                          amb_bytes)
+    # Persistent compile cache: the SHARED wiring (utils/compile_cache.py)
+    # used by every entry point — bench.py, tools/northstar.py, the test
+    # harness, and this run(). Resolution: --compile-cache flag >
+    # TPUMNIST_COMPILE_CACHE env > harness-pinned ambient config >
+    # <repo>/.xla_cache default; flag/env "" disables. Re-entrant-safe:
+    # a previous run()'s dir never leaks into a run that asked otherwise.
+    cache_dir = compile_cache.configure(getattr(args, "compile_cache", None))
+    if cache_dir:
+        log0(f"compile cache: {cache_dir}")
     log0(args)  # startup args print parity (:337)
     seed = args.seed if args.seed is not None else 0
     if args.seed is not None:
@@ -981,6 +966,11 @@ def run(args, epoch_callback=None) -> dict:
             # raised asymmetrically before the collective).
             from jax.experimental import multihost_utils
 
+            # Marker bytes are non-NUL on purpose: the padding strip below
+            # is rstrip(b'\0'), and a NUL success marker in front of an
+            # EMPTY resolved path would be stripped with it, leaving the
+            # decode relying on b''[:1]/b''[1:] happening to work
+            # (round-5 advisor). 'K' (ok) / 'E' (error) always survive.
             payload_bytes = b""
             if process_index() == 0:
                 try:
@@ -992,15 +982,15 @@ def run(args, epoch_callback=None) -> dict:
                             "over the 4095-byte multi-host broadcast "
                             "buffer; use a shorter --checkpoint-dir"
                         )
-                    payload_bytes = b"\x00" + encoded
+                    payload_bytes = b"K" + encoded
                 except Exception as exc:  # noqa: BLE001 - broadcast it
-                    payload_bytes = b"\x01" + repr(exc).encode()[:4000]
+                    payload_bytes = b"E" + repr(exc).encode()[:4000]
             payload = np.frombuffer(
                 payload_bytes.ljust(4096, b"\0"), dtype=np.uint8
             )
             agreed = multihost_utils.broadcast_one_to_all(payload)
             data = bytes(agreed).rstrip(b"\0")
-            if data[:1] == b"\x01":
+            if data[:1] == b"E":
                 raise SystemExit(
                     "--resume auto: resolution failed on process 0: "
                     + data[1:].decode(errors="replace")
@@ -1121,6 +1111,19 @@ def run(args, epoch_callback=None) -> dict:
                       aux_weight=aux_weight)
     lr_of = step_decay_schedule(args.lr)
 
+    # Per-run compile accounting (surfaced in the summary/logs below);
+    # reset here so a re-entrant run() reports its own compiles only.
+    compile_log.reset()
+    if not args.evaluate and not getattr(args, "no_precompile", False):
+        # AOT-compile every program this run will execute on background
+        # threads, overlapping the first epoch's host staging below —
+        # compile leaves the cold-start critical path (the whole r5
+        # north-star gap) instead of serializing at first batch. With a
+        # warm persistent cache the same call degenerates to fast
+        # executable fetches. (--evaluate runs one program once: there
+        # is nothing to overlap.)
+        trainer.precompile()
+
     if args.evaluate:
         # Short-circuit parity (:225-228).
         test_loss, test_acc = trainer.evaluate()
@@ -1217,7 +1220,15 @@ def run(args, epoch_callback=None) -> dict:
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
          f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")
+    compile_stats = compile_log.stats()
+    for prog, rec in compile_stats["programs"].items():
+        hit = rec["persistent_cache_hit"]
+        cache = ("cache off" if hit is None
+                 else "cache hit" if hit else "cache miss")
+        log0(f"compile[{prog}]: {rec['wall_ms']:.0f} ms "
+             f"({rec['backend_compiles']} XLA compile(s), {cache})")
     return {"best_acc": best_acc, "history": history,
+            "compile_stats": compile_stats,
             "images_per_sec": ips,
             "images_per_sec_per_chip": timer.images_per_sec_per_chip,
             # Final epoch's rate: steady-state throughput once the epoch

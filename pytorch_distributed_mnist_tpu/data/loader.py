@@ -116,6 +116,40 @@ class MNISTDataLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
+    def batch_spec(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Abstract (shape, dtype) form of one assembled GLOBAL batch —
+        what ``make_global_batch`` yields for one ``__iter__`` item. The
+        AOT precompile path (``train/steps.py precompile``) lowers against
+        this, so it lives HERE next to the code whose output it mirrors:
+        a loader change that altered batch layout would break the spec in
+        the same file."""
+        b = self.global_batch_size
+        return {
+            "image": jax.ShapeDtypeStruct((b,) + self.images.shape[1:],
+                                          self.images.dtype),
+            "label": jax.ShapeDtypeStruct((b,), self.labels.dtype),
+            "mask": jax.ShapeDtypeStruct((b,), np.float32),
+        }
+
+    def epoch_spec(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Abstract form of a whole staged GLOBAL epoch — ``stacked_epoch``
+        assembled by ``make_global_batch(..., leading_replicated=True)``:
+        every ``batch_spec`` leaf gains the leading steps axis."""
+        s = self.steps_per_epoch
+        return {
+            k: jax.ShapeDtypeStruct((s,) + v.shape, v.dtype)
+            for k, v in self.batch_spec().items()
+        }
+
+    def ticks_spec(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Abstract form of a GLOBAL ``epoch_ticks`` index matrix + mask —
+        the device-gather path's per-epoch upload."""
+        shape = (self.steps_per_epoch, self.global_batch_size)
+        return {
+            "idx": jax.ShapeDtypeStruct(shape, np.int32),
+            "mask": jax.ShapeDtypeStruct(shape, np.float32),
+        }
+
     def stacked_epoch(self, epoch: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Whole epoch as {'image': (S, B, ...), 'label': (S, B), 'mask': (S, B)}
         for lax.scan.

@@ -140,7 +140,11 @@ def pallas_adam(
 
     def update(updates, state, params=None):
         del params
-        count = optax.safe_increment(state.count)
+        # optax renamed safe_int32_increment -> safe_increment; accept both
+        # so the kernel runs on either side of the rename.
+        _increment = getattr(optax, "safe_increment", None) \
+            or optax.safe_int32_increment
+        count = _increment(state.count)
         t = count.astype(jnp.float32)
         hypers = jnp.stack([
             jnp.asarray(learning_rate, jnp.float32),

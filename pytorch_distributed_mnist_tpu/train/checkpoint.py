@@ -553,6 +553,12 @@ class AsyncCheckpointer:
 
     def save(self, state, **kwargs) -> None:
         self.wait()
+        # Arm fresh: from here on _result must only ever hold THIS save's
+        # outcome. Without this, a failed write/publish leaves the
+        # PREVIOUS epoch's path in _result, and a later wait() (e.g.
+        # after the caller caught the error) would return that stale path
+        # as if it were the latest save's (round-5 advisor).
+        self._result = None
         named = _leaves_with_names(_state_tree(state))
         layout = kwargs.pop("layout", None)
         if layout not in (None, "npz", "sharded"):

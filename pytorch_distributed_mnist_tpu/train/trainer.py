@@ -29,11 +29,13 @@ The execution model is TPU-first rather than a translation:
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Optional, Tuple
 
+import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_mnist_tpu.data.loader import (
     MNISTDataLoader,
@@ -44,11 +46,13 @@ from pytorch_distributed_mnist_tpu.ops.metrics import Accuracy, Average, MetricS
 from pytorch_distributed_mnist_tpu.parallel.collectives import make_explicit_dp_train_step
 from pytorch_distributed_mnist_tpu.train.state import TrainState
 from pytorch_distributed_mnist_tpu.train.steps import (
+    abstract_spec,
     make_eval_epoch,
     make_eval_step,
     make_train_epoch,
     make_train_epoch_indexed,
     make_train_step,
+    precompile,
 )
 
 
@@ -97,6 +101,7 @@ class Trainer:
         self.test_loader = test_loader
         self.mesh = mesh
         self.mode = mode
+        self._state_sharding = state_sharding
         if mode == "explicit":
             if mesh is None:
                 raise ValueError("mode='explicit' requires a mesh")
@@ -161,6 +166,14 @@ class Trainer:
         self._prefetch = None
         self.prefetch_enabled = True
         self._eval_staged = None
+        # AOT precompile state: program name -> Compiled executable, the
+        # threads (by program name) still building them, and any
+        # per-program failures (surfaced once at that program's join; the
+        # lazy jit path stays the fallback).
+        self._precompiled = {}
+        self._precompile_threads = {}
+        self._precompile_errors = {}
+        self._precompile_started = False
 
     def _start_prefetch(self) -> None:
         """Stage the NEXT epoch's gather while the device runs this one.
@@ -184,6 +197,125 @@ class Trainer:
         t.start()
         self._prefetch = (epoch, t, holder)
 
+    # -- AOT precompile ---------------------------------------------------
+
+    def _precompile_jobs(self):
+        """(program name, jitted fn, abstract args) for every program this
+        trainer's mode will actually run. Batch specs come from the
+        loaders (``data/loader.py batch_spec/epoch_spec/ticks_spec``) so
+        they cannot drift from what staging really produces."""
+        state_spec = abstract_spec(self.state)
+        if self.mode == "scan":
+            jobs = [("eval_epoch", self._eval_epoch,
+                     (state_spec, self.test_loader.epoch_spec()))]
+            if self.epoch_gather == "device":
+                data_spec = abstract_spec({
+                    "image": self.train_loader.images,
+                    "label": self.train_loader.labels,
+                })
+                jobs.insert(0, (
+                    "train_epoch_indexed", self._train_epoch,
+                    (state_spec, data_spec, self.train_loader.ticks_spec()),
+                ))
+            else:
+                jobs.insert(0, ("train_epoch", self._train_epoch,
+                                (state_spec, self.train_loader.epoch_spec())))
+            return jobs
+        suffix = "_explicit" if self.mode == "explicit" else ""
+        return [
+            ("train_step" + suffix, self._train_step,
+             (state_spec, self.train_loader.batch_spec())),
+            ("eval_step" + suffix, self._eval_step,
+             (state_spec, self.test_loader.batch_spec())),
+        ]
+
+    def precompile(self, wait: bool = False) -> None:
+        """AOT-compile this trainer's programs on background threads.
+
+        Each program is ``.lower(...).compile()``-d on abstract shapes
+        (``train/steps.py precompile``), CONCURRENTLY with whatever the
+        caller does next — in ``cli.run`` that is the first epoch's MNIST
+        staging/host-gather, so compile leaves the cold-start critical
+        path instead of serializing at first use. The compiled
+        executables are used directly by ``train()``/``evaluate()`` (no
+        re-lowering, no second compile); any failure or signature
+        mismatch falls back to the lazy jit path, which is
+        trajectory-identical (tests/test_compile_cache.py pins this).
+
+        ``wait=True`` blocks until every program is built — tests and
+        callers with nothing to overlap.
+        """
+        if self._precompile_started:
+            return
+        self._precompile_started = True
+        if self.mesh is not None and self._state_sharding is None \
+                and jax.process_count() == 1:
+            # Commit the state to the replicated layout the programs are
+            # compiled for. Fresh states arrive uncommitted (accepted
+            # either way); a resumed state arrives committed to device 0
+            # (checkpoint restore) and would otherwise fail the compiled
+            # executable's sharding check and recompile lazily. Sharded
+            # layouts (TP/ZeRO/PP) are placed by their constructors.
+            # Single-process only: a host->multi-host-sharding device_put
+            # runs a cross-process value-equality collective (and cannot
+            # run at all on the CPU sim); multi-host states stay as they
+            # arrive, and a sharding mismatch just takes the lazy path.
+            self.state = jax.device_put(
+                self.state, NamedSharding(self.mesh, P()))
+        for name, fn, specs in self._precompile_jobs():
+            def work(name=name, fn=fn, specs=specs):
+                try:
+                    self._precompiled[name] = precompile(
+                        fn, *specs, program=name)
+                except Exception as exc:  # noqa: BLE001 - surfaced at join
+                    self._precompile_errors[name] = exc
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name=f"precompile-{name}")
+            t.start()
+            self._precompile_threads[name] = t
+        if wait:
+            self._join_precompile()
+
+    def _join_precompile(self, name: str = None) -> None:
+        """Join the thread building ``name`` (all threads when None). Only
+        the REQUESTED program blocks the caller: the first train epoch
+        must not wait out the eval program's compile — that would
+        re-serialize part of the compile time the overlap exists to
+        hide; eval's thread keeps compiling during epoch 1 and is joined
+        when evaluate() first needs it."""
+        names = (list(self._precompile_threads) if name is None
+                 else [name] if name in self._precompile_threads else [])
+        for n in names:
+            self._precompile_threads.pop(n).join()
+            exc = self._precompile_errors.pop(n, None)
+            if exc is not None:
+                print(
+                    f"WARNING: precompile of {n} failed; falling back "
+                    f"to lazy compilation: {exc!r}",
+                    file=sys.stderr, flush=True,
+                )
+
+    def _run_program(self, name: str, fn, *args):
+        """Run ``name`` via its precompiled executable when one exists and
+        matches, else via the lazy jit ``fn`` (identical program)."""
+        self._join_precompile(name)
+        compiled = self._precompiled.get(name)
+        if compiled is not None:
+            try:
+                return compiled(*args)
+            except (TypeError, ValueError) as exc:
+                # Shapes/shardings drifted from the precompiled signature
+                # (e.g. a mid-run loader swap): drop the stale executable
+                # once and let jit recompile for the new signature.
+                del self._precompiled[name]
+                print(
+                    f"WARNING: precompiled {name} no longer matches its "
+                    f"arguments; recompiling lazily: {str(exc)[:200]}",
+                    file=sys.stderr, flush=True,
+                )
+        return fn(*args)
+
     def train(self) -> Tuple[Average, Accuracy]:
         """One training epoch; returns (loss meter, accuracy meter).
 
@@ -199,7 +331,8 @@ class Trainer:
             ticks = make_global_batch(
                 {"idx": idx.astype(np.int32), "mask": mask}, self.mesh,
                 leading_replicated=True)
-            self.state, ms = self._train_epoch(
+            self.state, ms = self._run_program(
+                "train_epoch_indexed", self._train_epoch,
                 self.state, self._train_data, ticks)
         elif self.mode == "scan":
             staged = None
@@ -214,14 +347,18 @@ class Trainer:
             batches = make_global_batch(
                 staged, self.mesh, leading_replicated=True
             )
-            self.state, ms = self._train_epoch(self.state, batches)
+            self.state, ms = self._run_program(
+                "train_epoch", self._train_epoch, self.state, batches)
             if self.prefetch_enabled:
                 self._start_prefetch()
         else:
             ms = None
+            name = ("train_step_explicit" if self.mode == "explicit"
+                    else "train_step")
             for batch in self.train_loader:
                 gbatch = make_global_batch(batch, self.mesh)
-                self.state, m = self._train_step(self.state, gbatch)
+                self.state, m = self._run_program(
+                    name, self._train_step, self.state, gbatch)
                 ms = m if ms is None else MetricState(
                     ms.loss_sum + m.loss_sum, ms.correct + m.correct, ms.count + m.count
                 )
@@ -244,12 +381,16 @@ class Trainer:
                     self.test_loader.stacked_epoch(), self.mesh,
                     leading_replicated=True
                 )
-            ms = self._eval_epoch(self.state, self._eval_staged)
+            ms = self._run_program(
+                "eval_epoch", self._eval_epoch, self.state, self._eval_staged)
         else:
             ms = None
+            name = ("eval_step_explicit" if self.mode == "explicit"
+                    else "eval_step")
             for batch in self.test_loader:
                 gbatch = make_global_batch(batch, self.mesh)
-                m = self._eval_step(self.state, gbatch)
+                m = self._run_program(
+                    name, self._eval_step, self.state, gbatch)
                 ms = m if ms is None else MetricState(
                     ms.loss_sum + m.loss_sum, ms.correct + m.correct, ms.count + m.count
                 )

@@ -1,6 +1,12 @@
-"""Utilities: rank-aware logging, profiling, seeding."""
+"""Utilities: rank-aware logging, profiling, compile-cache wiring, seeding."""
 
 from pytorch_distributed_mnist_tpu.utils.logging import log0, get_logger
-from pytorch_distributed_mnist_tpu.utils.profiling import StepTimer, profile_trace
+from pytorch_distributed_mnist_tpu.utils.profiling import (
+    CompileLog,
+    StepTimer,
+    compile_log,
+    profile_trace,
+)
 
-__all__ = ["log0", "get_logger", "StepTimer", "profile_trace"]
+__all__ = ["log0", "get_logger", "StepTimer", "profile_trace",
+           "CompileLog", "compile_log"]

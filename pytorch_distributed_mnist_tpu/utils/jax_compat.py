@@ -1,0 +1,116 @@
+"""Runtime shims for older jax installs (currently 0.4.x).
+
+The framework is written against the modern public surface — ``jax.shard_map``
+with ``check_vma``, a differentiable ``lax.optimization_barrier`` — but the
+pinned environment may ship a jax where those are still
+``jax.experimental.shard_map.shard_map(check_rep=...)`` and a barrier with no
+AD rule (it gained one upstream later; the rule is the identity/linear one,
+matching the barrier's semantics of "same values, no fusion across").
+
+``install()`` is idempotent and a no-op on a jax that already has the
+modern surface; the package ``__init__`` calls it, so every entry point
+(cli, bench, tools, tests) sees one consistent API. Nothing here changes
+numerics: the shim translates names/kwargs and registers the same linear
+AD rule jax itself adopted.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+_installed = False
+
+
+def _shard_map_shim():
+    """``jax.shard_map`` accepting ``check_vma`` on a jax whose shard_map
+    still lives in ``jax.experimental`` under the ``check_rep`` spelling."""
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    @functools.wraps(_legacy)
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:  # partial form: jax.shard_map(mesh=..., ...)(f)
+            return lambda fn: shard_map(fn, **kwargs)
+        return _legacy(f, **kwargs)
+
+    return shard_map
+
+
+def _register_optimization_barrier_ad() -> None:
+    """The identity JVP/transpose jax later added upstream: the barrier is
+    linear (it only pins values against compiler fusion), so tangents and
+    cotangents pass through their own barrier."""
+    from jax._src.interpreters import ad
+    from jax._src.lax import lax as _lax_internal
+
+    prim = _lax_internal.optimization_barrier_p
+    if prim in ad.primitive_jvps:
+        return
+
+    def _jvp(primals, tangents):
+        tangents = [ad.instantiate_zeros(t) for t in tangents]
+        return prim.bind(*primals), prim.bind(*tangents)
+
+    def _transpose(cts, *primals):
+        del primals
+        cts = [ad.instantiate_zeros(ct) for ct in cts]
+        return prim.bind(*cts)
+
+    ad.primitive_jvps[prim] = _jvp
+    ad.primitive_transposes[prim] = _transpose
+
+
+def _axis_size(axis_name):
+    """Static mesh-axis size from inside a shard_map/pmap body — the
+    ``lax.axis_size`` jax later added; on 0.4.x the same integer lives on
+    the trace context's axis env."""
+    from jax._src import core
+
+    return core.axis_frame(axis_name)
+
+
+COLLECTIVE_TIMEOUT_FLAGS = (
+    " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+    " --xla_cpu_collective_timeout_seconds=600"
+)
+_collective_flags_supported = None
+
+
+def supported_collective_timeout_flags() -> str:
+    """``COLLECTIVE_TIMEOUT_FLAGS`` when this jaxlib's XLA knows them,
+    else ``""``. XLA *aborts the process* on an unknown flag at backend
+    init (parse_flags_from_env is fatal), so callers must probe in a
+    throwaway child before appending them to XLA_FLAGS. ~1s, cached for
+    the process. (tests/conftest.py carries its own copy of this probe
+    because it must run before anything imports jax.)"""
+    global _collective_flags_supported
+    if _collective_flags_supported is None:
+        import subprocess
+        import sys
+
+        probe = ("import os; os.environ['XLA_FLAGS'] = %r; "
+                 "from jaxlib import xla_client; xla_client.make_cpu_client()"
+                 % COLLECTIVE_TIMEOUT_FLAGS.strip())
+        try:
+            _collective_flags_supported = subprocess.run(
+                [sys.executable, "-c", probe], capture_output=True,
+                timeout=120,
+            ).returncode == 0
+        except (OSError, subprocess.SubprocessError):
+            _collective_flags_supported = False
+    return COLLECTIVE_TIMEOUT_FLAGS if _collective_flags_supported else ""
+
+
+def install() -> None:
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_shim()
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+    _register_optimization_barrier_ad()

@@ -10,8 +10,9 @@ trace for xprof/tensorboard.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 
@@ -76,6 +77,153 @@ class StepTimer:
     @property
     def steps_per_sec(self) -> float:
         return self.steps / max(self.elapsed, 1e-9)
+
+
+class CompileLog:
+    """Per-program compile observability: wall ms, XLA backend compiles,
+    and persistent-cache hit/miss, attributed to named programs.
+
+    jax reports compile activity through ``jax.monitoring`` events —
+    ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` fire per XLA
+    compile request when the persistent cache is enabled, and the
+    backend-compile duration event fires for every compile (a
+    persistent-cache *hit* still reports a few ms there: that is the
+    executable deserialization, not a compile). Listeners run on the
+    thread doing the compiling, so attribution is thread-local: whatever
+    program name the current thread has open via ``measure(name)`` owns
+    the events — concurrent background precompiles (train/trainer.py)
+    can't misfile each other's counts.
+
+    ``cache_misses`` is the honest "programs actually compiled" counter:
+    the acceptance bar for a warm start is zero misses, not zero
+    backend-duration events.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._listening = False
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs: Dict[str, Dict] = {}
+            self._totals = {"cache_hits": 0, "cache_misses": 0,
+                            "backend_compiles": 0, "backend_compile_ms": 0.0}
+
+    # -- jax.monitoring plumbing ------------------------------------------
+
+    def _ensure_listening(self) -> None:
+        from jax._src import monitoring
+
+        # Under the lock: concurrent FIRST measures (the trainer's
+        # background precompile threads) must not both register, or every
+        # later compile event would be double-counted for the process
+        # lifetime.
+        with self._lock:
+            if self._listening:
+                return
+            monitoring.register_event_listener(self._on_event)
+            monitoring.register_event_duration_secs_listener(
+                self._on_duration)
+            self._listening = True
+
+    def close(self) -> None:
+        """Detach this log from jax.monitoring. The registered listeners
+        hold a strong reference to the instance and fire on every future
+        compile — fine for the module singleton, a leak for throwaway
+        instances (tests), which should close() when done."""
+        from jax._src import monitoring
+
+        with self._lock:
+            if not self._listening:
+                return
+            monitoring._unregister_event_listener_by_callback(self._on_event)
+            monitoring._unregister_event_duration_listener_by_callback(
+                self._on_duration)
+            self._listening = False
+
+    def _current(self) -> Optional[Dict]:
+        return getattr(self._tls, "record", None)
+
+    def _on_event(self, name: str, **kwargs) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            key = "cache_hits"
+        elif name == "/jax/compilation_cache/cache_misses":
+            key = "cache_misses"
+        else:
+            return
+        rec = self._current()
+        with self._lock:
+            self._totals[key] += 1
+            if rec is not None:
+                rec[key] += 1
+
+    def _on_duration(self, name: str, secs: float, **kwargs) -> None:
+        # The event was renamed across jax versions; accept both.
+        if name not in ("/jax/core/compile/backend_compile_duration",
+                        "/jax/core/compile/backend_compile_time_sec"):
+            return
+        rec = self._current()
+        with self._lock:
+            self._totals["backend_compiles"] += 1
+            self._totals["backend_compile_ms"] += secs * 1e3
+            if rec is not None:
+                rec["backend_compiles"] += 1
+                rec["backend_compile_ms"] += secs * 1e3
+
+    # -- public API --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def measure(self, program: str):
+        """Attribute this thread's compile activity to ``program`` while
+        the block runs; the record accumulates across repeat measures of
+        the same name (e.g. precompile then first call)."""
+        self._ensure_listening()
+        with self._lock:
+            rec = self._programs.setdefault(program, {
+                "wall_ms": 0.0, "backend_compiles": 0,
+                "backend_compile_ms": 0.0, "cache_hits": 0,
+                "cache_misses": 0,
+            })
+        prev = self._current()
+        self._tls.record = rec
+        t0 = time.perf_counter()
+        try:
+            yield rec
+        finally:
+            dt = (time.perf_counter() - t0) * 1e3
+            self._tls.record = prev
+            with self._lock:
+                rec["wall_ms"] += dt
+
+    def stats(self) -> Dict:
+        """``{"programs": {name: record}, "totals": {...}}`` snapshot.
+
+        Each program record carries ``persistent_cache_hit``: True when
+        every XLA compile request inside its measures was served from the
+        persistent cache, False when any real compile happened, None when
+        the persistent cache was disabled (no hit/miss events at all)."""
+        with self._lock:
+            programs = {}
+            for name, rec in self._programs.items():
+                rec = dict(rec)
+                rec["wall_ms"] = round(rec["wall_ms"], 1)
+                rec["backend_compile_ms"] = round(rec["backend_compile_ms"], 1)
+                if rec["cache_hits"] or rec["cache_misses"]:
+                    rec["persistent_cache_hit"] = rec["cache_misses"] == 0
+                else:
+                    rec["persistent_cache_hit"] = None
+                programs[name] = rec
+            totals = dict(self._totals)
+        totals["backend_compile_ms"] = round(totals["backend_compile_ms"], 1)
+        return {"programs": programs, "totals": totals}
+
+
+# Process-wide singleton: entry points (cli/bench/tools) and the trainer's
+# background precompile all feed one log, so a run's compile story lands in
+# one place. Tests reset() it between cases.
+compile_log = CompileLog()
 
 
 @contextlib.contextmanager

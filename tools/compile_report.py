@@ -1,0 +1,119 @@
+"""Print the per-program compile_stats from bench/northstar artifacts.
+
+The compile-latency subsystem (utils/compile_cache.py + the trainer's AOT
+precompile) records, for every program, its compile wall ms, how many real
+XLA backend compiles ran, and whether the persistent cache served it. That
+lands in:
+
+- ``bench.py`` output lines (``compile_stats`` block) -> ``BENCH_r*.json``
+  and the watcher's ``tools/captured/bench.json``;
+- ``tools/northstar.py`` output (``compile_stats`` + ``compile_cache``);
+- any JSON file a caller passes explicitly.
+
+This tool renders those blocks as a cold-vs-warm table so the watcher
+scripts can capture a human-readable compile report the moment the chip
+window opens (ISSUE satellite), and so round-over-round BENCH artifacts
+can be compared at a glance.
+
+Usage:
+  python tools/compile_report.py            # newest BENCH_r*.json + capture
+  python tools/compile_report.py FILE...    # specific artifact file(s)
+
+Exit status: 0 if at least one compile_stats block was found, else 1.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lines(path: str):
+    """Every JSON object found in ``path`` (one per line; tolerant of
+    non-JSON lines and trailing garbage — artifacts are append-style)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict):
+                    out.append(obj)
+    except OSError:
+        return []
+    return out
+
+
+def _find_stats(obj: dict):
+    """The compile_stats block of an artifact line, wherever it lives
+    (top level for bench/northstar; nested under ``captured`` for a
+    watcher pass-through)."""
+    for holder in (obj, obj.get("captured") or {}):
+        stats = holder.get("compile_stats")
+        if isinstance(stats, dict) and isinstance(
+                stats.get("programs"), dict):
+            return stats
+    return None
+
+
+def default_artifacts():
+    benches = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    paths = benches[-1:] if benches else []
+    captured = os.path.join(REPO, "tools", "captured", "bench.json")
+    if os.path.exists(captured):
+        paths.append(captured)
+    return paths
+
+
+def report(paths) -> int:
+    found = 0
+    for path in paths:
+        for obj in _load_lines(path):
+            stats = _find_stats(obj)
+            if stats is None:
+                continue
+            found += 1
+            label = obj.get("metric") or obj.get("target_acc") or "run"
+            backend = obj.get("backend", "?")
+            when = obj.get("measured_at") or obj.get("capture_timestamp", "")
+            print(f"\n{os.path.relpath(path, REPO)} — {label} "
+                  f"[{backend}] {when}")
+            print(f"  {'program':<24} {'compile ms':>10} {'XLA':>4} "
+                  f"{'cache':>6}")
+            for name, rec in sorted(stats["programs"].items()):
+                hit = rec.get("persistent_cache_hit")
+                cache = ("off" if hit is None else
+                         "hit" if hit else "miss")
+                print(f"  {name:<24} {rec.get('wall_ms', 0):>10.0f} "
+                      f"{rec.get('backend_compiles', 0):>4} {cache:>6}")
+            totals = stats.get("totals", {})
+            print(f"  totals: {totals.get('backend_compiles', 0)} XLA "
+                  f"compile(s), {totals.get('backend_compile_ms', 0):.0f} ms "
+                  f"backend, {totals.get('cache_hits', 0)} hit / "
+                  f"{totals.get('cache_misses', 0)} miss")
+    if not found:
+        print("no compile_stats blocks found (artifacts predate the "
+              "compile-latency subsystem, or the runs never compiled)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    paths = list(sys.argv[1:] if argv is None else argv)
+    if not paths:
+        paths = default_artifacts()
+    return report(paths)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
