@@ -63,7 +63,13 @@ from pytorch_distributed_mnist_tpu.parallel.mesh import (
     data_replica_coords,
     make_mesh,
 )
-from pytorch_distributed_mnist_tpu.train.checkpoint import save_checkpoint, try_resume
+from pytorch_distributed_mnist_tpu.runtime import supervision
+from pytorch_distributed_mnist_tpu.train.checkpoint import (
+    is_corrupt_checkpoint_error,
+    quarantine_checkpoint,
+    save_checkpoint,
+    try_resume,
+)
 from pytorch_distributed_mnist_tpu.train.lr_schedule import step_decay_schedule
 from pytorch_distributed_mnist_tpu.train.state import create_train_state
 from pytorch_distributed_mnist_tpu.train.trainer import Trainer
@@ -72,6 +78,7 @@ from pytorch_distributed_mnist_tpu.utils.logging import log0
 from pytorch_distributed_mnist_tpu.utils.profiling import (
     StepTimer,
     compile_log,
+    failure_events,
     phase,
     profile_trace,
 )
@@ -266,6 +273,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "the epoch's; a sharded directory is published at "
                         "the next epoch's save via a main-thread barrier, "
                         "Orbax-style deferred commit)")
+    p.add_argument("--agreement-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="watchdog deadline for every multi-host agreement "
+                        "collective (checkpoint prepare/write/publish "
+                        "agreements, resume broadcast/agreement, dataset "
+                        "agreement): a peer that dies outside an agreed "
+                        "phase no longer strands this host forever — the "
+                        "watchdog dumps a per-host phase report and exits "
+                        "with PeerFailure naming the silent host(s). "
+                        "Default: the TPUMNIST_AGREEMENT_TIMEOUT env var, "
+                        "else 0 = disabled (the safe default on real "
+                        "multi-host TPU, where a conservatively-sized "
+                        "deadline is a new way to shoot a healthy-but-"
+                        "slow job); the test harness and the chaos twins "
+                        "(tools/chaos.py) turn it on")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace here")
     p.add_argument("--compile-cache", type=str, default=None, metavar="DIR",
@@ -324,6 +346,8 @@ def _moe_num_experts() -> int:
 
 
 def _build_loaders(args, seed: int, mesh):
+    supervision.set_phase("data_stage")
+    supervision.maybe_fault("data_stage")
     name = "mnist" if args.dataset == "synthetic" else args.dataset
     synthesize = args.dataset == "synthetic"
     # Default False for programmatic callers that build args by hand.
@@ -355,9 +379,9 @@ def _build_loaders(args, seed: int, mesh):
         # is on actual LOAD SUCCESS, not a dataset_present() check — a
         # presence probe leaves a window between check and read in which
         # one host's files can vanish (round-5 review), and on success the
-        # loaded arrays are kept, so nothing is read twice.
-        from jax.experimental import multihost_utils
-
+        # loaded arrays are kept, so nothing is read twice. The agreement
+        # rides the supervision record channel, so it is watchdogged and
+        # a peer's poison pill from another phase parses cleanly here.
         import zlib
 
         def _try_load(train: bool):
@@ -385,25 +409,28 @@ def _build_loaders(args, seed: int, mesh):
 
         loaded = (_try_load(train=True), _try_load(train=False))
         ok = all(split is not None for split in loaded)
-        everyone = multihost_utils.process_allgather(
-            np.asarray([ok], dtype=np.bool_)
-        )
-        if bool(np.all(everyone)):
+        records = supervision.allgather_records(
+            "dataset_load", ok, "" if ok else f"{name} load failed")
+        supervision.raise_if_poisoned(records, "the dataset agreement")
+        n_ok = sum(1 for rec in records if rec.ok)
+        if n_ok == len(records):
             preloaded = loaded
         else:
             if not allow_synthetic:
                 hint = ("the download may have failed (see any warning "
                         "above)" if args.download else
                         "pre-download on every host, or pass --download")
-                raise SystemExit(
+                exc = SystemExit(
                     f"{name!r} is not present on every host "
-                    f"({int(np.sum(everyone))}/{everyone.size} loaded it) "
+                    f"({n_ok}/{len(records)} loaded it) "
                     f"— {hint}, or pass --allow-synthetic to train on "
                     f"labelled fake data, or --dataset synthetic."
                 )
+                supervision.mark_agreed(exc)  # symmetric exit, agreed vote
+                raise exc
             log0(
                 f"WARNING: {name!r} is not present on every host "
-                f"({int(np.sum(everyone))}/{everyone.size} loaded it); "
+                f"({n_ok}/{len(records)} loaded it); "
                 "all hosts will use the synthetic fallback so training "
                 "data stays consistent across the job"
             )
@@ -468,13 +495,223 @@ def _build_loaders(args, seed: int, mesh):
     return train_loader, test_loader, used_synthetic
 
 
+def _resolve_resume_auto(args) -> str:
+    """Resolve ``--resume auto`` to one agreed checkpoint path ('' = none).
+
+    Every host must resume from the SAME checkpoint: a stale NFS
+    attribute cache can show different listings to different hosts, and
+    hosts resuming at different epochs run different numbers of
+    collective programs — a silent hang, not an error. ONLY process 0
+    resolves (its resolution wins anyway, and a local resolution failure
+    on another host must not kill that host before the collective —
+    peers would block in it forever); its record carries an ok/error
+    status so a process-0 failure exits every host identically instead
+    of process 0 raising alone.
+
+    The exchange rides the supervision record channel (one fixed-width
+    allgather, process 0's record is the resolution — a broadcast in
+    allgather clothing): it is watchdogged like every agreement, and a
+    peer that died on a host-local error pairs its poison pill with THIS
+    collective and is attributed correctly instead of hanging the job.
+    """
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        latest_checkpoint,
+    )
+
+    if process_count() <= 1:
+        return latest_checkpoint(args.checkpoint_dir) or ""
+    detail = ""
+    err: Optional[str] = None
+    if process_index() == 0:
+        try:
+            resolved = latest_checkpoint(args.checkpoint_dir) or ""
+            encoded = resolved.encode()
+            if len(encoded) > supervision.DETAIL_BYTES:
+                raise ValueError(
+                    f"checkpoint path is {len(encoded)} bytes, over the "
+                    f"{supervision.DETAIL_BYTES}-byte resume-resolution "
+                    "record budget; use a shorter --checkpoint-dir"
+                )
+            detail = resolved
+        except Exception as exc:  # noqa: BLE001 - agreed below
+            err = repr(exc)
+    records = supervision.allgather_records(
+        "resume_resolve", err is None, detail if err is None else err)
+    supervision.raise_if_poisoned(records, "resume resolution")
+    leader = records[0]
+    if not leader.ok:
+        exc = SystemExit(
+            "--resume auto: resolution failed on process 0: "
+            + leader.detail
+        )
+        # Every host leaves this agreement raising this same exit; mark
+        # it so nobody sends a poison pill no peer would pair with.
+        supervision.mark_agreed(exc)
+        raise exc
+    return leader.detail
+
+
+def _resume_supervised(args, state):
+    """Resolve + load the resume checkpoint under the agreement protocol.
+
+    Returns ``(state, start_epoch, best_acc, resume_path)``. Semantics:
+
+    - Agree the per-host load OUTCOME, not just the path: a stale NFS
+      attribute cache can hide the agreed checkpoint from one host —
+      ``try_resume`` would then silently train fresh at epoch 0 while
+      its peers resume at N, so hosts run different numbers of
+      collective programs (a silent hang). All hosts proceed at the same
+      epoch, or all exit loudly with the same error.
+    - Corrupt-checkpoint resilience (``--resume auto`` only): when the
+      resolved latest checkpoint is damaged — truncated write the crash
+      left behind, torn download — on EVERY host, it is quarantined
+      (renamed ``*.corrupt``, invisible to resolution) and resolution
+      falls back to the next-older epoch through the same agreement
+      path, instead of aborting a run that has perfectly good older
+      checkpoints. A load failure that is NOT corruption (model/shape
+      mismatch), or one that differs across hosts, still aborts loudly:
+      quarantining a good checkpoint because one host's NFS view is
+      stale would destroy training history.
+    """
+    supervision.set_phase("resume")
+    supervision.maybe_fault("resume")
+    auto = args.resume == "auto"
+    multi = process_count() > 1
+    while True:
+        if auto:
+            resume_path = _resolve_resume_auto(args)
+            if not resume_path:
+                log0(f"=> --resume auto: no checkpoint in "
+                     f"'{args.checkpoint_dir}' yet, training fresh")
+                return state, 0, 0.0, ""
+        else:
+            resume_path = args.resume
+        if not (multi and resume_path):
+            try:
+                new_state, start_epoch, best_acc = try_resume(
+                    resume_path, state)
+            except Exception as exc:
+                if auto and is_corrupt_checkpoint_error(exc):
+                    dest = quarantine_checkpoint(resume_path)
+                    failure_events.record(
+                        "checkpoint_quarantined",
+                        f"{resume_path} -> {dest}: {exc!r}")
+                    log0(f"=> quarantined corrupt checkpoint "
+                         f"{resume_path!r} -> {dest!r} ({exc!r}); "
+                         f"falling back to the next-older epoch")
+                    continue
+                raise
+            return new_state, start_epoch, best_acc, resume_path
+
+        resume_err: Optional[BaseException] = None
+        corrupt = False
+        new_state = state
+        start_epoch, best_acc = 0, 0.0
+        try:
+            new_state, start_epoch, best_acc = try_resume(
+                resume_path, state)
+            outcome = str(start_epoch)
+        except Exception as exc:  # noqa: BLE001 - agreed below
+            print(
+                f"process {process_index()}: resume from "
+                f"{resume_path!r} failed: {exc!r}",
+                file=sys.stderr, flush=True,
+            )
+            resume_err = exc
+            corrupt = is_corrupt_checkpoint_error(exc)
+            outcome = ("corrupt:" if corrupt else "error:") + repr(exc)
+        records = supervision.allgather_records(
+            "resume_load", resume_err is None, outcome)
+        if resume_err is not None:
+            supervision.mark_agreed(resume_err)  # delivered just above
+        supervision.raise_if_poisoned(records, "the resume agreement")
+        epochs = [int(rec.detail) if rec.ok else -1 for rec in records]
+        if all(e == epochs[0] for e in epochs):
+            if resume_err is None:
+                return new_state, start_epoch, best_acc, resume_path
+            all_corrupt = all(
+                rec.detail.startswith("corrupt:")
+                for rec in records if not rec.ok
+            )
+            if all_corrupt and auto:
+                # Same damaged file everywhere (a torn write on the
+                # shared filesystem): process 0 quarantines it, the
+                # outcome is agreed (a rename failure aborts every host
+                # together), and resolution re-runs on what's left.
+                qerr: Optional[BaseException] = None
+                dest = ""
+                if process_index() == 0:
+                    try:
+                        dest = quarantine_checkpoint(resume_path)
+                    except Exception as exc:  # noqa: BLE001
+                        qerr = exc
+                failed = supervision.agree("resume_quarantine", qerr)
+                if failed and qerr is None:
+                    raise supervision.PeerFailure(
+                        supervision.peer_failure_message(
+                            failed,
+                            f"quarantine of corrupt checkpoint "
+                            f"{resume_path!r} failed on host(s) "
+                            f"{[h for h, _, _ in failed]};",
+                        ),
+                        hosts=[h for h, _, _ in failed],
+                        phase="resume_quarantine",
+                        reason=failed[0][2],
+                    )
+                if qerr is not None:
+                    raise qerr
+                failure_events.record(
+                    "checkpoint_quarantined",
+                    f"{resume_path} -> {dest or '(renamed on process 0)'}"
+                    f": {resume_err!r}")
+                log0(f"=> quarantined corrupt checkpoint "
+                     f"{resume_path!r} ({resume_err!r}); falling back "
+                     f"to the next-older epoch")
+                continue
+            raise resume_err  # identical on every host (agreed above)
+        exc = SystemExit(
+            f"resume outcome diverged across hosts for "
+            f"{resume_path!r}: start epochs {epochs} "
+            f"(-1 = load failed). A host resuming at a different "
+            f"epoch runs different collective programs — a silent "
+            f"hang, not an error. Check that --checkpoint-dir is a "
+            f"filesystem shared by all hosts and the checkpoint is "
+            f"intact on every host."
+        )
+        supervision.mark_agreed(exc)  # symmetric exit on every host
+        raise exc
+
+
 def run(args, epoch_callback=None) -> dict:
     """Per-process SPMD lifecycle; returns a summary dict for tests/benchmarks.
 
     ``epoch_callback(epoch, history_row) -> bool`` (optional) fires after
     each epoch's train+eval+checkpoint; returning True stops the loop early
     (tools/northstar.py uses this to stop at the target accuracy).
+
+    The whole body runs under the agreed-exit protocol
+    (``runtime/supervision.py``): ANY host-local failure — data staging,
+    step execution, checkpoint collect/write, eval — delivers a
+    poison-pill record to the next agreement collective before this host
+    unwinds, so peers exit with ``PeerFailure(host, phase, reason)``
+    instead of blocking forever in a timeout-less collective.
     """
+    try:
+        return _run_body(args, epoch_callback)
+    except BaseException as exc:
+        # deliver_poison is a no-op for single-process runs, for
+        # KeyboardInterrupt, for already-agreed failures (PeerFailure /
+        # watchdog aborts), and when the saver's __exit__ already sent
+        # the pill for this exception (idempotent per exception).
+        # escalate_exit arms a hard-exit timer ONLY for peer-failure
+        # deaths, whose interpreter teardown would otherwise hang in the
+        # distributed shutdown barrier the dead peers can never join.
+        supervision.deliver_poison(exc)
+        supervision.escalate_exit(exc)
+        raise
+
+
+def _run_body(args, epoch_callback=None) -> dict:
     # An explicit JAX_PLATFORMS=cpu request (spawned children, smoke tests)
     # must win even when an accelerator plugin force-writes jax_platforms at
     # import time; tests/conftest.py and tools/northstar.py apply the same
@@ -504,9 +741,31 @@ def run(args, epoch_callback=None) -> dict:
     # TPUMNIST_COMPILE_CACHE env > harness-pinned ambient config >
     # <repo>/.xla_cache default; flag/env "" disables. Re-entrant-safe:
     # a previous run()'s dir never leaks into a run that asked otherwise.
-    cache_dir = compile_cache.configure(getattr(args, "compile_cache", None))
+    if process_count() > 1 and jax.devices()[0].platform == "cpu":
+        # Persistent-cache reads are FATAL in a multi-process CPU (gloo
+        # collectives) world on this jaxlib: deserializing a cached
+        # executable — including multihost_utils' own allgather program —
+        # aborts the process (SIGSEGV/SIGABRT, reproduced in the chaos
+        # twins; sibling hazard to the in-process read-after-write heap
+        # corruption in docs/DESIGN.md). The local pod simulation
+        # therefore runs uncached; real TPU pods keep the cache.
+        cache_dir = compile_cache.configure("")
+        log0("compile cache: disabled (multi-process CPU backend — "
+             "cached-executable reads abort on this jaxlib)")
+    else:
+        cache_dir = compile_cache.configure(
+            getattr(args, "compile_cache", None))
     if cache_dir:
         log0(f"compile cache: {cache_dir}")
+    # Run supervision: agreement watchdogs (--agreement-timeout flag >
+    # TPUMNIST_AGREEMENT_TIMEOUT env > 0 = off), fault-plan parse
+    # (TPUMNIST_FAULT, the chaos harness), and a fresh failure-event log.
+    # Re-entrant-safe for the same reason as the cache wiring above.
+    agreement_timeout = supervision.configure(
+        getattr(args, "agreement_timeout", None))
+    failure_events.reset()
+    if agreement_timeout:
+        log0(f"agreement watchdog: {agreement_timeout:g}s deadline")
     log0(args)  # startup args print parity (:337)
     seed = args.seed if args.seed is not None else 0
     if args.seed is not None:
@@ -945,102 +1204,10 @@ def run(args, epoch_callback=None) -> dict:
         )
         if init_model is not None:
             state = state.replace(apply_fn=model.apply)
-    resume_path = args.resume
-    if resume_path == "auto":
-        from pytorch_distributed_mnist_tpu.train.checkpoint import (
-            latest_checkpoint,
-        )
-
-        if process_count() > 1:
-            # Every host must resume from the SAME checkpoint: a stale NFS
-            # attribute cache can hide the newest file from some hosts,
-            # and hosts resuming at different epochs run different numbers
-            # of collective programs — a silent hang, not an error.
-            # ONLY process 0 resolves (its resolution wins anyway, and a
-            # local resolution failure on another host must not kill that
-            # host before the broadcast — peers would block in it
-            # forever); the broadcast payload carries an ok/error marker
-            # byte so a process-0 failure exits every host identically
-            # instead of process 0 raising alone (round-5 audit; this
-            # also covers the >4095-byte-path case, which previously
-            # raised asymmetrically before the collective).
-            from jax.experimental import multihost_utils
-
-            # Marker bytes are non-NUL on purpose: the padding strip below
-            # is rstrip(b'\0'), and a NUL success marker in front of an
-            # EMPTY resolved path would be stripped with it, leaving the
-            # decode relying on b''[:1]/b''[1:] happening to work
-            # (round-5 advisor). 'K' (ok) / 'E' (error) always survive.
-            payload_bytes = b""
-            if process_index() == 0:
-                try:
-                    resolved = latest_checkpoint(args.checkpoint_dir) or ""
-                    encoded = resolved.encode()
-                    if len(encoded) > 4095:
-                        raise ValueError(
-                            f"checkpoint path is {len(encoded)} bytes, "
-                            "over the 4095-byte multi-host broadcast "
-                            "buffer; use a shorter --checkpoint-dir"
-                        )
-                    payload_bytes = b"K" + encoded
-                except Exception as exc:  # noqa: BLE001 - broadcast it
-                    payload_bytes = b"E" + repr(exc).encode()[:4000]
-            payload = np.frombuffer(
-                payload_bytes.ljust(4096, b"\0"), dtype=np.uint8
-            )
-            agreed = multihost_utils.broadcast_one_to_all(payload)
-            data = bytes(agreed).rstrip(b"\0")
-            if data[:1] == b"E":
-                raise SystemExit(
-                    "--resume auto: resolution failed on process 0: "
-                    + data[1:].decode(errors="replace")
-                )
-            resume_path = data[1:].decode()
-        else:
-            resume_path = latest_checkpoint(args.checkpoint_dir) or ""
-        if not resume_path:
-            log0(f"=> --resume auto: no checkpoint in "
-                 f"'{args.checkpoint_dir}' yet, training fresh")
-    if process_count() > 1 and resume_path:
-        # Agree the per-host resume OUTCOME, not just the path: a stale
-        # NFS attribute cache can hide the agreed checkpoint from one
-        # host — try_resume would then silently train fresh at epoch 0
-        # while its peers resume at N, so hosts run different numbers of
-        # collective programs (a silent hang, the exact threat the path
-        # broadcast above closes for resolution). A local load failure
-        # likewise must not kill one host before the next collective.
-        # All hosts proceed at the same epoch, or all exit loudly.
-        from jax.experimental import multihost_utils
-
-        resume_err: Optional[BaseException] = None
-        try:
-            state, start_epoch, best_acc = try_resume(resume_path, state)
-            local_outcome = start_epoch
-        except Exception as exc:  # noqa: BLE001 - agreed below
-            print(
-                f"process {process_index()}: resume from "
-                f"{resume_path!r} failed: {exc!r}",
-                file=sys.stderr, flush=True,
-            )
-            resume_err = exc
-            local_outcome = -1
-        everyone = multihost_utils.process_allgather(
-            np.asarray([local_outcome], dtype=np.int64)
-        ).reshape(-1)
-        if not bool(np.all(everyone == everyone[0])):
-            raise SystemExit(
-                f"resume outcome diverged across hosts for "
-                f"{resume_path!r}: start epochs {everyone.tolist()} "
-                f"(-1 = load failed). A host resuming at a different "
-                f"epoch runs different collective programs — a silent "
-                f"hang, not an error. Check that --checkpoint-dir is a "
-                f"filesystem shared by all hosts and the checkpoint is "
-                f"intact on every host."
-            )
-        if resume_err is not None:
-            raise resume_err  # identical on every host (agreed above)
-    else:
-        state, start_epoch, best_acc = try_resume(resume_path, state)
+    # Resume: resolution, outcome agreement, and corrupt-checkpoint
+    # quarantine all live in _resume_supervised (the agreed-exit wiring).
+    state, start_epoch, best_acc, resume_path = _resume_supervised(
+        args, state)
     resumed = resume_path and start_epoch > 0
     if not resumed:
         # Reference precedence (:204): a resumed checkpoint's epoch wins over
@@ -1126,11 +1293,13 @@ def run(args, epoch_callback=None) -> dict:
 
     if args.evaluate:
         # Short-circuit parity (:225-228).
+        supervision.set_phase("eval")
         test_loss, test_acc = trainer.evaluate()
         log0(f"Test Loss: {test_loss}, Test Acc: {test_acc}")
         return {"test_loss": test_loss.average, "test_acc": test_acc.accuracy,
                 "best_acc": best_acc, "start_epoch": start_epoch,
-                "epochs_run": 0}
+                "epochs_run": 0,
+                "failure_events": failure_events.snapshot()}
 
     timer = StepTimer()
     history = []
@@ -1170,9 +1339,11 @@ def run(args, epoch_callback=None) -> dict:
             # host values before returning, so the measured span covers all
             # device work for the epoch and nothing else (not eval, not the
             # checkpoint write).
+            supervision.set_phase(f"train@{epoch}")
             with timer.measure(len(train_loader) * args.batch_size), \
                     phase("train", epoch=epoch):
                 train_loss, train_acc = trainer.train()
+            supervision.set_phase(f"eval@{epoch}")
             with phase("eval", epoch=epoch):
                 test_loss, test_acc = trainer.evaluate()
             # Synthetic data is stamped on EVERY epoch line (not just the
@@ -1186,6 +1357,7 @@ def run(args, epoch_callback=None) -> dict:
                  f"{synth_tag}")
             is_best = test_acc.accuracy > best_acc  # (:245-246)
             best_acc = max(test_acc.accuracy, best_acc)
+            supervision.set_phase(f"checkpoint@{epoch}")
             ckpt_kwargs = dict(
                 epoch=epoch, best_acc=best_acc, is_best=is_best,
                 directory=args.checkpoint_dir,
@@ -1217,6 +1389,7 @@ def run(args, epoch_callback=None) -> dict:
                     }) + "\n")
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break
+    supervision.set_phase("shutdown")
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
          f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")
@@ -1227,8 +1400,15 @@ def run(args, epoch_callback=None) -> dict:
                  else "cache hit" if hit else "cache miss")
         log0(f"compile[{prog}]: {rec['wall_ms']:.0f} ms "
              f"({rec['backend_compiles']} XLA compile(s), {cache})")
+    events = failure_events.snapshot()
+    for ev in events:
+        # Retries/quarantines the run survived still belong in the log —
+        # a checkpoint that needed three publish attempts is a disk
+        # about to fail, visible only if someone can see the near-miss.
+        log0(f"supervision[{ev['kind']}]: {ev['detail']}")
     return {"best_acc": best_acc, "history": history,
             "compile_stats": compile_stats,
+            "failure_events": events,
             "images_per_sec": ips,
             "images_per_sec_per_chip": timer.images_per_sec_per_chip,
             # Final epoch's rate: steady-state throughput once the epoch

@@ -84,7 +84,16 @@ def _looks_like_idx_gz(path: str) -> bool:
     return len(head) == 4 and head[0] == 0 and head[1] == 0 and head[2] == 8
 
 
+class _PermanentFetchError(Exception):
+    """A per-URL failure retrying cannot fix (HTTP 4xx: the mirror is up
+    and definitively does not serve this file) — fail over to the next
+    mirror immediately instead of burning backoff attempts."""
+
+
 def _fetch(url: str, dest: str, timeout: float) -> None:
+    from pytorch_distributed_mnist_tpu.runtime.supervision import maybe_fault
+
+    maybe_fault("download_fetch")
     # pid-unique tmp: concurrent downloaders (multiple hosts sharing a
     # filesystem) each publish atomically instead of interleaving writes.
     tmp = f"{dest}.tmp{os.getpid()}"
@@ -99,6 +108,55 @@ def _fetch(url: str, dest: str, timeout: float) -> None:
     finally:
         if os.path.exists(tmp):  # mid-stream failure: no orphan partials
             os.remove(tmp)
+
+
+def _fetch_verified(url: str, dest: str, timeout: float,
+                    want_md5: Optional[str], attempts: int = 3) -> None:
+    """Fetch ``url`` and verify it, retrying with exponential backoff.
+
+    One mirror used to get exactly one shot: a transient reset (or a
+    proxy serving one truncated body) failed the file over to the next
+    mirror — or, for the single-mirror datasets, failed the download
+    outright. Each attempt now re-verifies the published file (pinned
+    md5, else the gunzip+IDX-magic sanity gate — a truncated-but-
+    well-formed gzip prefix passes a naive existence check but not this)
+    and a verification failure deletes the file and retries like any
+    network error, with backoff + jitter so multiple hosts hammering a
+    shared mirror de-synchronize. Raises the last error when ``attempts``
+    are exhausted; the caller's mirror loop then moves on.
+    """
+    from pytorch_distributed_mnist_tpu.utils.profiling import failure_events
+    from pytorch_distributed_mnist_tpu.utils.watchdog import (
+        retry_with_backoff,
+    )
+
+    def attempt() -> None:
+        try:
+            _fetch(url, dest, timeout)
+        except urllib.error.HTTPError as exc:
+            if exc.code < 500:
+                # Deterministic refusal (404 on a dead mirror layout,
+                # 403): identical on every retry — move on now.
+                raise _PermanentFetchError(f"{exc}") from exc
+            raise
+        if want_md5:
+            got = _md5(dest)
+            if got != want_md5:
+                os.remove(dest)
+                raise ValueError(
+                    f"checksum mismatch (got {got}, want {want_md5})")
+        elif not _looks_like_idx_gz(dest):
+            os.remove(dest)
+            raise ValueError("not a gzipped IDX file")
+
+    retry_with_backoff(
+        attempt, attempts=attempts,
+        retry_on=(urllib.error.URLError, OSError, ValueError),
+        on_retry=lambda n, exc, delay: failure_events.record(
+            "download_retry",
+            f"{url} attempt {n} failed ({exc!r}); retrying in "
+            f"{delay:.2f}s"),
+    )
 
 
 def dataset_present(directory: str, files: Iterable[str] = _GZ_FILES) -> bool:
@@ -120,6 +178,7 @@ def download_dataset(
     checksums: Optional[Dict[str, str]] = None,
     timeout: float = 60.0,
     process_index: int = 0,
+    attempts: int = 3,
 ) -> str:
     """Fetch ``name``'s four IDX .gz files into ``root/<name>/``.
 
@@ -129,8 +188,9 @@ def download_dataset(
     filesystem, the multi-host analog of the reference's world-size-1
     pre-download run (``README.md:42-48``).
 
-    Raises ``OSError`` when no mirror can serve a file, ``ValueError`` when
-    a fetched file fails verification.
+    Each mirror gets ``attempts`` tries with exponential backoff + jitter,
+    and every attempt re-verifies what landed (``_fetch_verified``).
+    Raises ``OSError`` when no mirror can serve a file after all retries.
     """
     directory = os.path.join(root, name)
     if process_index != 0:
@@ -154,17 +214,10 @@ def download_dataset(
         for mirror in mirrors:
             url = mirror.rstrip("/") + "/" + filename
             try:
-                _fetch(url, dest, timeout)
-            except (urllib.error.URLError, OSError, ValueError) as exc:
+                _fetch_verified(url, dest, timeout, want, attempts=attempts)
+            except (urllib.error.URLError, OSError, ValueError,
+                    _PermanentFetchError) as exc:
                 errors.append(f"{url}: {exc}")
-                continue
-            if want and _md5(dest) != want:
-                os.remove(dest)
-                errors.append(f"{url}: checksum mismatch")
-                continue
-            if not want and not _looks_like_idx_gz(dest):
-                os.remove(dest)
-                errors.append(f"{url}: not a gzipped IDX file")
                 continue
             break
         else:

@@ -1,0 +1,1 @@
+"""Run-time supervision layer: agreed exits, watchdogs, fault injection."""

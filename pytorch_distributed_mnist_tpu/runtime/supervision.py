@@ -1,0 +1,625 @@
+"""Run supervision: the agreed-exit protocol, collective watchdogs, and
+fault-injection hooks.
+
+The framework's failure model (docs/DESIGN.md "Failure model") rests on
+one invariant: **no host may fail alone on a path its peers continue past
+into a collective** — multi-host collectives have no timeout, so a lone
+local error strands every peer forever. Before this module, the invariant
+was enforced piecewise: ``_agree_phase_ok`` in train/checkpoint.py, plus
+the same shape inlined twice in cli.py. This module is the one wiring all
+three pieces now share:
+
+- **Agreement records** (``allgather_records`` / ``agree``): every
+  host-side exchange — checkpoint phase agreements, the dataset vote,
+  resume resolution (the old one-to-all broadcast retired into this
+  channel) and the resume-load agreement — is one fixed-width record per
+  host: a ``K``/``E``/``P`` status byte + the host's current phase + a
+  detail string, instead of a bare ok bool. Because every exchange is
+  the SAME program shape, a failing host's poison-pill record meets
+  whatever agreement its peers reach next and still parses: peers learn
+  *who* failed, *where*, and *why*, and raise ``PeerFailure`` naming all
+  three.
+- **Agreed exit** (``deliver_poison``): ``cli.run`` routes every
+  host-local failure (data staging, step execution, checkpoint phases,
+  eval) through one except-path that participates in the next agreement
+  collective with a ``P`` record before unwinding — converting "peers
+  hang at the next drain" (the ADVICE.md residual hazard) into "peers
+  exit with ``PeerFailure(host, phase, reason)``".
+- **Watchdogs** (``utils/watchdog.py``): every agreement collective
+  gets a configurable deadline
+  (``--agreement-timeout`` / ``TPUMNIST_AGREEMENT_TIMEOUT``; 0 = off,
+  the default on real multi-host TPU where a slow-but-healthy job must
+  not be shot). On expiry the supervisor dumps a per-host phase report —
+  which phase this host is blocked in, for how long, and each peer's
+  last-heartbeat (the phase it reported at the last completed agreement)
+  — then aborts with ``PeerFailure`` attributing the silent peers.
+- **Fault injection** (``FaultPlan`` / ``maybe_fault``): named fault
+  points throughout the stack honor ``TPUMNIST_FAULT=point:host:kind``
+  so the chaos harness (tools/chaos.py, tests/test_chaos.py) can kill,
+  raise in, or stall a chosen process at a chosen point and prove the
+  protocol end to end with real subprocess twins.
+
+What the protocol can and cannot promise: a poison pill unwinds peers
+cleanly when their next *cross-host host-side operation* is an agreement
+collective (every checkpoint phase, the resume agreements, the dataset
+agreement). A peer blocked inside a *device* program (a train step's
+psum) cannot be reached by any host-side protocol — that case stays with
+the watchdog/coordination-service layer and the restart-from-checkpoint
+recovery model. The residual-hazards table in docs/DESIGN.md is the
+authoritative list.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.parallel.distributed import (
+    process_count,
+    process_index,
+)
+from pytorch_distributed_mnist_tpu.utils.profiling import failure_events
+from pytorch_distributed_mnist_tpu.utils.watchdog import (
+    WatchdogTimeout,
+    run_with_deadline,
+)
+
+# Fixed per-host agreement record: 1 status byte + "phase\x1fdetail",
+# NUL-padded. EVERY host-side supervision collective — checkpoint phase
+# agreements, the dataset vote, resume resolution AND load agreement, and
+# the poison pill — exchanges exactly this shape, so order-mismatched
+# collectives (a poison pill meeting whatever agreement the peers reach
+# next) still execute the same program and parse cleanly.
+#
+# Status bytes (non-NUL on purpose — rstrip-safe): ``K`` ok, ``E`` this
+# host's local outcome for THIS agreement was a failure (a vote), ``P``
+# this host is dying on a host-local error and this record is its poison
+# pill (fatal regardless of which agreement it lands in).
+RECORD_BYTES = 4352
+# Payload capacity of one record's detail field (status byte + phase cap
+# + separator reserve the rest). Derived, not a second literal: callers
+# that budget-check what they stuff into a detail (the resume-resolution
+# path) must track a record resize automatically. Sized so the old
+# resume broadcast's 4095-byte path budget still fits.
+DETAIL_BYTES = RECORD_BYTES - 160
+_SEP = b"\x1f"
+_OK, _ERR, _POISON = b"K", b"E", b"P"
+
+# Environment knobs (documented in README "what happens when a host dies").
+TIMEOUT_ENV = "TPUMNIST_AGREEMENT_TIMEOUT"
+FAULT_ENV = "TPUMNIST_FAULT"
+# A failing host's poison-pill allgather must itself be bounded even when
+# agreement watchdogs are off — if its peers are stuck in a device
+# collective they will never meet it, and the failing host must not trade
+# its clean exit for a new hang.
+POISON_TIMEOUT_DEFAULT = 60.0
+
+
+class PeerFailure(RuntimeError):
+    """Another host failed (or went silent) and this host must unwind.
+
+    ``hosts`` is the list of implicated process indices, ``phase`` the
+    failure phase being attributed (the peer's own reported phase when it
+    delivered a record; the local agreement's phase on a watchdog
+    timeout), ``reason`` a short human string. ``already_agreed`` tells
+    the agreed-exit path not to send a poison pill for this exception:
+    the peers either already know (they sent the record) or are beyond
+    reach (they timed out).
+    """
+
+    already_agreed = True
+
+    def __init__(self, message: str, *, hosts: List[int], phase: str,
+                 reason: str = "") -> None:
+        super().__init__(message)
+        self.hosts = list(hosts)
+        self.phase = phase
+        self.reason = reason
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``kind=raise`` fault point (chaos harness)."""
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+# Every injectable fault point in the framework, name -> where it fires.
+# tools/chaos.py --list renders this table, and tests/test_supervision.py
+# pins that every maybe_fault() call site in the source appears here (and
+# vice versa), so hooks and docs cannot drift.
+FAULT_POINTS: Dict[str, str] = {
+    "data_stage": "cli._build_loaders entry: dataset load/staging on this "
+                  "host, before the cross-host dataset agreement",
+    "train_epoch": "Trainer.train entry: host-side work of one training "
+                   "epoch (staging, dispatch)",
+    "eval": "Trainer.evaluate entry: host-side work of one eval pass",
+    "ckpt_prepare": "checkpoint._sharded_prepare entry: tmp-dir cleanup "
+                    "before the prepare agreement",
+    "ckpt_collect": "checkpoint sharded-save collect phase: owned-shard "
+                    "D2H snapshot, before the write agreement",
+    "ckpt_write": "checkpoint._sharded_write_files entry: shard/index/"
+                  "meta file I/O (the async writer thread's phase)",
+    "ckpt_publish": "checkpoint._sharded_publish entry: immediately "
+                    "before the publish agreement collective",
+    "resume": "cli resume section entry: before checkpoint resolution "
+              "and the resume broadcast/agreement",
+    "download_fetch": "data.download._fetch entry: one mirror fetch "
+                      "attempt",
+}
+
+_FAULT_KINDS = ("kill", "raise", "stall")
+
+
+@dataclass
+class FaultPlan:
+    """One injected fault: ``point:host:kind[:arg]``.
+
+    ``host`` is a process index or ``*`` (every host). ``kind``:
+    ``kill`` (SIGKILL this process — the preemption case), ``raise``
+    (raise ``InjectedFault`` — the host-local error case), ``stall``
+    (sleep ``arg`` seconds, default 3600 — the silent-peer case). For
+    ``kill``/``raise``, ``arg`` is instead the number of matching hits to
+    SKIP before firing (so "the second epoch's train staging" is
+    ``train_epoch:*:kill:1``).
+    """
+
+    point: str
+    host: str
+    kind: str
+    arg: float = 0.0
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        parts = spec.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {spec!r}: expected "
+                f"point:host:kind[:arg]"
+            )
+        point, host, kind = parts[:3]
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {spec!r}: unknown fault point "
+                f"{point!r} (tools/chaos.py --list enumerates them)"
+            )
+        if kind not in _FAULT_KINDS:
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {spec!r}: unknown kind {kind!r} "
+                f"(one of {', '.join(_FAULT_KINDS)})"
+            )
+        if host != "*":
+            try:
+                int(host)
+            except ValueError:
+                raise ValueError(
+                    f"bad {FAULT_ENV} spec {spec!r}: host must be a "
+                    f"process index or '*'"
+                ) from None
+        arg = float(parts[3]) if len(parts) == 4 else (
+            3600.0 if kind == "stall" else 0.0)
+        return cls(point=point, host=host, kind=kind, arg=arg)
+
+    def matches(self, point: str) -> bool:
+        if point != self.point:
+            return False
+        return self.host == "*" or int(self.host) == process_index()
+
+    def fire(self) -> None:
+        detail = f"{self.point}:{self.host}:{self.kind}"
+        print(f"chaos: process {process_index()} firing injected fault "
+              f"{detail}", file=sys.stderr, flush=True)
+        if self.kind == "kill":
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # unreachable
+        if self.kind == "stall":
+            time.sleep(self.arg)
+            return
+        raise InjectedFault(f"injected fault at {detail} (chaos harness)")
+
+
+_fault_plan: Optional[FaultPlan] = None
+_fault_parsed = False
+_fault_hits: Dict[str, int] = {}
+
+
+def _load_fault_plan() -> Optional[FaultPlan]:
+    global _fault_plan, _fault_parsed
+    if not _fault_parsed:
+        spec = os.environ.get(FAULT_ENV, "").strip()
+        _fault_plan = FaultPlan.parse(spec) if spec else None
+        _fault_parsed = True
+    return _fault_plan
+
+
+def maybe_fault(point: str) -> None:
+    """Fire the configured fault when this call site/host matches.
+
+    Call sites must use a string literal from ``FAULT_POINTS`` (pinned by
+    test); the hook is a no-op (one dict probe) when no plan is set.
+    """
+    assert point in FAULT_POINTS, f"unregistered fault point {point!r}"
+    plan = _load_fault_plan()
+    if plan is None or not plan.matches(point):
+        return
+    hits = _fault_hits.get(point, 0)
+    _fault_hits[point] = hits + 1
+    if plan.kind in ("kill", "raise") and hits < int(plan.arg):
+        return  # arg = number of matching hits to skip first
+    plan.fire()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor state
+# ---------------------------------------------------------------------------
+
+_timeout: float = 0.0
+_hard_exit_after: Optional[float] = None
+_phase: str = "startup"
+_agreements: int = 0
+# host -> {"phase": str, "agreement": int, "wall": float} from the last
+# completed agreement: the per-host heartbeat the watchdog dump renders.
+_last_seen: Dict[int, Dict] = {}
+
+
+def configure(timeout: Optional[float] = None,
+              hard_exit_after: Optional[float] = 30.0) -> float:
+    """(Re)arm the supervisor for one run; returns the effective timeout.
+
+    Resolution: explicit ``timeout`` (the ``--agreement-timeout`` flag) >
+    ``TPUMNIST_AGREEMENT_TIMEOUT`` env > 0 (off). 0/negative disables the
+    watchdogs; the agreement protocol itself (records, poison pills) is
+    always on. Also resets per-run state (phase, heartbeats, fault-plan
+    cache) so re-entrant ``cli.run`` calls supervise their own run only.
+    """
+    global _timeout, _hard_exit_after, _phase, _agreements
+    global _fault_parsed, _fault_plan
+    if timeout is None:
+        env = os.environ.get(TIMEOUT_ENV, "").strip()
+        try:
+            timeout = float(env) if env else 0.0
+        except ValueError:
+            raise SystemExit(
+                f"{TIMEOUT_ENV}={env!r} is not a number of seconds"
+            )
+    _timeout = max(0.0, float(timeout))
+    _hard_exit_after = hard_exit_after
+    _phase = "startup"
+    _agreements = 0
+    _last_seen.clear()
+    _fault_parsed = False
+    _fault_plan = None
+    _fault_hits.clear()
+    return _timeout
+
+
+def agreement_timeout() -> float:
+    return _timeout
+
+
+def set_phase(phase: str) -> str:
+    """Mark the lifecycle phase this host is entering (diagnostics +
+    poison-pill attribution); returns the previous phase."""
+    global _phase
+    prev, _phase = _phase, phase
+    return prev
+
+
+def current_phase() -> str:
+    return _phase
+
+
+def _dump_phase_report(label: str, started: float) -> None:
+    """The watchdog diagnostic: who we are, where we're stuck, and every
+    peer's last heartbeat. stderr, one block, machine-greppable header."""
+    from pytorch_distributed_mnist_tpu.parallel.distributed import (
+        runtime_info,
+    )
+
+    info = runtime_info()
+    topo = ", ".join(f"{k}={info[k]}" for k in sorted(info)
+                     if k != "initialized_at")
+    lines = [
+        f"=== supervision watchdog report (process {process_index()}) ===",
+        f"world: {topo}",
+        f"blocked in: {label}",
+        f"lifecycle phase: {_phase}",
+        f"waited: {time.time() - started:.1f}s "
+        f"(deadline {_timeout:g}s)",
+        f"completed agreements this run: {_agreements}",
+    ]
+    if _last_seen:
+        lines.append("per-host last heartbeat (phase reported at the "
+                     "last completed agreement):")
+        for host in sorted(_last_seen):
+            rec = _last_seen[host]
+            age = time.time() - rec["wall"]
+            lines.append(
+                f"  host {host}: phase {rec['phase']!r} at agreement "
+                f"#{rec['agreement']}, {age:.1f}s ago"
+            )
+    else:
+        lines.append("no completed agreements yet: peers' phases unknown "
+                     "(a host may have died before the first agreement)")
+    lines.append("which hosts reached this collective cannot be observed "
+                 "from inside it; suspects = every host but this one")
+    print("\n".join(lines), file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Agreement collectives
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Record:
+    """One host's decoded agreement record."""
+
+    status: str  # "K" | "E" | "P"
+    phase: str   # the sender's lifecycle phase when it sent the record
+    detail: str
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "K"
+
+    @property
+    def poisoned(self) -> bool:
+        return self.status == "P"
+
+
+def _encode_record(status: bytes, detail: str) -> bytes:
+    body = status + _phase.encode()[:128] + _SEP \
+        + detail.encode()[:DETAIL_BYTES]
+    return body.ljust(RECORD_BYTES, b"\0")
+
+
+def _decode_record(raw: bytes) -> Record:
+    raw = raw.rstrip(b"\0")
+    status = raw[:1].decode(errors="replace") or "?"
+    phase, _, detail = raw[1:].partition(_SEP)
+    return Record(status, phase.decode(errors="replace"),
+                  detail.decode(errors="replace"))
+
+
+def _raw_allgather(payload: np.ndarray) -> np.ndarray:
+    """One process_allgather; split out so tests can stall/patch it."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(payload)
+
+
+def _collective_with_deadline(fn: Callable, label: str):
+    """Run a host collective under the configured watchdog deadline."""
+    started = time.time()
+    return run_with_deadline(
+        fn, timeout=_timeout, label=label,
+        on_timeout=lambda: _dump_phase_report(label, started),
+        hard_exit_after=_hard_exit_after,
+    )
+
+
+def allgather_records(phase: str, ok: bool, detail: str = "",
+                      fatal: bool = False) -> List[Record]:
+    """Exchange one supervision record per host; returns decoded records
+    indexed by process. Single-process: returns this host's record alone
+    (no collective). On watchdog expiry: dumps the phase report and
+    raises ``PeerFailure`` implicating every other host.
+    """
+    global _agreements
+    status = _POISON if fatal else (_OK if ok else _ERR)
+    record = _encode_record(status, detail)
+    if process_count() <= 1:
+        return [_decode_record(record)]
+    payload = np.frombuffer(record, dtype=np.uint8)
+    label = f"agreement '{phase}'"
+    try:
+        gathered = _collective_with_deadline(
+            lambda: _raw_allgather(payload), label)
+    except WatchdogTimeout as exc:
+        suspects = [h for h in range(process_count())
+                    if h != process_index()]
+        failure_events.record(
+            "agreement_timeout", f"{label}: peers silent past "
+            f"{_timeout:g}s deadline", phase=phase, hosts=suspects)
+        raise PeerFailure(
+            f"PeerFailure: agreement {phase!r} timed out after "
+            f"{_timeout:g}s — host(s) {suspects} never arrived (died or "
+            f"stuck outside an agreed phase); see the watchdog report "
+            f"above for per-host last heartbeats",
+            hosts=suspects, phase=phase,
+            reason="agreement deadline exceeded",
+        ) from exc
+    except Exception as exc:
+        # The collective itself failed in TRANSPORT (gloo "connection
+        # reset by peer", a dead coordinator's grpc socket): a peer died
+        # mid-collective. That is a peer failure, not a host-local error
+        # — attributing it (and marking it already-agreed) matters
+        # doubly, because a poison pill sent for it would block in the
+        # same dead transport while jax's coordination service races to
+        # hard-kill this process.
+        suspects = [h for h in range(process_count())
+                    if h != process_index()]
+        failure_events.record(
+            "agreement_transport_error", f"{label}: {exc!r}",
+            phase=phase, hosts=suspects)
+        raise PeerFailure(
+            f"PeerFailure: agreement {phase!r} failed in transport — "
+            f"host(s) {suspects} likely died mid-collective: {exc!r}",
+            hosts=suspects, phase=phase,
+            reason=f"collective transport failure: {exc!r}"[:300],
+        ) from exc
+    gathered = np.asarray(gathered).reshape(process_count(), RECORD_BYTES)
+    records = [_decode_record(gathered[h].tobytes())
+               for h in range(process_count())]
+    _agreements += 1
+    now = time.time()
+    for host, rec in enumerate(records):
+        _last_seen[host] = {"phase": rec.phase, "agreement": _agreements,
+                            "wall": now}
+    return records
+
+
+def agree(phase: str, error: Optional[BaseException] = None,
+          detail: str = "") -> List[Tuple[int, str, str]]:
+    """Agree a per-host phase outcome; returns failed peers' records.
+
+    Every host calls this at the same logical step with its local outcome
+    (``error`` / ``detail``). Returns ``[(host, peer_phase, reason), ...]``
+    for every FAILED host (``E`` votes and ``P`` poison pills alike) so
+    callers can raise their own domain-specific message
+    (train/checkpoint.py keeps its pinned wording); callers must re-raise
+    ``error`` afterwards when it is set. The allgather itself
+    synchronizes, so callers may rely on this as a barrier.
+    """
+    detail = detail or (repr(error) if error is not None else "")
+    records = allgather_records(phase, error is None, detail)
+    if error is not None:
+        # The E record above WAS this error's delivery to the peers: the
+        # agreed-exit path must not send a second pill for it on unwind
+        # (a pill no peer would pair a collective with).
+        mark_agreed(error)
+    return [(host, rec.phase, rec.detail)
+            for host, rec in enumerate(records) if not rec.ok]
+
+
+def mark_agreed(error: BaseException) -> None:
+    """Mark ``error`` as already communicated to the peers, so
+    ``deliver_poison`` will not send a (count-misaligning) second pill
+    for it. Callers that raise AFTER an agreement that every host
+    reached — divergence SystemExits, vote rejections — must mark what
+    they raise: every host leaves that agreement raising something, so
+    nobody is left to pair a collective with a pill."""
+    try:
+        error._poison_delivered = True
+    except AttributeError:
+        pass  # exceptions with __slots__: worst case a duplicate pill
+
+
+def raise_if_poisoned(records: List[Record], context: str) -> None:
+    """Raise ``PeerFailure`` when any record is a peer's poison pill.
+
+    Vote-type agreements (dataset load, resume resolution/outcome)
+    interpret a same-phase ``E`` record as a legitimate local vote;
+    without this check a dying peer's pill would be misread as that vote
+    ("dataset not present on host 2") instead of the truth ("host 2 died
+    in checkpoint write"). The ``P`` status makes the distinction
+    explicit whatever phase the pill was sent from.
+    """
+    poisoned = [(host, rec.phase, rec.detail)
+                for host, rec in enumerate(records)
+                if rec.poisoned and host != process_index()]
+    if poisoned:
+        raise PeerFailure(
+            peer_failure_message(
+                poisoned,
+                f"PeerFailure: host(s) {[h for h, _, _ in poisoned]} "
+                f"died on a host-local error while this host was in "
+                f"{context};",
+            ),
+            hosts=[h for h, _, _ in poisoned],
+            phase=poisoned[0][1],
+            reason=poisoned[0][2],
+        )
+
+
+def peer_failure_message(failed: List[Tuple[int, str, str]],
+                         context: str) -> str:
+    """Uniform rendering of failed-peer records for error messages."""
+    per_host = "; ".join(
+        f"host {h} in phase {p!r}: {r or 'no detail'}"
+        for h, p, r in failed
+    )
+    return f"{context} [{per_host}]"
+
+
+def escalate_exit(error: BaseException, grace: float = 10.0) -> None:
+    """Arm a hard exit for a host dying on a PEER failure.
+
+    When this host unwinds because its peers are dead (``PeerFailure`` /
+    watchdog abort — the ``already_agreed`` class), interpreter teardown
+    is itself a hang risk: jax's atexit distributed shutdown runs a
+    coordination-service *barrier* that the dead peers will never join,
+    parking the process ~90s until the heartbeat timeout hard-kills it
+    (observed in the chaos twins) — which both delays the exit far past
+    the watchdog deadline and replaces the informative exit with a
+    SIGABRT. A daemon timer gives normal teardown ``grace`` seconds,
+    then ``os._exit``s with the watchdog's distinct code. Symmetric
+    failure exits (every host raising the same agreed error) are NOT
+    escalated: all hosts reach the shutdown barrier together and a
+    normal exit preserves the real return code.
+    """
+    if process_count() <= 1 or not getattr(error, "already_agreed", False):
+        return
+    from pytorch_distributed_mnist_tpu.utils.watchdog import arm_hard_exit
+
+    failure_events.record("exit_escalated",
+                          f"hard exit in {grace:g}s (peers unreachable)")
+    arm_hard_exit(grace, "peers unreachable; the distributed shutdown "
+                         "barrier may block interpreter teardown")
+
+
+def deliver_poison(error: BaseException) -> None:
+    """The agreed exit: participate in the next agreement collective with
+    a failure record, so peers unwind with ``PeerFailure`` instead of
+    hanging at their next agreement.
+
+    No-op when: single-process (nobody to poison); the error is itself
+    the product of an agreement (``already_agreed`` — peers already know,
+    or timed out and are beyond reach); or ``KeyboardInterrupt`` (the
+    operator is killing every host themselves). The poison allgather is
+    always deadline-bounded (the configured timeout, else
+    ``POISON_TIMEOUT_DEFAULT``): if peers are stuck in a device
+    collective they will never meet it, and this host's clean exit must
+    not become a second hang. Best-effort by design — the original
+    ``error`` is never masked.
+    """
+    if process_count() <= 1:
+        return
+    if getattr(error, "already_agreed", False):
+        return
+    if isinstance(error, KeyboardInterrupt):
+        return
+    if getattr(error, "_poison_delivered", False):
+        # Idempotent per exception: both AsyncCheckpointer.__exit__ and
+        # cli.run's supervised scope call this on the same unwind, but
+        # the pill must go out exactly once — peers pair ONE extra
+        # collective with it, a second would misalign every host's
+        # collective count.
+        return
+    try:
+        error._poison_delivered = True
+    except AttributeError:
+        pass  # exceptions with __slots__: worst case a duplicate pill
+    global _timeout
+    reason = repr(error)[:300]
+    failure_events.record("poison_sent", reason, phase=_phase)
+    print(
+        f"process {process_index()}: host-local failure in phase "
+        f"{_phase!r}; delivering poison pill to peers before exit: "
+        f"{reason}", file=sys.stderr, flush=True,
+    )
+    bounded = _timeout if _timeout > 0 else POISON_TIMEOUT_DEFAULT
+    saved, _timeout = _timeout, bounded
+    try:
+        allgather_records("poison_exit", ok=False, detail=reason,
+                          fatal=True)
+    except Exception as exc:
+        # Peers never met the poison (dead, stuck in a device program,
+        # or the transport is already gone). The coordination service /
+        # operator restart layer owns them now; this host exits on its
+        # original error — delivery is best-effort by contract.
+        failure_events.record(
+            "poison_undelivered",
+            f"no agreement within {bounded:g}s: {exc!r}", phase=_phase)
+    finally:
+        _timeout = saved

@@ -53,7 +53,15 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from pytorch_distributed_mnist_tpu.runtime.supervision import maybe_fault
+from pytorch_distributed_mnist_tpu.utils.watchdog import retry_with_backoff
+
 CHECKPOINT_DIR = "checkpoints"
+
+# Quarantine suffix for corrupt checkpoints (resume-time rename); the
+# `_epoch_checkpoints` pattern can never match a quarantined name, so a
+# quarantined file is invisible to resolution and pruning alike.
+CORRUPT_SUFFIX = ".corrupt"
 
 
 def _leaves_with_names(tree: Any):
@@ -152,6 +160,7 @@ def _sharded_prepare(directory: str, epoch: int, pid: int) -> Tuple[str, str]:
     agreement collective doubles as the nobody-writes-into-a-dir-
     being-rm'd barrier. Creating each host's own view of ``tmp`` is left
     to the callers' guarded produce phase for the same reason."""
+    maybe_fault("ckpt_prepare")
     final = os.path.join(directory, f"checkpoint_{epoch}.ckpt")
     tmp = final + ".tmp"  # same deterministic name on every process
     err: Optional[BaseException] = None
@@ -180,6 +189,7 @@ def _sharded_collect(named, pid: int) -> Tuple[Dict[str, np.ndarray], list]:
     per host. ``np.asarray(shard.data)`` is a D2H copy, so the returned
     payload is a consistent snapshot — the train loop may donate the
     device buffers the moment this returns."""
+    maybe_fault("ckpt_collect")
     payload: Dict[str, np.ndarray] = {}
     index = []
     for i, (_, leaf) in enumerate(named):
@@ -220,6 +230,7 @@ def _sharded_write_files(tmp: str, pid: int, payload, index,
                          meta: Optional[Dict[str, Any]]) -> None:
     """Phase 3 (any thread): pure file I/O, no device or collective use —
     the part the AsyncCheckpointer overlaps with the next epoch."""
+    maybe_fault("ckpt_write")
     shard_file = f"shards_p{pid:05d}.npz"
     if payload:
         with open(os.path.join(tmp, shard_file), "wb") as f:
@@ -253,7 +264,35 @@ def _publish_dir(tmp: str, final: str, directory: str, epoch: int,
         )
     if os.path.isdir(final):
         shutil.rmtree(final)
-    os.replace(tmp, final)  # atomic publish of the complete directory
+
+    # Atomic publish of the complete directory. The rename is the one
+    # retry-safe step on a network filesystem (transient ESTALE/EIO on a
+    # busy NFS export): bounded backoff+jitter, because failing here
+    # aborts EVERY host via the publish agreement while a one-line retry
+    # publishes a checkpoint that is already fully on disk.
+    from pytorch_distributed_mnist_tpu.utils.profiling import failure_events
+
+    def _replace_once() -> None:
+        try:
+            os.replace(tmp, final)
+        except OSError:
+            if os.path.isdir(final) and not os.path.exists(tmp):
+                # NFS lost-reply duplicate: the server performed the
+                # rename but the client's reply was lost, so the retry
+                # sees ENOENT for tmp. The publish already landed —
+                # treating this as failure would abort EVERY host over a
+                # checkpoint that is intact on disk.
+                return
+            raise
+
+    retry_with_backoff(
+        _replace_once,
+        attempts=3, retry_on=(OSError,),
+        on_retry=lambda attempt, exc, delay: failure_events.record(
+            "publish_retry",
+            f"rename to {final} attempt {attempt} failed ({exc!r}); "
+            f"retrying in {delay:.2f}s"),
+    )
     try:
         if is_best:
             best = os.path.join(directory, "model_best.ckpt")
@@ -295,6 +334,7 @@ def _sharded_publish(tmp: str, final: str, directory: str, epoch: int,
     sites do) — that agreement is the all-shard-files-are-on-disk
     barrier, so no extra collective runs here before process 0 checks
     visibility."""
+    maybe_fault("ckpt_publish")
     err: Optional[BaseException] = None
     if pid == 0:
         try:
@@ -318,22 +358,34 @@ def _agree_phase_ok(error: Optional[BaseException], epoch: int,
     prepare, and process 0's publish body alike). Every host calls this
     at the same logical step; afterwards all hosts either proceed
     together or raise together — peers of a failed host raise
-    ``RuntimeError`` naming it, the failed host re-raises its own error.
-    The allgather itself synchronizes, so callers may rely on this as a
+    ``PeerFailure`` naming it, the failed host re-raises its own error.
+
+    Since the supervision retrofit this delegates to
+    ``runtime/supervision.py``: the agreement exchanges full supervision
+    records (so a poison pill from a host that failed OUTSIDE a
+    checkpoint phase is understood here and attributed to its real
+    phase), runs under the configured watchdog deadline, and the
+    allgather itself synchronizes, so callers may rely on this as a
     barrier.
     """
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    from pytorch_distributed_mnist_tpu.runtime import supervision
 
-        ok = error is None
-        everyone = multihost_utils.process_allgather(
-            np.asarray([ok], dtype=np.bool_)
-        ).reshape(-1)
-        if not bool(np.all(everyone)) and ok:
-            failed = [int(i) for i in np.nonzero(~everyone)[0]]
-            raise RuntimeError(
-                f"sharded checkpoint {phase} for epoch {epoch} failed on "
-                f"host(s) {failed}; {detail}"
+    if jax.process_count() > 1:
+        failed = supervision.agree(f"ckpt_{phase}", error)
+        if failed and error is None:
+            raise supervision.PeerFailure(
+                supervision.peer_failure_message(
+                    failed,
+                    f"sharded checkpoint {phase} for epoch {epoch} failed "
+                    f"on host(s) {[h for h, _, _ in failed]}; {detail}",
+                ),
+                hosts=[h for h, _, _ in failed],
+                # The failed peer's OWN reported phase: a poison pill
+                # from a host that died outside checkpointing must be
+                # attributed to its real failure site, not to whichever
+                # checkpoint agreement happened to receive the pill.
+                phase=failed[0][1],
+                reason=failed[0][2],
             )
     if error is not None:
         raise error
@@ -470,6 +522,64 @@ def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
         saved = [z[f"leaf_{i}"] for i in range(len(meta["leaf_names"]))]
     new_state = _restore_onto_template(path, meta["leaf_names"], saved, state)
     return new_state, int(meta["epoch"]), float(meta["best_acc"])
+
+
+def is_corrupt_checkpoint_error(exc: BaseException) -> bool:
+    """True when a ``load_checkpoint`` failure means the FILE is damaged
+    (truncated download, torn write, lost shard file) rather than the
+    CALLER being wrong (model/optimizer mismatch -> shape/leaf-count
+    ValueErrors, path typo on a fresh run).
+
+    The distinction gates resume-time quarantine: a corrupt latest
+    checkpoint is renamed ``*.corrupt`` and resume falls back to the
+    next-older epoch, while a mismatch must keep aborting loudly —
+    quarantining a perfectly good checkpoint because the user changed
+    ``--model`` would silently destroy their training history.
+
+    Only CONTENT-level damage qualifies (bytes present but undecodable).
+    Absence-level signals — a published ``.ckpt`` directory "missing"
+    meta.json or a shard file — are NOT corruption: the atomic publish
+    means a published directory was complete when renamed, so a missing
+    member at resume time is far more likely a stale NFS attribute/
+    readdir cache serving an incomplete view, and quarantining on it
+    would destroy the newest good checkpoint. Those abort loudly.
+    """
+    import zipfile
+    import zlib
+
+    if isinstance(exc, (zipfile.BadZipFile, zlib.error, EOFError,
+                        json.JSONDecodeError)):
+        return True
+    if isinstance(exc, KeyError):
+        # npz member missing (__meta__/leaf_N): a torn or foreign zip
+        # (zip content, not filesystem absence — the file itself decoded).
+        return True
+    if isinstance(exc, ValueError):
+        # np.load on a non-zip is corruption; shape/leaf-count
+        # mismatches (and _load_sharded's missing-shards complaint,
+        # which is absence-level) are not.
+        msg = str(exc)
+        return ("Cannot load file" in msg
+                or "Failed to interpret" in msg or "allow_pickle" in msg)
+    return False
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Rename a corrupt checkpoint out of the resolution namespace.
+
+    ``checkpoint_{e}.npz`` -> ``checkpoint_{e}.npz.corrupt`` (numbered
+    ``.corrupt2``... if a previous quarantine of the same epoch exists),
+    for both layouts — ``_epoch_checkpoints``'s pattern cannot match the
+    suffix, so ``latest_checkpoint`` falls back to the next-older epoch
+    and pruning never touches the evidence. Returns the quarantine path.
+    """
+    dest = path + CORRUPT_SUFFIX
+    n = 2
+    while os.path.exists(dest):
+        dest = f"{path}{CORRUPT_SUFFIX}{n}"
+        n += 1
+    os.replace(path, dest)
+    return dest
 
 
 def _epoch_checkpoints(directory: str) -> list:
@@ -666,6 +776,11 @@ class AsyncCheckpointer:
         if exc_info[0] is None:
             self.wait()
         else:
+            from pytorch_distributed_mnist_tpu.runtime import supervision
+            from pytorch_distributed_mnist_tpu.utils.profiling import (
+                failure_events,
+            )
+
             if self._thread is not None:
                 self._thread.join()
                 self._thread = None
@@ -680,19 +795,33 @@ class AsyncCheckpointer:
                     f"favor of the run's own exception: {self._error!r}",
                     file=sys.stderr,
                 )
+                failure_events.record(
+                    "async_write_error_discarded", repr(self._error))
                 self._error = None
             if self._pending_publish is not None:
                 # Never run the deferred publish barrier while unwinding:
-                # the other hosts may be unwinding too and would never
-                # arrive. The unpublished tmp dir is named so the epoch's
-                # loss is visible, not silent.
+                # a PEER failure (or watchdog abort) means the other
+                # hosts are unwinding too and would never arrive. The
+                # unpublished tmp dir is named so the epoch's loss is
+                # visible, not silent.
                 print(
                     "WARNING: unpublished checkpoint "
                     f"{self._pending_publish['tmp']} dropped during "
                     "unwind (publish barrier skipped)",
                     file=sys.stderr,
                 )
+                failure_events.record(
+                    "pending_publish_dropped", self._pending_publish["tmp"])
                 self._pending_publish = None
+            # The agreed exit (ADVICE.md residual hazard, now closed):
+            # a HOST-LOCAL failure must not let this host vanish while
+            # its peers proceed to the next drain's write agreement and
+            # block forever in it. Delivering the poison pill here —
+            # inside the saver's scope boundary — covers every
+            # AsyncCheckpointer user, not just cli.run (whose supervised
+            # scope calls this too; delivery is idempotent per
+            # exception, so the pill goes out exactly once).
+            supervision.deliver_poison(exc_info[1])
 
 
 class _HostState:

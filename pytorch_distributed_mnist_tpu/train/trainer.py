@@ -321,6 +321,11 @@ class Trainer:
 
         Parity contract: reference ``Trainer.train`` (``:77-97``).
         """
+        from pytorch_distributed_mnist_tpu.runtime.supervision import (
+            maybe_fault,
+        )
+
+        maybe_fault("train_epoch")
         if self.mode == "scan" and self.epoch_gather == "device":
             if self._train_data is None:
                 # The dataset crosses the host boundary exactly once.
@@ -371,6 +376,11 @@ class Trainer:
         gradient, no state update. When the eval loader is sharded the
         metric reduction crosses devices inside the jitted program.
         """
+        from pytorch_distributed_mnist_tpu.runtime.supervision import (
+            maybe_fault,
+        )
+
+        maybe_fault("eval")
         if self.mode == "scan":
             if self._eval_staged is None:
                 # The eval sampler never reshuffles, so the stacked epoch

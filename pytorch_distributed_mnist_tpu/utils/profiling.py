@@ -226,6 +226,44 @@ class CompileLog:
 compile_log = CompileLog()
 
 
+class EventLog:
+    """Append-only log of supervision/failure events for the run summary.
+
+    The run-supervision layer (``runtime/supervision.py``) records every
+    watchdog trip, poison-pill sent/received, retry, and quarantine here,
+    and ``cli.run`` surfaces the snapshot as the summary's
+    ``failure_events`` — so "what went wrong, when, in which phase" is one
+    JSON block in the same place throughput and compile stats already
+    live, instead of a grep through interleaved stderr. Thread-safe:
+    watchdog timers and the async checkpoint writer record from their own
+    threads.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events = []
+
+    def record(self, kind: str, detail: str, **fields) -> Dict:
+        event = {"t": round(time.time(), 3), "kind": kind,
+                 "detail": detail, **fields}
+        with self._lock:
+            self._events.append(event)
+        return event
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# Singleton for the same reason as compile_log: one run, one failure story.
+# cli.run resets it at entry so re-entrant runs report their own events.
+failure_events = EventLog()
+
+
 @contextlib.contextmanager
 def profile_trace(logdir: Optional[str]):
     """Capture a jax.profiler trace to ``logdir`` when set; no-op otherwise."""

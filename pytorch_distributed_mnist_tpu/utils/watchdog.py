@@ -1,0 +1,171 @@
+"""Host-side deadline and retry primitives for the run-supervision layer.
+
+Multi-host agreement collectives (``runtime/supervision.py``) have no
+native timeout: a peer that died outside an agreed phase leaves this host
+blocked forever. ``run_with_deadline`` bounds any such call by running it
+on a worker thread and joining with a timeout — the one threading pattern
+that is compatible with the "no two collectives in flight at once"
+invariant (docs/DESIGN.md section 6), because the main thread BLOCKS on
+the join: process-wide there is still at most one collective executing.
+
+On expiry the watchdog (a) invokes the caller's diagnostic dump, (b)
+optionally arms a hard-exit timer so a process whose interpreter
+teardown would itself block on the stuck collective still dies, and (c)
+raises ``WatchdogTimeout`` in the caller. The worker thread stays
+parked in the dead collective; callers must treat a ``WatchdogTimeout``
+as fatal for the run (``already_agreed`` marks it as not needing — and
+not safe for — any further collective participation).
+
+``retry_with_backoff`` is the sibling primitive for the *retryable*
+host-side failures (checkpoint publish rename on NFS, dataset mirror
+fetch): bounded attempts, exponential backoff, jitter so a fleet of
+hosts retrying a shared resource doesn't stampede in lockstep.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+# Exit code for a watchdog hard-exit: distinct from SIGKILL's 137 and from
+# ordinary failure 1, so a postmortem can tell "the watchdog shot this
+# process" from "it crashed" at a glance. 75 = EX_TEMPFAIL.
+HARD_EXIT_CODE = 75
+
+
+def arm_hard_exit(delay: float, reason: str) -> None:
+    """Arm a daemon timer that ``os._exit(HARD_EXIT_CODE)``s the process
+    ``delay`` seconds from now unless it exits on its own first.
+
+    The shared last resort for the two places a supervised process can
+    get stuck on the way OUT: a watchdog-expired collective whose thread
+    holds interpreter teardown hostage (``run_with_deadline``), and the
+    distributed shutdown barrier that dead peers will never join
+    (``supervision.escalate_exit``). Announces itself on stderr so the
+    distinct exit code is explicable from the log.
+    """
+    t = threading.Timer(delay, lambda: os._exit(HARD_EXIT_CODE))
+    t.daemon = True
+    t.start()
+    print(
+        f"watchdog: {reason}; hard exit ({HARD_EXIT_CODE}) in {delay:g}s "
+        f"unless the process unwinds first", file=sys.stderr, flush=True,
+    )
+
+
+class WatchdogTimeout(RuntimeError):
+    """A supervised call exceeded its deadline.
+
+    ``already_agreed`` tells the agreed-exit protocol NOT to attempt a
+    poison-pill agreement on the way out: the peers this process would
+    agree with are exactly the ones that failed to show up.
+    """
+
+    already_agreed = True
+
+    def __init__(self, label: str, timeout: float) -> None:
+        super().__init__(
+            f"watchdog: {label} made no progress within {timeout:g}s"
+        )
+        self.label = label
+        self.timeout = timeout
+
+
+def run_with_deadline(
+    fn: Callable,
+    *,
+    timeout: float,
+    label: str,
+    on_timeout: Optional[Callable[[], None]] = None,
+    hard_exit_after: Optional[float] = None,
+):
+    """Run ``fn()`` with a deadline; return its result or raise.
+
+    ``timeout <= 0`` disables supervision entirely: ``fn`` runs inline on
+    the calling thread (the production default on real multi-host TPU,
+    where a conservatively-sized deadline would still be a new way to
+    kill a healthy-but-slow job).
+
+    On expiry: ``on_timeout()`` runs first (diagnostics — it must not
+    itself block or raise), then, when ``hard_exit_after`` is set, a
+    daemon timer is armed that ``os._exit(HARD_EXIT_CODE)``s the process
+    that many seconds later if it is still alive (interpreter teardown
+    can block on the stuck collective's thread-state otherwise), then
+    ``WatchdogTimeout`` is raised in the caller. ``fn``'s own exception
+    propagates unchanged when it finishes in time.
+    """
+    if not timeout or timeout <= 0:
+        return fn()
+    outcome: dict = {}
+
+    def _body() -> None:
+        try:
+            outcome["result"] = fn()
+        except BaseException as exc:  # propagated by the joiner below
+            outcome["error"] = exc
+
+    t = threading.Thread(target=_body, daemon=True,
+                         name=f"watchdog-{label}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        if on_timeout is not None:
+            try:
+                on_timeout()
+            except Exception as exc:  # diagnostics must never mask the abort
+                print(f"watchdog: diagnostic dump for {label} failed: "
+                      f"{exc!r}", file=sys.stderr, flush=True)
+        if hard_exit_after and hard_exit_after > 0:
+            arm_hard_exit(hard_exit_after, f"{label} timed out")
+        raise WatchdogTimeout(label, timeout)
+    if "error" in outcome:
+        raise outcome["error"]
+    return outcome.get("result")
+
+
+def retry_with_backoff(
+    fn: Callable,
+    *,
+    attempts: int,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    base_delay: float = 0.5,
+    max_delay: float = 8.0,
+    jitter: float = 0.5,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+    rng: Optional[random.Random] = None,
+):
+    """Call ``fn()`` up to ``attempts`` times; return its first success.
+
+    Retries only on ``retry_on`` exceptions (anything else propagates
+    immediately — a checksum mismatch is retryable, a programming error
+    is not the retry loop's business). Delay before attempt ``k`` (1-based
+    retries) is ``min(max_delay, base_delay * 2**(k-1))`` plus a uniform
+    ``[0, jitter)`` second draw, so lockstep hosts retrying one shared
+    mirror or filesystem de-synchronize. ``on_retry(attempt, exc, delay)``
+    observes each scheduled retry (the supervision event log hooks in
+    here); the final failure re-raises the last exception unchanged.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if sleep is None:
+        sleep = time.sleep  # late-bound: monkeypatched clocks apply
+    draw = (rng or random).uniform
+    last: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as exc:
+            last = exc
+            if attempt == attempts:
+                raise
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            delay += draw(0.0, jitter) if jitter > 0 else 0.0
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+    raise last  # unreachable; keeps type-checkers honest

@@ -82,6 +82,15 @@ from pytorch_distributed_mnist_tpu.utils.compile_cache import (  # noqa: E402
 os.environ.pop("TPUMNIST_COMPILE_CACHE", None)
 configure_ambient(os.environ.get("TPU_MNIST_TEST_CACHE", ""))
 
+# Agreement watchdogs default ON in tests (off in production): any
+# multi-process child a test spawns inherits this via _child_env, so a
+# protocol regression that re-introduces a strand fails as a loud
+# PeerFailure near this deadline instead of idling until the test's
+# communicate() timeout. 300s is far above any legitimate skew between
+# healthy ranks (whole 2-rank runs finish in well under that); chaos
+# twins override with a tight per-test value.
+os.environ.setdefault("TPUMNIST_AGREEMENT_TIMEOUT", "300")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,235 @@
+"""Fault-injection (chaos) twins: real 2-process worlds with one rank
+sabotaged at a named fault point (``TPUMNIST_FAULT``), proving the
+run-supervision subsystem end to end — the proofs a monkeypatched unit
+test cannot give:
+
+- killing one host during the publish agreement ends the SURVIVOR with a
+  ``PeerFailure`` naming the dead host and the phase, within seconds —
+  not a hang until the test harness timeout — and a subsequent
+  ``--resume auto`` world recovers from the last published checkpoint;
+- killing a host mid-sharded-write leaves the epoch UNPUBLISHED (tmp dir
+  only), and the next run cleans up and republishes;
+- killing every host mid-epoch (the preemption case) loses at most the
+  unpublished epoch: the same command line resumes and finishes;
+- a host-local EXCEPTION (not a kill) delivers the poison pill: the
+  healthy peer unwinds from its next agreement with the failure
+  attributed to the right host and phase.
+
+The acceptance twin (publish-agreement kill + recovery) runs in tier-1
+with tight timeouts; the longer scenarios are ``slow``. All are marked
+``chaos`` (`pytest -m chaos` runs just this harness).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pytorch_distributed_mnist_tpu.parallel.launcher import (
+    _child_env,
+    free_port,
+)
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tight deadline for chaos runs: the strand being tested must convert to
+# a loud exit in seconds. Generous enough that a healthy-but-loaded rank
+# (one CPU core timeshared by everything) cannot trip it spuriously.
+_DEADLINE = "8"
+
+pytestmark = pytest.mark.chaos
+
+
+def _spawn(ckpt, flags, fault=None, nprocs=2, timeout=180):
+    """Launch ``nprocs`` worker ranks (optionally fault-injected); wait
+    for all (killing stragglers at ``timeout``); return [(rc, out)]."""
+    port = free_port()
+    env = _child_env()
+    env["TPUMNIST_AGREEMENT_TIMEOUT"] = _DEADLINE
+    if fault:
+        env["TPUMNIST_FAULT"] = fault
+    else:
+        env.pop("TPUMNIST_FAULT", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), str(nprocs), str(port),
+             str(ckpt)] + list(flags),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=_REPO,
+        )
+        for rank in range(nprocs)
+    ]
+    results = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                out = (out or "") + "\n<<killed by test harness timeout>>"
+            results.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results
+
+
+def _summary(out):
+    lines = [l for l in out.splitlines() if l.startswith("SUMMARY")]
+    assert lines, f"no SUMMARY line in:\n{out[-3000:]}"
+    return json.loads(lines[-1][len("SUMMARY"):])
+
+
+_Z1 = ["--optimizer-sharding", "zero1"]
+
+
+def test_kill_during_publish_agreement_peer_failure_then_resume(tmp_path):
+    """THE acceptance twin. Epoch 1's publish agreement: rank 1 is
+    SIGKILLed at the ``ckpt_publish`` fault point (after the write
+    agreement, at the publish collective). Before the supervision layer,
+    rank 0 blocked forever in the timeout-less publish barrier; now it
+    must exit with ``PeerFailure`` attributing host 1 and the
+    ``ckpt_publish`` phase — within seconds, not a hang — and a fresh
+    2-process ``--resume auto`` world must recover from the last
+    published checkpoint."""
+    ckpt = tmp_path / "ckpts"
+    t0 = time.monotonic()
+    results = _spawn(ckpt, _Z1 + ["--epochs", "2"],
+                     fault="ckpt_publish:1:kill:1")
+    elapsed = time.monotonic() - t0
+    (rc0, out0), (rc1, out1) = results
+    assert rc1 == -9, f"rank 1 should have been SIGKILLed:\n{out1[-2000:]}"
+    assert "<<killed by test harness timeout>>" not in out0, (
+        f"rank 0 hung instead of exiting:\n{out0[-2000:]}")
+    assert rc0 not in (0, None), f"rank 0 should have failed:\n{out0[-2000:]}"
+    # Correct attribution: the phase and the host, in a PeerFailure.
+    assert "PeerFailure" in out0
+    assert "ckpt_publish" in out0
+    assert "[1]" in out0
+    # "within the configured deadline, not a hang": the whole twin —
+    # startup, epoch 0, epoch 1, kill, supervised exit — stays well
+    # under the old failure mode (blocked until the 180s harness kill).
+    assert elapsed < 150, f"supervised exit took {elapsed:.0f}s"
+    # Epoch 1 HAD published before the agreement (process 0 renames
+    # before agreeing); epoch 0 is there from the previous save.
+    names = set(os.listdir(ckpt))
+    assert "checkpoint_0.ckpt" in names and "checkpoint_1.ckpt" in names
+
+    # Recovery: the same world, no fault, picks up the last PUBLISHED
+    # checkpoint (epoch 1 -> start at 2) and finishes the job.
+    results = _spawn(ckpt, _Z1 + ["--epochs", "3", "--resume", "auto"])
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"recovery rank {rank} failed:\n{out[-3000:]}"
+    for rc, out in results:
+        s = _summary(out)
+        assert s["start_epoch"] == 2 and s["epochs_run"] == 1
+
+
+@pytest.mark.slow
+def test_stall_during_publish_agreement_trips_watchdog(tmp_path):
+    """The silent-peer flavor (process alive, never arrives): rank 1
+    STALLS at the publish fault point, so no transport error ever fires —
+    only the agreement watchdog can save rank 0. It must dump the
+    per-host phase report and exit with the deadline PeerFailure."""
+    ckpt = tmp_path / "ckpts"
+    port = free_port()
+    env = _child_env()
+    env["TPUMNIST_AGREEMENT_TIMEOUT"] = _DEADLINE
+    env["TPUMNIST_FAULT"] = "ckpt_publish:1:stall:600"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), "2", str(port),
+             str(ckpt)] + _Z1,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=_REPO,
+        )
+        for rank in range(2)
+    ]
+    try:
+        out0, _ = procs[0].communicate(timeout=180)
+    finally:
+        for p in procs:  # rank 1 is stalled by design: shoot it
+            if p.poll() is None:
+                p.kill()
+    procs[1].communicate()
+    assert procs[0].returncode not in (0, None), out0[-3000:]
+    assert "supervision watchdog report" in out0
+    assert "blocked in: agreement 'ckpt_publish'" in out0
+    assert "PeerFailure" in out0 and "timed out" in out0
+    assert "[1]" in out0
+
+
+@pytest.mark.slow
+def test_kill_during_sharded_write_drops_unpublished_tmp(tmp_path):
+    """Rank 1 dies inside the shard-file write: the epoch must end
+    UNPUBLISHED on every host (tmp dir only — a half-written directory
+    must never become ``latest_checkpoint``), and the next run must
+    clean the stale tmp and publish normally."""
+    ckpt = tmp_path / "ckpts"
+    results = _spawn(ckpt, _Z1, fault="ckpt_write:1:kill")
+    (rc0, out0), (rc1, out1) = results
+    assert rc1 == -9, out1[-2000:]
+    assert rc0 not in (0, None), out0[-2000:]
+    assert "PeerFailure" in out0
+    names = set(os.listdir(ckpt))
+    assert "checkpoint_0.ckpt" not in names
+    assert "checkpoint_0.ckpt.tmp" in names  # evidence, not a checkpoint
+
+    # Same command line, healthy world: --resume auto finds NO published
+    # checkpoint (the tmp is invisible to resolution), trains fresh,
+    # cleans the stale tmp, and publishes.
+    results = _spawn(ckpt, _Z1 + ["--resume", "auto"])
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"recovery rank {rank} failed:\n{out[-3000:]}"
+    assert _summary(results[0][1])["start_epoch"] == 0
+    names = set(os.listdir(ckpt))
+    assert "checkpoint_0.ckpt" in names
+    assert "checkpoint_0.ckpt.tmp" not in names
+
+
+@pytest.mark.slow
+def test_midepoch_kill_every_host_then_resume_auto(tmp_path):
+    """The preemption case at 2-process scale: every host is SIGKILLed
+    mid-epoch-1 (after epoch 0's checkpoint landed). The relaunch with
+    the SAME command line resumes at epoch 1 and finishes — at most the
+    unpublished epoch is lost."""
+    ckpt = tmp_path / "ckpts"
+    flags = ["--epochs", "3", "--resume", "auto"]
+    results = _spawn(ckpt, flags, fault="train_epoch:*:kill:1")
+    for rank, (rc, out) in enumerate(results):
+        assert rc == -9, f"rank {rank} should have been killed:\n{out[-2000:]}"
+    assert "checkpoint_0.npz" in os.listdir(ckpt)
+
+    results = _spawn(ckpt, flags)
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"resumed rank {rank} failed:\n{out[-3000:]}"
+    for rc, out in results:
+        s = _summary(out)
+        assert s["start_epoch"] == 1 and s["epochs_run"] == 2
+    assert {"checkpoint_0.npz", "checkpoint_1.npz",
+            "checkpoint_2.npz"} <= set(os.listdir(ckpt))
+
+
+@pytest.mark.slow
+def test_hostlocal_raise_delivers_poison_pill(tmp_path):
+    """The agreed-exit protocol proper (no kill involved): rank 1 raises
+    a host-local exception at the ``resume`` fault point. Its poison
+    pill pairs with rank 0's resume-resolution collective, so rank 0
+    unwinds with the failure attributed to host 1 and its phase —
+    before this protocol, rank 0 blocked in that collective forever."""
+    ckpt = tmp_path / "ckpts"
+    results = _spawn(ckpt, ["--resume", "auto"], fault="resume:1:raise")
+    (rc0, out0), (rc1, out1) = results
+    assert rc1 not in (0, None), out1[-2000:]
+    assert "InjectedFault" in out1
+    assert "delivering poison pill" in out1
+    assert rc0 not in (0, None), out0[-2000:]
+    assert "PeerFailure" in out0
+    assert "died on a host-local error" in out0
+    assert "[1]" in out0 and "'resume'" in out0

@@ -319,11 +319,12 @@ def test_async_sharded_peer_failure_agreed_before_publish_barrier(
     whose writes succeeded must NOT enter the publish barrier (it has no
     timeout — they would hang forever waiting for the raising host).
     The write outcome is allgathered first; all hosts fail together.
-    Hermetic twin: process_count/allgather stubbed to simulate host 1
-    failing while we (host 0) succeeded."""
+    Hermetic twin: process_count/allgather stubbed (via the supervision
+    record channel the agreement now rides) to simulate host 1 failing
+    while we (host 0) succeeded."""
     import numpy as np
-    from jax.experimental import multihost_utils
 
+    from pytorch_distributed_mnist_tpu.runtime import supervision as sup
     from pytorch_distributed_mnist_tpu.train import checkpoint as ckpt
 
     saver = ckpt.AsyncCheckpointer()
@@ -333,9 +334,16 @@ def test_async_sharded_peer_failure_agreed_before_publish_barrier(
         directory=str(tmp_path), epoch=3, is_best=False, keep_last=0,
         pid=0)
     monkeypatch.setattr(ckpt.jax, "process_count", lambda: 2)
-    monkeypatch.setattr(
-        multihost_utils, "process_allgather",
-        lambda x: np.concatenate([np.asarray(x), np.asarray([False])]))
+    monkeypatch.setattr(sup, "process_count", lambda: 2)
+    monkeypatch.setattr(sup, "process_index", lambda: 0)
+
+    def fake_allgather(payload):
+        peer = np.frombuffer(
+            sup._encode_record(sup._ERR, "OSError('peer write failed')"),
+            np.uint8)
+        return np.stack([np.asarray(payload), peer])
+
+    monkeypatch.setattr(sup, "_raw_allgather", fake_allgather)
     published = []
     monkeypatch.setattr(ckpt, "_sharded_publish",
                         lambda **kw: published.append(kw))
@@ -432,3 +440,149 @@ def test_async_and_keep_last_cli(tmp_path):
     _, epoch, _ = load_checkpoint(str(tmp_path / "checkpoint_2.npz"),
                                   fresh_state())
     assert epoch == 3
+
+
+# -- corrupt-checkpoint quarantine at resume (run-supervision satellite) ----
+
+
+def _resume_args(ckpt_dir, resume="auto"):
+    import argparse
+
+    return argparse.Namespace(resume=resume, checkpoint_dir=str(ckpt_dir))
+
+
+def test_corrupt_latest_quarantined_falls_back(tmp_path, capsys):
+    """A truncated latest checkpoint is renamed *.corrupt and --resume
+    auto continues from the next-older epoch instead of aborting — the
+    crash-mid-write postmortem no longer needs a human to move a file."""
+    from pytorch_distributed_mnist_tpu.cli import _resume_supervised
+
+    state = fresh_state()
+    save_checkpoint(state, epoch=0, best_acc=0.5, is_best=True,
+                    directory=str(tmp_path))
+    save_checkpoint(state, epoch=1, best_acc=0.6, is_best=True,
+                    directory=str(tmp_path))
+    # torn write: valid zip prefix, garbage tail
+    good = (tmp_path / "checkpoint_1.npz").read_bytes()
+    (tmp_path / "checkpoint_1.npz").write_bytes(good[: len(good) // 3])
+
+    new_state, start_epoch, best_acc, path = _resume_supervised(
+        _resume_args(tmp_path), state)
+    assert start_epoch == 1  # fell back to epoch 0's file (epoch+1 == 1)
+    assert best_acc == 0.5
+    assert path.endswith("checkpoint_0.npz")
+    assert (tmp_path / "checkpoint_1.npz.corrupt").exists()
+    assert not (tmp_path / "checkpoint_1.npz").exists()
+    assert "quarantined corrupt checkpoint" in capsys.readouterr().out
+
+
+def test_all_checkpoints_corrupt_trains_fresh(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import _resume_supervised
+
+    state = fresh_state()
+    for e in range(2):
+        save_checkpoint(state, epoch=e, best_acc=0.1, is_best=False,
+                        directory=str(tmp_path))
+        (tmp_path / f"checkpoint_{e}.npz").write_bytes(b"not a zip at all")
+    _, start_epoch, best_acc, path = _resume_supervised(
+        _resume_args(tmp_path), state)
+    assert (start_epoch, best_acc, path) == (0, 0.0, "")
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["checkpoint_0.npz.corrupt", "checkpoint_1.npz.corrupt"]
+
+
+def test_corrupt_sharded_directory_quarantined(tmp_path):
+    """The .ckpt directory layout quarantines too (torn meta.json)."""
+    from pytorch_distributed_mnist_tpu.cli import _resume_supervised
+
+    state = fresh_state()
+    save_checkpoint(state, epoch=0, best_acc=0.3, is_best=False,
+                    directory=str(tmp_path))
+    save_checkpoint(state, epoch=1, best_acc=0.4, is_best=False,
+                    directory=str(tmp_path), layout="sharded")
+    meta = tmp_path / "checkpoint_1.ckpt" / "meta.json"
+    meta.write_text(meta.read_text()[:10])  # torn JSON
+
+    _, start_epoch, _, path = _resume_supervised(
+        _resume_args(tmp_path), state)
+    assert start_epoch == 1 and path.endswith("checkpoint_0.npz")
+    assert (tmp_path / "checkpoint_1.ckpt.corrupt").is_dir()
+
+
+def test_explicit_resume_path_never_quarantined(tmp_path):
+    """Quarantine is an auto-mode policy: an explicitly named corrupt
+    checkpoint must abort loudly and stay on disk for the postmortem."""
+    from pytorch_distributed_mnist_tpu.cli import _resume_supervised
+
+    state = fresh_state()
+    save_checkpoint(state, epoch=0, best_acc=0.1, is_best=False,
+                    directory=str(tmp_path))
+    target = tmp_path / "checkpoint_0.npz"
+    target.write_bytes(b"garbage")
+    with pytest.raises(Exception):
+        _resume_supervised(_resume_args(tmp_path, resume=str(target)),
+                           state)
+    assert target.exists()  # evidence untouched
+    assert not (tmp_path / "checkpoint_0.npz.corrupt").exists()
+
+
+def test_model_mismatch_is_not_corruption(tmp_path):
+    """A checkpoint that loads but does not FIT (leaf-count mismatch —
+    the user changed --model) must abort, not be quarantined: renaming a
+    good checkpoint would destroy training history."""
+    from pytorch_distributed_mnist_tpu.cli import _resume_supervised
+
+    state = fresh_state()
+    save_checkpoint(state, epoch=0, best_acc=0.1, is_best=False,
+                    directory=str(tmp_path))
+    other = create_train_state(get_model("cnn"), jax.random.key(0))
+    with pytest.raises(ValueError, match="mismatch"):
+        _resume_supervised(_resume_args(tmp_path), other)
+    assert (tmp_path / "checkpoint_0.npz").exists()
+
+
+def test_is_corrupt_checkpoint_error_classification():
+    import json as _json
+    import zipfile
+    import zlib
+
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        is_corrupt_checkpoint_error,
+    )
+
+    assert is_corrupt_checkpoint_error(zipfile.BadZipFile("x"))
+    assert is_corrupt_checkpoint_error(zlib.error("x"))
+    assert is_corrupt_checkpoint_error(EOFError())
+    assert is_corrupt_checkpoint_error(KeyError("__meta__"))
+    assert is_corrupt_checkpoint_error(
+        _json.JSONDecodeError("x", "doc", 0))
+    # NOT corruption: the caller is wrong, the file is fine.
+    assert not is_corrupt_checkpoint_error(
+        ValueError("checkpoint has 4 leaves, current state has 8 — "
+                   "model/optimizer mismatch"))
+    assert not is_corrupt_checkpoint_error(
+        ValueError("leaf x shape (3,) != expected (4,)"))
+    assert not is_corrupt_checkpoint_error(RuntimeError("unrelated"))
+    # NOT corruption: absence-level signals — a published directory was
+    # complete at publish time, so a missing member at resume time is
+    # far more likely a stale NFS view than damage; quarantining on it
+    # would destroy the newest good checkpoint (review finding).
+    assert not is_corrupt_checkpoint_error(FileNotFoundError("meta.json"))
+    assert not is_corrupt_checkpoint_error(
+        ValueError("leaf params is missing shards (3/9 elements present)"))
+
+
+def test_quarantine_checkpoint_numbered_on_collision(tmp_path):
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        latest_checkpoint,
+        quarantine_checkpoint,
+    )
+
+    for _ in range(2):
+        p = tmp_path / "checkpoint_3.npz"
+        p.write_bytes(b"bad")
+        quarantine_checkpoint(str(p))
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["checkpoint_3.npz.corrupt", "checkpoint_3.npz.corrupt2"]
+    # quarantined names are invisible to resolution and pruning
+    assert latest_checkpoint(str(tmp_path)) is None

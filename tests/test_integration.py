@@ -106,21 +106,27 @@ def test_multihost_presence_decision_is_agreed_without_download(
     --download — otherwise a host missing the IDX files either falls back
     to synthetic alone (silent cross-host data divergence) or raises
     SystemExit alone while its peers hang at the next collective.
-    Hermetic twin: process_count/allgather stubbed to simulate a 2-host
-    job where the peer host lacks the files."""
+    Hermetic twin: process_count/allgather stubbed (on the supervision
+    record channel the agreement now rides) to simulate a 2-host job
+    where the peer host lacks the files."""
     import numpy as np
-    from jax.experimental import multihost_utils
 
     from pytorch_distributed_mnist_tpu import cli
+    from pytorch_distributed_mnist_tpu.runtime import supervision as sup
 
     monkeypatch.setattr(cli, "process_count", lambda: 2)
+    monkeypatch.setattr(sup, "process_count", lambda: 2)
+    monkeypatch.setattr(sup, "process_index", lambda: 0)
     calls = []
 
     def fake_allgather(x):
         calls.append(np.asarray(x))
-        return np.concatenate([np.asarray(x), np.asarray([False])])
+        peer = np.frombuffer(
+            sup._encode_record(sup._ERR, "files missing on host 1"),
+            np.uint8)
+        return np.stack([np.asarray(x), peer])
 
-    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    monkeypatch.setattr(sup, "_raw_allgather", fake_allgather)
 
     from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
 

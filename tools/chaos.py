@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Fault-injection (chaos) harness for the run-supervision subsystem.
+
+Drives the same local N-process world as ``tpu-mnist --spawn`` with ONE
+process sabotaged at a named fault point (``runtime/supervision.py``'s
+``TPUMNIST_FAULT=point:host:kind[:arg]`` hook), so the agreed-exit
+protocol and the collective watchdogs can be exercised against real
+process deaths instead of monkeypatches:
+
+    # what can be injected, and where each point fires
+    python tools/chaos.py --list
+
+    # SIGKILL host 0 right before the checkpoint publish agreement;
+    # host 1 must exit with PeerFailure within the deadline, not hang
+    python tools/chaos.py --fault ckpt_publish:0:kill --nprocs 2 \\
+        --agreement-timeout 10 -- \\
+        --dataset synthetic --model linear --epochs 2 \\
+        --optimizer-sharding zero1 --trainer-mode stepwise
+
+    # then prove recovery: the same world, no fault, resumes
+    python tools/chaos.py --nprocs 2 -- --dataset synthetic \\
+        --model linear --epochs 2 --optimizer-sharding zero1 \\
+        --trainer-mode stepwise --resume auto
+
+Exit code: 0 when every rank exited 0 (only meaningful for no-fault
+runs); otherwise the first failing rank's code (killed ranks surface as
+128+signal). tests/test_chaos.py runs these scenarios with assertions;
+this tool is the operator-facing way to reproduce one interactively.
+
+``--list`` is the drift gate: tests/test_supervision.py pins that its
+output, the ``FAULT_POINTS`` registry, and the ``maybe_fault()`` call
+sites in the source all agree — a hook added without registry+docs (or
+vice versa) fails the suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pytorch_distributed_mnist_tpu.parallel.launcher import (  # noqa: E402
+    spawn_local,
+)
+from pytorch_distributed_mnist_tpu.runtime.supervision import (  # noqa: E402
+    FAULT_ENV,
+    FAULT_POINTS,
+    TIMEOUT_ENV,
+    FaultPlan,
+)
+
+
+def list_fault_points(file=sys.stdout) -> None:
+    """One line per injectable point: ``name<TAB>description``."""
+    for name in sorted(FAULT_POINTS):
+        print(f"{name}\t{FAULT_POINTS[name]}", file=file)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="chaos",
+        description="fault-injection twins for the run-supervision layer",
+    )
+    p.add_argument("--list", action="store_true",
+                   help="enumerate injectable fault points and exit")
+    p.add_argument("--fault", type=str, default=None,
+                   metavar="POINT:HOST:KIND[:ARG]",
+                   help="the fault to inject (see --list; kinds: kill, "
+                        "raise, stall). Omit for a clean control run")
+    p.add_argument("--nprocs", type=int, default=2,
+                   help="local host processes to spawn (default 2)")
+    p.add_argument("--agreement-timeout", type=float, default=15.0,
+                   help="watchdog deadline handed to every rank via "
+                        f"{TIMEOUT_ENV} (default 15s: chaos runs WANT "
+                        "the watchdog — a hang is the bug under test)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="whole-run wall clock bound before every rank "
+                        "is killed (default 600s)")
+    p.add_argument("cli_args", nargs=argparse.REMAINDER,
+                   help="arguments after -- go to tpu-mnist verbatim")
+    args = p.parse_args(argv)
+
+    if args.list:
+        list_fault_points()
+        return 0
+
+    if args.fault:
+        FaultPlan.parse(args.fault)  # fail fast with the spec's message
+        os.environ[FAULT_ENV] = args.fault
+    else:
+        os.environ.pop(FAULT_ENV, None)
+    os.environ[TIMEOUT_ENV] = str(args.agreement_timeout)
+
+    cli_args = list(args.cli_args)
+    if cli_args and cli_args[0] == "--":
+        cli_args = cli_args[1:]
+    print(f"chaos: spawning {args.nprocs} ranks"
+          + (f", fault {args.fault}" if args.fault else " (control run)")
+          + f", agreement timeout {args.agreement_timeout:g}s",
+          file=sys.stderr)
+    return spawn_local(args.nprocs, cli_args, timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
