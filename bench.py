@@ -842,6 +842,163 @@ def main_vit() -> None:
         sys.exit(1)
 
 
+SERVE_REQUESTS = 2000
+SERVE_CONCURRENCY = 16
+
+
+def main_serve() -> None:
+    """``--mode serve``: the serving trajectory's BENCH line.
+
+    Drives the real serving stack — bucketed AOT
+    :class:`InferenceEngine` + :class:`MicroBatcher` — in process
+    (closed-loop worker threads submitting straight to the batcher, no
+    sockets), so the line measures micro-batching + device forward
+    throughput/latency rather than Python's HTTP server. Emits ONE JSON
+    line: requests/sec headline, p50/p95/p99 latency, the batch-size
+    histogram, and the zero-steady-state-recompiles invariant checked
+    via ``CompileLog``. Never raises; failures become an ``error`` line
+    (the always-emit-JSON contract the training bench follows).
+    """
+    out = {
+        "metric": "mnist_serve_requests_per_sec",
+        "unit": "requests/sec",
+        "baseline": "same engine, batching disabled (bucket-1 program "
+                    "per request): vs_baseline is the micro-batching "
+                    "speedup",
+    }
+    try:
+        import jax
+
+        configure_jax(jax, force_cpu=bool(os.environ.get("BENCH_FORCE_CPU")))
+
+        import threading
+
+        from pytorch_distributed_mnist_tpu.data.mnist import synthetic_dataset
+        from pytorch_distributed_mnist_tpu.models import get_model
+        from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher
+        from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+        from pytorch_distributed_mnist_tpu.train.state import create_train_state
+        from pytorch_distributed_mnist_tpu.utils.profiling import (
+            ServeLog,
+            compile_log,
+        )
+
+        device = jax.devices()[0]
+        import jax.numpy as jnp
+
+        # Same backend policy as the training bench: bf16 MXU path on
+        # TPU, f32 on the CPU fallback.
+        model = get_model(
+            "cnn", **({} if device.platform == "tpu"
+                      else {"compute_dtype": jnp.float32}))
+        state = create_train_state(model, jax.random.key(0))
+        serve_log = ServeLog()
+        engine = InferenceEngine(model.apply, state.params,
+                                 serve_log=serve_log)
+        compile_log.reset()
+        t0 = time.perf_counter()
+        engine.warmup()
+        warmup_s = time.perf_counter() - t0
+        totals_after_warmup = dict(compile_log.stats()["totals"])
+
+        images, _ = synthetic_dataset(64, seed=0)
+        stacks = [engine.preprocess(images[i:i + 1]) for i in range(16)]
+
+        requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                      SERVE_REQUESTS))
+        concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY",
+                                         SERVE_CONCURRENCY))
+
+        drive_errors: list = []
+
+        def drive(requests_n: int) -> float:
+            counter = {"next": 0}
+            lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with lock:
+                        i = counter["next"]
+                        if i >= requests_n:
+                            return
+                        counter["next"] = i + 1
+                    try:
+                        batcher.predict(stacks[i % len(stacks)])
+                    except Exception as exc:  # noqa: BLE001
+                        # A silently-dead worker would let the drive
+                        # finish with unserved requests counted into the
+                        # headline; collect and fail the line instead.
+                        drive_errors.append(repr(exc))
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(concurrency)]
+            t = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            return time.perf_counter() - t
+
+        with MicroBatcher(engine.predict, max_batch=engine.max_batch,
+                          max_wait_s=0.002, max_queue=4 * concurrency,
+                          serve_log=serve_log) as batcher:
+            drive(max(64, requests // 10))  # warm the path end to end
+            serve_log.reset()
+            wall = drive(requests)
+
+        totals_after_load = dict(compile_log.stats()["totals"])
+        zero_recompiles = (
+            totals_after_load["backend_compiles"]
+            == totals_after_warmup["backend_compiles"])
+        snap = serve_log.snapshot()
+
+        # Baseline twin: batching off — every request runs the bucket-1
+        # program alone through a max_batch=1 batcher.
+        with MicroBatcher(engine.predict, max_batch=1, max_wait_s=0.0,
+                          max_queue=4 * concurrency) as batcher:
+            baseline_wall = drive(requests)
+
+        value = requests / wall
+        out.update({
+            "value": round(value, 1),
+            "vs_baseline": round(value / (requests / baseline_wall), 3),
+            "requests": requests,
+            "concurrency": concurrency,
+            "p50_ms": snap["latency_ms"]["p50"],
+            "p95_ms": snap["latency_ms"]["p95"],
+            "p99_ms": snap["latency_ms"]["p99"],
+            "batch_histogram": snap["batch_histogram"],
+            "buckets": list(engine.buckets),
+            "rejected": snap["rejected"],
+            "warmup_compile_s": round(warmup_s, 3),
+            "zero_steady_state_recompiles": zero_recompiles,
+            "backend": device.platform,
+            "device_kind": device.device_kind,
+            "n_chips": jax.device_count(),
+            "compile_stats": compile_log.stats(),
+        })
+        # The measured drive really served every request (phantom
+        # completions would inflate the headline), and nothing failed.
+        served_all = snap["requests"] == requests
+        ok = zero_recompiles and not drive_errors and served_all
+        if not zero_recompiles:
+            out["error"] = ("steady-state serving recompiled: "
+                            f"{totals_after_warmup} -> {totals_after_load}")
+        elif drive_errors:
+            out["error"] = (f"{len(drive_errors)} requests failed during "
+                            f"the drive: {drive_errors[:3]}")
+        elif not served_all:
+            out["error"] = (f"served {snap['requests']} of {requests} "
+                            f"requests")
+    except Exception as exc:  # noqa: BLE001 - bench must always emit JSON
+        out.update({"value": 0.0, "vs_baseline": 0.0, "error": repr(exc)})
+        ok = False
+    out["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(json.dumps(out))
+    if not ok:
+        sys.exit(1)
+
+
 def bench_torch_reference() -> float:
     """Reference-style per-batch torch loop (same CNN, Adam), CPU."""
     import torch
@@ -962,7 +1119,23 @@ if __name__ == "__main__":
             print(json.dumps({"ok": False, "error": repr(exc)}))
             sys.exit(1)
         sys.exit(0)
-    if "--vit" in sys.argv[1:]:
+    argv = sys.argv[1:]
+    mode = None
+    if "--mode" in argv:
+        idx = argv.index("--mode")
+        # A bare trailing --mode must error, not silently run the
+        # multi-minute training bench (empty $MODE in a CI invocation).
+        mode = argv[idx + 1] if idx + 1 < len(argv) else "(missing)"
+    else:
+        mode = next((a.split("=", 1)[1] for a in argv
+                     if a.startswith("--mode=")), None)
+    if mode == "serve":
+        main_serve()
+    elif mode not in (None, "train"):
+        print(json.dumps({"error": f"unknown --mode {mode!r}; "
+                                   f"expected train or serve"}))
+        sys.exit(2)
+    elif "--vit" in argv:
         main_vit()
     else:
         main()

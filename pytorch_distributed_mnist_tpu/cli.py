@@ -261,9 +261,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "matrix")
     p.add_argument("--checkpoint-dir", type=str, default="checkpoints")
     p.add_argument("--keep-last", type=int, default=0, metavar="N",
-                   help="retain only the N newest per-epoch checkpoints "
-                        "(model_best is never pruned); 0 keeps every "
-                        "epoch's file, the reference's behavior (:267-268)")
+                   help="prune per-epoch checkpoints more than N epochs "
+                        "older than the latest published one (model_best "
+                        "is never pruned); 0 keeps every epoch's file, "
+                        "the reference's behavior (:267-268). The window "
+                        "is keyed to the latest PUBLISHED epoch so a "
+                        "serve process hot-reloading from this directory "
+                        "can never have its in-progress load deleted "
+                        "(train/checkpoint.py ordering guarantee)")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="write checkpoints on a background thread, "
                         "overlapping file I/O with the next epoch "
@@ -764,6 +769,18 @@ def _run_body(args, epoch_callback=None) -> dict:
     agreement_timeout = supervision.configure(
         getattr(args, "agreement_timeout", None))
     failure_events.reset()
+    # The shared JSONL sink (utils/profiling.py): per-epoch metric rows,
+    # supervision/failure events, and — in a serve process sharing the
+    # flag — serving stats all append to ONE file in one format. Attached
+    # directly after the reset so even resume-time events (checkpoint
+    # quarantines) reach the stream.
+    metrics_sink = None
+    metrics_file = getattr(args, "metrics_file", None)
+    if metrics_file and process_index() == 0:
+        from pytorch_distributed_mnist_tpu.utils.profiling import JsonlSink
+
+        metrics_sink = JsonlSink(metrics_file)
+        failure_events.set_sink(metrics_sink, source="train")
     if agreement_timeout:
         log0(f"agreement watchdog: {agreement_timeout:g}s deadline")
     log0(args)  # startup args print parity (:337)
@@ -1310,16 +1327,6 @@ def _run_body(args, epoch_callback=None) -> dict:
         )
 
         saver = AsyncCheckpointer()
-    metrics_file = getattr(args, "metrics_file", None)
-    if metrics_file and process_index() == 0:
-        import json as _json
-        import os as _os2
-
-        parent = _os2.path.dirname(metrics_file)
-        if parent:
-            _os2.makedirs(parent, exist_ok=True)
-    else:
-        metrics_file = None
     from contextlib import nullcontext
 
     # The saver as context manager: a clean exit waits for the last write
@@ -1376,17 +1383,16 @@ def _run_body(args, epoch_callback=None) -> dict:
                             "train_acc": train_acc.accuracy,
                             "test_loss": test_loss.average,
                             "test_acc": test_acc.accuracy})
-            if metrics_file:
-                with open(metrics_file, "a") as f:
-                    f.write(_json.dumps({
-                        **history[-1], "lr": lr_of(epoch),
-                        "best_acc": best_acc,
-                        # THIS epoch's train rate, not the cumulative
-                        # average (epoch 0's compile would drag it down).
-                        "images_per_sec": timer.last_images_per_sec,
-                        "dataset": ("synthetic" if dataset_synthesized
-                                    else args.dataset),
-                    }) + "\n")
+            if metrics_sink is not None:
+                metrics_sink.write({
+                    **history[-1], "lr": lr_of(epoch),
+                    "best_acc": best_acc,
+                    # THIS epoch's train rate, not the cumulative
+                    # average (epoch 0's compile would drag it down).
+                    "images_per_sec": timer.last_images_per_sec,
+                    "dataset": ("synthetic" if dataset_synthesized
+                                else args.dataset),
+                })
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break
     supervision.set_phase("shutdown")
@@ -1426,6 +1432,18 @@ def main(argv: Optional[list] = None) -> None:
     import sys as _sys
 
     argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # The serving subsystem: `tpu-mnist serve --checkpoint-dir ...`
+        # boots the bucketed AOT inference engine + micro-batcher + hot
+        # reload watcher over a training run's checkpoint directory
+        # (serve/server.py). A subcommand, not a flag: serving has its
+        # own flag surface and lifecycle (a process that never exits).
+        from pytorch_distributed_mnist_tpu.serve.server import (
+            main as serve_main,
+        )
+
+        serve_main(argv[1:])
+        return
     args = build_parser().parse_args(argv)
     if args.spawn:
         if args.spawn < 2:

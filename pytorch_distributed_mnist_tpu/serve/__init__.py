@@ -1,0 +1,32 @@
+"""Serving subsystem: request-level inference decoupled from training.
+
+The training side of this framework runs epochs; this package runs
+REQUESTS — the north-star's "serves heavy traffic" capability. Pieces:
+
+- ``engine.py``: :class:`InferenceEngine` — params + a fixed set of
+  AOT-compiled forward programs at batch buckets (pad up, never
+  recompile), built on the same forward-program builder ``--evaluate``
+  uses (``train/steps.py make_forward_program``);
+- ``batcher.py``: :class:`MicroBatcher` — dynamic micro-batching with a
+  max-wait deadline, max-batch coalescing, and bounded-queue admission
+  control (:class:`Overloaded` instead of unbounded latency);
+- ``reload.py``: :class:`CheckpointWatcher` — polls a published
+  checkpoint directory (``train/checkpoint.py`` conventions) and swaps
+  params atomically between batches;
+- ``server.py``: the ``serve`` CLI subcommand — a stdlib HTTP JSON
+  endpoint with ``/predict``, ``/healthz``, ``/stats``.
+
+Drive it with ``tools/loadgen.py``; measure it with
+``python bench.py --mode serve``.
+"""
+
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
+from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
+
+__all__ = [
+    "CheckpointWatcher",
+    "InferenceEngine",
+    "MicroBatcher",
+    "Overloaded",
+]

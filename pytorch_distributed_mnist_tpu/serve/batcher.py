@@ -1,0 +1,268 @@
+"""Dynamic micro-batching with a deadline and admission control.
+
+The latency/throughput trade at the heart of serving: a single request
+underfills even the smallest useful device batch, but waiting forever to
+fill the largest one destroys tail latency. The batcher holds a
+thread-safe queue; one worker thread coalesces whatever arrives within
+``max_wait`` of the OLDEST waiting request — or until ``max_batch`` rows
+are ready, whichever is first — and runs the engine once per formed
+batch. Device work is serialized on the worker by construction (the
+chips are one shared resource; concurrent forwards would only contend).
+
+Overload is explicit, not emergent: the queue is bounded (``max_queue``
+requests), and a submit against a full queue raises :class:`Overloaded`
+immediately — the caller (HTTP layer) turns that into a 503. Without the
+bound, a stalled or slow engine converts overload into unbounded queue
+growth and minutes-long latency for every request already in line, which
+is strictly worse than telling new arrivals to back off.
+
+Per-request accounting: enqueue->batch-formed (queue wait) and
+enqueue->result (total latency) land in the :class:`ServeLog` the server
+exposes at ``/stats``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class Overloaded(RuntimeError):
+    """Admission control: the request queue is at capacity; back off."""
+
+
+class _Pending:
+    """One submitted request riding the queue."""
+
+    __slots__ = ("images", "rows", "event", "result", "error", "t_submit",
+                 "t_batched", "abandoned")
+
+    def __init__(self, images: np.ndarray, rows: int) -> None:
+        self.images = images
+        self.rows = rows
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_batched = self.t_submit
+        # Set by a caller whose result() wait timed out: still-queued
+        # abandoned requests are dropped before execution (no device work
+        # for an answer nobody will read, no phantom /stats samples, and
+        # the queue slot frees for admission control).
+        self.abandoned = False
+
+    def finish(self, result: Optional[np.ndarray],
+               error: Optional[BaseException], serve_log) -> None:
+        self.result = result
+        self.error = error
+        if serve_log is not None and not self.abandoned:
+            now = time.perf_counter()
+            serve_log.record_request(
+                latency_s=now - self.t_submit,
+                queue_wait_s=self.t_batched - self.t_submit,
+                images=self.rows,
+            )
+        self.event.set()
+
+
+class MicroBatcher:
+    """Coalesces concurrent requests into engine-sized batches.
+
+    ``infer_fn(images) -> outputs`` maps a float/uint8 row-stack to a
+    per-row output stack (first dims equal); the engine's ``predict`` is
+    the production value, but any callable works — the unit tests drive
+    the state machine with stubs, no device or socket required.
+    """
+
+    def __init__(
+        self,
+        infer_fn: Callable[[np.ndarray], np.ndarray],
+        max_batch: int,
+        max_wait_s: float = 0.005,
+        max_queue: int = 256,
+        serve_log=None,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.infer_fn = infer_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        self.serve_log = serve_log
+        self._cv = threading.Condition()
+        self._queue: List[_Pending] = []
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        if serve_log is not None:
+            serve_log.set_queue_depth_probe(self.queue_depth)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serve-batcher")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the worker; queued requests are drained first so a clean
+        shutdown never strands a caller blocked on ``result``."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, images) -> _Pending:
+        """Enqueue one request. ``images`` must be a row-stack whose first
+        dim is the example count (the server preprocesses through
+        ``engine.preprocess`` first, so row counting and concatenation
+        are unambiguous); any row count is accepted — oversized batches
+        ride alone and the engine chunks them. Raises :class:`Overloaded`
+        when the queue is at capacity — admission control happens HERE,
+        before any work is done for the request."""
+        arr = np.asarray(images)
+        if arr.ndim < 2 or arr.shape[0] == 0:
+            raise ValueError(
+                f"submit expects a non-empty (rows, ...) stack of "
+                f"examples; got shape {arr.shape}")
+        pending = _Pending(arr, int(arr.shape[0]))
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("batcher is shut down")
+            if len(self._queue) >= self.max_queue:
+                if self.serve_log is not None:
+                    self.serve_log.record_rejection()
+                raise Overloaded(
+                    f"request queue full ({self.max_queue} pending)")
+            self._queue.append(pending)
+            self._cv.notify_all()
+        return pending
+
+    @staticmethod
+    def result(pending: _Pending, timeout: Optional[float] = None):
+        if not pending.event.wait(timeout):
+            # Nobody will read the answer: if the request is still
+            # queued, the worker drops it instead of executing it (an
+            # already in-flight batch can't be recalled from the device).
+            pending.abandoned = True
+            raise TimeoutError("request did not complete in time")
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def predict(self, images, timeout: Optional[float] = 30.0):
+        """Synchronous submit + wait — the HTTP handler's one call."""
+        return self.result(self.submit(images), timeout)
+
+    # -- worker side -------------------------------------------------------
+
+    def _take_batch(self) -> List[_Pending]:
+        """Block until work exists, then coalesce under the deadline.
+
+        The deadline is anchored to the OLDEST request's submit time, so
+        a trickle of arrivals cannot postpone the flush indefinitely; a
+        full ``max_batch`` flushes immediately. Returns ``[]`` only when
+        stopped with an empty queue."""
+        def takeable_rows() -> int:
+            """Rows the take loop below would ACTUALLY co-batch right
+            now — same walk, same no-split rule, skipping abandoned
+            entries. The flush trigger must use this, not a raw sum: a
+            1-row request followed by an oversized one would otherwise
+            'fill' the batch on paper and flush the 1-row alone with
+            coalescing time still on the clock."""
+            rows = 0
+            for p in self._queue:
+                if p.abandoned:
+                    continue
+                if rows and rows + p.rows > self.max_batch:
+                    break
+                rows += p.rows
+                if rows >= self.max_batch:
+                    break
+            return rows
+
+        with self._cv:
+            while True:  # until a non-empty take, or stopped + drained
+                while not self._queue and not self._stopped:
+                    self._cv.wait()
+                if not self._queue:
+                    return []
+                deadline = self._queue[0].t_submit + self.max_wait_s
+                while not self._stopped:
+                    remaining = deadline - time.perf_counter()
+                    if takeable_rows() >= self.max_batch or remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                taken, rows = [], 0
+                while self._queue and rows < self.max_batch:
+                    head = self._queue[0]
+                    if head.abandoned:
+                        # Its caller timed out and left: drop without
+                        # executing (finish() skips stats for abandoned).
+                        self._queue.pop(0)
+                        head.finish(None, TimeoutError("abandoned"),
+                                    self.serve_log)
+                        continue
+                    # Never split one request across batches: results map
+                    # back by whole slices. A request bigger than
+                    # max_batch rides alone (the engine chunks it through
+                    # the top bucket).
+                    if taken and rows + head.rows > self.max_batch:
+                        break
+                    self._queue.pop(0)
+                    taken.append(head)
+                    rows += head.rows
+                if not taken:
+                    continue  # everything seen was abandoned: wait again
+                t = time.perf_counter()
+                for p in taken:
+                    p.t_batched = t
+                return taken
+
+    def _run_batch(self, taken: List[_Pending]) -> None:
+        images = (taken[0].images if len(taken) == 1
+                  else np.concatenate([p.images for p in taken], axis=0))
+        try:
+            out = np.asarray(self.infer_fn(images))
+        except BaseException as exc:  # noqa: BLE001 - delivered per request
+            for p in taken:
+                p.finish(None, exc, self.serve_log)
+            return
+        if out.shape[0] != sum(p.rows for p in taken):
+            exc = RuntimeError(
+                f"infer_fn returned {out.shape[0]} rows for "
+                f"{sum(p.rows for p in taken)} inputs")
+            for p in taken:
+                p.finish(None, exc, self.serve_log)
+            return
+        off = 0
+        for p in taken:
+            p.finish(out[off:off + p.rows], None, self.serve_log)
+            off += p.rows
+
+    def _loop(self) -> None:
+        while True:
+            taken = self._take_batch()
+            if not taken:
+                return  # stopped and drained
+            self._run_batch(taken)

@@ -1,0 +1,222 @@
+"""Bucketed AOT inference engine.
+
+Serving on TPU has one cardinal rule: a request must NEVER trigger an XLA
+compile. A compile is 20-40 s of wall-clock on a real chip — against a
+p99 budget of milliseconds — and jit keys programs by input shape, so a
+naive ``jit(forward)(params, batch)`` recompiles for every distinct batch
+size the batcher happens to form. The engine therefore owns a FIXED set
+of batch buckets (default 1/8/32/128), AOT-compiles one forward program
+per bucket at startup (``.lower().compile()`` through the same
+``precompile`` path the trainer uses, so compiles land in ``CompileLog``
+and the persistent cache applies), and pads every batch up to the
+nearest bucket. Steady-state serving touches only those executables:
+zero recompiles, asserted by test via ``CompileLog``.
+
+The forward program is built by ``train/steps.py make_forward_program``
+— the SAME builder the ``-e/--evaluate`` eval step traces — so serving
+can never disagree with evaluation on forward math or dtype policy, and
+preprocessing goes through the same ``normalize_images`` the training
+loaders use. Params are an explicit argument of the compiled programs
+(not a closure capture), which is what makes checkpoint hot-reload free:
+``swap_params`` is an atomic reference swap between batches; an in-flight
+batch keeps the params it captured at call entry, the next batch sees the
+new ones, and no executable is invalidated.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.data.mnist import normalize_images
+from pytorch_distributed_mnist_tpu.train.steps import (
+    abstract_spec,
+    make_forward_program,
+    precompile,
+)
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class InferenceEngine:
+    """Params + one AOT-compiled forward executable per batch bucket.
+
+    Threading contract: ``logits``/``predict`` are called from ONE thread
+    at a time (the batcher worker serializes device work — concurrent
+    forward calls would just contend for the same chips); ``swap_params``
+    may be called from any thread (the reload watcher) at any moment.
+    The only shared mutable state is the params reference, read once per
+    batch under the lock.
+    """
+
+    def __init__(
+        self,
+        apply_fn,
+        params,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        input_shape: Tuple[int, ...] = (28, 28, 1),
+        serve_log=None,
+        params_epoch: Optional[int] = None,
+    ) -> None:
+        buckets = sorted({int(b) for b in buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        self.buckets = tuple(buckets)
+        self.input_shape = tuple(input_shape)
+        self.serve_log = serve_log
+        self._forward = make_forward_program(apply_fn)
+        self._jit = jax.jit(self._forward)  # lazy fallback, identical program
+        self._lock = threading.Lock()
+        # Committed to device once per swap, not once per request.
+        self._params = jax.device_put(params)
+        self._params_epoch = params_epoch
+        self._compiled = {}  # bucket -> Compiled executable
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def params_epoch(self) -> Optional[int]:
+        with self._lock:
+            return self._params_epoch
+
+    def warmup(self) -> None:
+        """AOT-compile every bucket's forward program (idempotent).
+
+        Each program is measured under ``serve_forward_b{bucket}`` in the
+        process ``CompileLog``, so startup cost is attributable per bucket
+        and the zero-steady-state-recompiles acceptance check has an
+        anchor to diff against. With a warm persistent compile cache these
+        degenerate to executable fetches.
+        """
+        with self._lock:
+            params_spec = abstract_spec(self._params)
+        for bucket in self.buckets:
+            if bucket in self._compiled:
+                continue
+            image_spec = jax.ShapeDtypeStruct(
+                (bucket,) + self.input_shape, np.float32)
+            self._compiled[bucket] = precompile(
+                self._jit, params_spec, image_spec,
+                program=f"serve_forward_b{bucket}")
+
+    def swap_params(self, params, epoch: Optional[int] = None,
+                    path: Optional[str] = None) -> None:
+        """Atomically install new params (checkpoint hot-reload); the
+        signature is exactly the reload watcher's ``on_params`` callback.
+
+        The device_put runs OUTSIDE the lock (it is the slow part); the
+        installed reference swap is what in-flight batches race against,
+        and they only ever read the reference once, at call entry.
+        """
+        del path  # provenance lives on the watcher (current_path)
+        placed = jax.device_put(params)
+        with self._lock:
+            self._params = placed
+            self._params_epoch = epoch
+
+    # -- inference ---------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n must not exceed the largest bucket —
+        ``logits`` chunks oversized batches before calling this)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch of {n} exceeds largest bucket "
+                         f"{self.max_batch}")
+
+    def preprocess(self, images: np.ndarray) -> np.ndarray:
+        """Raw request pixels -> the float32 normalized layout training
+        uses. Accepts uint8 ``(N, 28, 28)`` raw images (normalized with
+        the SAME ``normalize_images`` the training loaders apply) or
+        already-normalized float32 ``(N,) + input_shape`` arrays; a single
+        example may drop its leading axis either way."""
+        arr = np.asarray(images)
+        if arr.size == 0:
+            raise ValueError("at least one image required")
+        raw_shape = self.input_shape[:-1]  # e.g. (28, 28): pre-channel
+        if arr.dtype == np.uint8:
+            if arr.shape == raw_shape:
+                arr = arr[None]
+            if arr.ndim == len(raw_shape) + 1 and arr.shape[1:] == raw_shape:
+                return normalize_images(arr)
+        elif np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(np.float32, copy=False)
+            if arr.shape == self.input_shape:
+                arr = arr[None]
+            if arr.ndim == len(self.input_shape) + 1 \
+                    and arr.shape[1:] == self.input_shape:
+                return arr
+        raise ValueError(
+            f"expected uint8 (N, {', '.join(map(str, raw_shape))}) raw "
+            f"images or float32 (N, {', '.join(map(str, self.input_shape))})"
+            f" normalized images; got {arr.dtype} {arr.shape}")
+
+    def _run_bucket(self, params, images: np.ndarray) -> np.ndarray:
+        """One padded forward on one bucket executable; returns logits for
+        the real rows only."""
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if n < bucket:
+            pad = np.zeros((bucket - n,) + images.shape[1:], images.dtype)
+            images = np.concatenate([images, pad], axis=0)
+        compiled = self._compiled.get(bucket)
+        x = jax.numpy.asarray(images)
+        if compiled is not None:
+            out = compiled(params, x)
+        else:
+            # Lazy fallback (warmup skipped or failed): same program via
+            # jit — correctness preserved, the no-recompile guarantee is
+            # what warmup buys.
+            out = self._jit(params, x)
+        if self.serve_log is not None:
+            self.serve_log.record_batch(n, bucket)
+        return np.asarray(out)[:n]
+
+    def logits_with_epoch(self, images) -> Tuple[np.ndarray, Optional[int]]:
+        """Forward ``images`` (raw uint8 or normalized float32) through
+        the bucketed programs; returns ``(logits (N, classes), epoch)``
+        where ``epoch`` is the checkpoint epoch of the params that
+        ACTUALLY computed these logits — params and epoch are captured
+        together under the lock, so a hot reload landing mid-call can
+        never mislabel a batch's provenance. Batches larger than the top
+        bucket are chunked through it (one capture for all chunks)."""
+        x = self.preprocess(images)
+        with self._lock:
+            params = self._params  # captured ONCE: swap-atomicity boundary
+            epoch = self._params_epoch
+        out = []
+        for start in range(0, x.shape[0], self.max_batch):
+            out.append(self._run_bucket(params, x[start:start + self.max_batch]))
+        return np.concatenate(out, axis=0), epoch
+
+    def logits(self, images) -> np.ndarray:
+        return self.logits_with_epoch(images)[0]
+
+    def predict(self, images) -> np.ndarray:
+        """Class labels (int64) for ``images``. The argmax stays on the
+        host so the device program remains byte-identical to the eval
+        forward pass."""
+        return np.argmax(self.logits(images), axis=-1)
+
+    def predict_with_epoch(self, images) -> Tuple[np.ndarray, Optional[int]]:
+        logits, epoch = self.logits_with_epoch(images)
+        return np.argmax(logits, axis=-1), epoch
+
+
+def load_params_for_serving(path: str, template_state) -> Tuple[object, int]:
+    """Restore just ``(params, epoch)`` from a published checkpoint onto
+    ``template_state``'s layout — the serve-side restore used at boot and
+    by every hot reload. ``epoch`` is the checkpoint's own epoch number
+    (the file's ``checkpoint_{e}`` index), not the stored resume epoch."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import load_checkpoint
+
+    state, next_epoch, _best = load_checkpoint(path, template_state)
+    return state.params, next_epoch - 1

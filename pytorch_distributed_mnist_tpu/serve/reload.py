@@ -1,0 +1,145 @@
+"""Checkpoint hot-reload: a serve process tracking a live training run.
+
+The training CLI publishes ``checkpoint_{e}.npz`` / ``.ckpt`` atomically
+(tmp + rename, ``train/checkpoint.py``) and prunes with a window keyed to
+the latest published epoch — which is exactly what makes polling safe: a
+watcher that resolves ``latest_checkpoint()`` sees only fully-published
+files, and the one it starts loading survives at least ``--keep-last``
+further publishes (the ordering guarantee documented on
+``prune_checkpoints``). So a trainer and a serve process can share one
+checkpoint directory with no coordination channel beyond the filesystem.
+
+The watcher polls on its own daemon thread, loads through the SAME
+``load_checkpoint``-onto-template path resume uses (shape/leaf-count
+validation included — a checkpoint from a different model aborts the
+reload, not the server), and installs params via
+``engine.swap_params``-style callback: an atomic reference swap, so the
+in-flight batch finishes on the old params and the next batch sees the
+new ones. Failures are contained: a corrupt or vanished checkpoint is
+recorded (``serve_reload_failed`` in the stats/JSONL stream) and the
+server keeps answering on the params it has — serving availability never
+depends on the newest file being readable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from pytorch_distributed_mnist_tpu.train.checkpoint import latest_checkpoint
+
+
+class CheckpointWatcher:
+    """Polls ``directory`` and hands newly published params to ``on_params``.
+
+    ``on_params(params, epoch, path)`` runs on the watcher thread and must
+    be cheap + thread-safe (the engine's ``swap_params`` is both).
+    ``current_path`` marks the checkpoint already loaded at boot so the
+    first poll doesn't redundantly reload it. ``poll_once`` is public and
+    thread-free so tests drive the state machine deterministically.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        template_state,
+        on_params: Callable,
+        poll_interval_s: float = 2.0,
+        serve_log=None,
+        current_path: Optional[str] = None,
+    ) -> None:
+        self.directory = directory
+        self.poll_interval_s = float(poll_interval_s)
+        self.serve_log = serve_log
+        self._template = template_state
+        self._on_params = on_params
+        self._current = current_path
+        # Last path that failed to load: retried only once the listing
+        # moves past it, so one corrupt file can't hot-loop the log.
+        self._failed: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def current_path(self) -> Optional[str]:
+        return self._current
+
+    def poll_once(self) -> bool:
+        """One resolution + (maybe) reload; returns True when new params
+        were installed."""
+        path = latest_checkpoint(self.directory)
+        if not path or path == self._current or path == self._failed:
+            return False
+        from pytorch_distributed_mnist_tpu.serve.engine import (
+            load_params_for_serving,
+        )
+
+        try:
+            params, epoch = load_params_for_serving(path, self._template)
+        except Exception as exc:  # noqa: BLE001 - serving must survive
+            # Serving always survives a failed reload — but retry policy
+            # follows the PR-2 damage taxonomy
+            # (``is_corrupt_checkpoint_error``): content-level corruption
+            # and template mismatches (shape/leaf-count ValueErrors — the
+            # CALLER's model is wrong for this directory) are permanent
+            # for this file, so the path is remembered and only a NEWER
+            # publish is tried. Anything else (EIO off a flaky NFS
+            # export, a momentary device_put OOM) is transient: the next
+            # poll retries the same path, because after training's final
+            # publish no newer path will ever appear to clear a
+            # wrongly-pinned blacklist.
+            from pytorch_distributed_mnist_tpu.train.checkpoint import (
+                is_corrupt_checkpoint_error,
+            )
+
+            # _load_sharded's missing-shards ValueError is ABSENCE-level
+            # (a stale NFS readdir view of a directory whose atomic
+            # publish means it WAS complete) — the same reasoning
+            # is_corrupt_checkpoint_error documents for excluding it from
+            # quarantine. It must stay retryable here too.
+            stale_view = (isinstance(exc, ValueError)
+                          and "missing shards" in str(exc))
+            permanent = not stale_view and (
+                is_corrupt_checkpoint_error(exc)
+                or isinstance(exc, ValueError))
+            if permanent:
+                self._failed = path
+            if self.serve_log is not None:
+                self.serve_log.record_reload_failure(path, repr(exc))
+            policy = ("skipping until a newer checkpoint appears"
+                      if permanent else "will retry next poll")
+            print(f"serve reload: failed to load {path!r} ({policy}; "
+                  f"still serving current params): {exc!r}", flush=True)
+            return False
+        self._on_params(params, epoch, path)
+        self._current = path
+        self._failed = None
+        if self.serve_log is not None:
+            self.serve_log.record_reload(path, epoch)
+        print(f"serve reload: now serving {path!r} (epoch {epoch})",
+              flush=True)
+        return True
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serve-reload")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception as exc:  # noqa: BLE001 - watcher never dies
+                # poll_once already contains load errors; this catches
+                # listing-level surprises (directory deleted, EIO). The
+                # watcher thread must outlive them all.
+                print(f"serve reload: poll failed: {exc!r}", flush=True)

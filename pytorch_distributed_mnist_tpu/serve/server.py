@@ -1,0 +1,421 @@
+"""The ``serve`` CLI subcommand: a stdlib HTTP JSON inference endpoint.
+
+``python -m pytorch_distributed_mnist_tpu serve --checkpoint-dir ckpt
+--model cnn`` boots: model + template state, newest published checkpoint
+(or fresh init with a loud warning), the bucketed
+:class:`~pytorch_distributed_mnist_tpu.serve.engine.InferenceEngine`
+(all buckets AOT-compiled before the socket opens — a request can never
+pay a compile), the
+:class:`~pytorch_distributed_mnist_tpu.serve.batcher.MicroBatcher`, and
+the :class:`~pytorch_distributed_mnist_tpu.serve.reload.CheckpointWatcher`
+sharing the training run's checkpoint directory.
+
+Endpoints (stdlib ``http.server``; one handler thread per connection,
+all of them funneling into the single batcher worker that owns the
+device):
+
+- ``POST /predict`` — body ``{"images": ...}``: one 28x28 image or a
+  list of them, raw 0-255 pixel values. Replies
+  ``{"predictions": [...], "model_epoch": e, "latency_ms": t}``;
+  503 ``{"error": "overloaded"}`` under admission control.
+- ``GET /healthz`` — liveness + which checkpoint epoch is serving.
+- ``GET /stats`` — the ServeLog snapshot: p50/p95/p99 latency, queue
+  depth/waits, batch-size histogram, reload + rejection counters, and
+  the serve programs' compile stats (the zero-recompile evidence).
+
+The deliberately boring transport (no asyncio, no framework dep) is the
+point: the serving smarts live in engine/batcher/reload, which are all
+driveable in-process by tests and by ``bench.py --mode serve``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
+from pytorch_distributed_mnist_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    InferenceEngine,
+    load_params_for_serving,
+)
+from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
+from pytorch_distributed_mnist_tpu.utils.profiling import (
+    JsonlSink,
+    ServeLog,
+    compile_log,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-mnist serve",
+        description="JSON inference endpoint over a training run's "
+                    "checkpoint directory",
+        allow_abbrev=False,
+    )
+    p.add_argument("--checkpoint-dir", type=str, default="checkpoints",
+                   help="directory the training run publishes checkpoints "
+                        "into; the newest is served and newer ones are "
+                        "hot-reloaded as they appear")
+    p.add_argument("--model", type=str, default="cnn",
+                   help="model architecture the checkpoints belong to "
+                        "(must match training's --model; a mismatched "
+                        "checkpoint is rejected at load, not served)")
+    p.add_argument("--dtype", type=str, default=None, choices=["bf16", "f32"],
+                   help="compute dtype override, same semantics as "
+                        "training's --dtype")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 picks a free port (printed at startup)")
+    p.add_argument("--buckets", type=str,
+                   default=",".join(str(b) for b in DEFAULT_BUCKETS),
+                   help="comma-separated batch buckets, each AOT-compiled "
+                        "at startup; batches pad up to the nearest bucket "
+                        "so steady-state serving never recompiles")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="micro-batcher deadline: a request waits at most "
+                        "this long for co-riders before its batch flushes")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission control: pending requests beyond this "
+                        "are rejected with 503 instead of queuing "
+                        "unboundedly")
+    p.add_argument("--max-request-images", type=int, default=1024,
+                   help="reject /predict requests with more images than "
+                        "this (400): one giant request occupies a single "
+                        "queue slot, so without a bound it could "
+                        "monopolize the batcher past admission control — "
+                        "batch client-side instead")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="seconds between checkpoint-directory polls for "
+                        "hot reload")
+    p.add_argument("--no-reload", action="store_true",
+                   help="serve the boot-time checkpoint forever (no "
+                        "directory watching)")
+    p.add_argument("--require-checkpoint", action="store_true",
+                   help="refuse to start without a published checkpoint "
+                        "(default: warn and serve fresh-init params, "
+                        "hot-reloading the first checkpoint when it "
+                        "appears)")
+    p.add_argument("--compile-cache", type=str, default=None, metavar="DIR",
+                   help="persistent XLA compile cache (same resolution as "
+                        "training: flag > TPUMNIST_COMPILE_CACHE > repo "
+                        "default; '' disables) — a warm cache turns the "
+                        "startup bucket compiles into fetches")
+    p.add_argument("--metrics-file", type=str, default=None,
+                   help="append serve_stats / serve_reload JSONL lines "
+                        "here — the same format/flag as training, so one "
+                        "file can carry both sides of a shared run")
+    p.add_argument("--stats-interval", type=float, default=30.0,
+                   help="seconds between serve_stats lines to "
+                        "--metrics-file (0 disables periodic writes)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="fresh-init param seed when no checkpoint exists")
+    return p
+
+
+# One oversized body must not buy unbounded JSON parsing on a handler
+# thread; 16 MB comfortably fits --max-request-images' worth of pixels.
+MAX_BODY_BYTES = 16 << 20
+
+
+class ServeContext:
+    """Everything one serving process owns; built by :func:`create_server`
+    and shared with the HTTP handlers via the server object."""
+
+    def __init__(self, engine, batcher, watcher, serve_log, sink,
+                 model_name: str, boot_path: Optional[str] = None,
+                 max_request_images: int = 1024) -> None:
+        self.max_request_images = max_request_images
+        self.engine = engine
+        self.batcher = batcher
+        self.watcher = watcher
+        self.serve_log = serve_log
+        self.sink = sink
+        self.model_name = model_name
+        self.boot_path = boot_path
+        self.t_start = time.time()
+
+    @property
+    def checkpoint_path(self) -> Optional[str]:
+        """The checkpoint currently serving: the watcher's view when
+        reloading is on, else the boot-time restore."""
+        if self.watcher is not None:
+            return self.watcher.current_path
+        return self.boot_path
+
+    def close(self) -> None:
+        if self.watcher is not None:
+            self.watcher.stop()
+        self.batcher.close()
+        if self.sink is not None:
+            self.serve_log.write_stats(final=True)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Per-request stderr lines would swamp the log at serving rates.
+    def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+        pass
+
+    @property
+    def ctx(self) -> ServeContext:
+        return self.server.ctx  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            # The client gave up (short timeout under overload) and
+            # closed the socket: nobody is listening, and a per-request
+            # traceback from socketserver would be exactly the log spam
+            # the silenced log_message avoids.
+            pass
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib name
+        ctx = self.ctx
+        if self.path == "/healthz":
+            self._reply(200, {
+                "ok": True,
+                "model": ctx.model_name,
+                "model_epoch": ctx.engine.params_epoch,
+                "checkpoint": ctx.checkpoint_path,
+                "uptime_s": round(time.time() - ctx.t_start, 3),
+            })
+        elif self.path == "/stats":
+            stats = ctx.serve_log.snapshot()
+            compile_stats = compile_log.stats()
+            stats["compile"] = {
+                "programs": {
+                    name: rec for name, rec in
+                    compile_stats["programs"].items()
+                    if name.startswith("serve_forward_")
+                },
+                "totals": compile_stats["totals"],
+            }
+            stats["buckets"] = list(ctx.engine.buckets)
+            stats["model_epoch"] = ctx.engine.params_epoch
+            self._reply(200, stats)
+        else:
+            self._reply(404, {"error": f"no route {self.path!r}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib name
+        if self.path != "/predict":
+            self._reply(404, {"error": f"no route {self.path!r}"})
+            return
+        ctx = self.ctx
+        t0 = time.perf_counter()
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_BODY_BYTES:
+            # Refuse BEFORE reading/parsing: a multi-GB body must not buy
+            # memory and JSON-parse time on this handler thread.
+            self._reply(413, {"error": f"body over {MAX_BODY_BYTES} bytes;"
+                                       f" batch client-side"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            images = payload.get("images")
+            if images is None:
+                raise ValueError("body must be JSON {\"images\": ...}")
+            arr = np.asarray(images, dtype=np.float32)
+            # Raw 0-255 pixels over the wire; quantize to the exact uint8
+            # domain training reads from disk, then the engine applies
+            # the training normalize. One preprocessing path, no drift.
+            raw = np.clip(np.rint(arr), 0, 255).astype(np.uint8)
+            batch = ctx.engine.preprocess(raw)
+            if batch.shape[0] > ctx.max_request_images:
+                # One request = one queue slot: an unbounded row count
+                # would monopolize the batcher past admission control.
+                raise ValueError(
+                    f"{batch.shape[0]} images in one request (max "
+                    f"{ctx.max_request_images}); batch client-side")
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        try:
+            # Each output row is (label, epoch-of-the-params-that-
+            # computed-it) — see create_server's infer wrapper — so the
+            # reply can never attribute a batch to a checkpoint a
+            # concurrent hot reload installed after it ran.
+            out = ctx.batcher.predict(batch)
+        except Overloaded as exc:
+            self._reply(503, {"error": "overloaded", "detail": str(exc)})
+            return
+        except TimeoutError as exc:
+            self._reply(504, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - a request never kills the server
+            self._reply(500, {"error": repr(exc)})
+            return
+        epoch = int(out[0, 1])
+        self._reply(200, {
+            "predictions": [int(v) for v in out[:, 0]],
+            "model_epoch": None if epoch < 0 else epoch,
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+
+
+def _parse_buckets(spec: str):
+    try:
+        buckets = tuple(int(tok) for tok in spec.split(",") if tok.strip())
+    except ValueError:
+        raise SystemExit(f"--buckets must be comma-separated ints, "
+                         f"got {spec!r}") from None
+    if not buckets or min(buckets) < 1:
+        raise SystemExit(f"--buckets needs at least one positive size, "
+                         f"got {spec!r}")
+    return buckets
+
+
+def create_server(args) -> ThreadingHTTPServer:
+    """Build engine + batcher + watcher and bind the HTTP server (socket
+    bound, not yet serving — callers run ``serve_forever`` themselves, so
+    tests can boot on port 0 in-process). ``server.ctx.close()`` tears
+    the serving stack down."""
+    import jax
+
+    from pytorch_distributed_mnist_tpu.models import get_model, list_models
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        _epoch_checkpoints,
+    )
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+    from pytorch_distributed_mnist_tpu.utils import compile_cache
+
+    if args.model not in list_models():
+        raise SystemExit(f"unknown --model {args.model!r}; "
+                         f"available: {list_models()}")
+    cache_dir = compile_cache.configure(getattr(args, "compile_cache", None))
+    if cache_dir:
+        print(f"compile cache: {cache_dir}", flush=True)
+
+    model_kwargs = {}
+    if getattr(args, "dtype", None):
+        import jax.numpy as jnp
+
+        model_kwargs["compute_dtype"] = {
+            "bf16": jnp.bfloat16, "f32": jnp.float32}[args.dtype]
+    model = get_model(args.model, **model_kwargs)
+    template = create_train_state(model, jax.random.key(args.seed))
+
+    # Boot restore walks newest -> oldest: one corrupt latest file must
+    # not turn a server RESTART (the natural operator response to any
+    # incident) into a total outage — the same availability stance the
+    # hot-reload watcher takes, and the serving analog of --resume auto's
+    # fall-back-to-next-older (quarantining stays the trainer's job).
+    boot_path, params, epoch = None, None, None
+    for _, candidate in reversed(_epoch_checkpoints(args.checkpoint_dir)):
+        try:
+            params, epoch = load_params_for_serving(candidate, template)
+            boot_path = candidate
+            break
+        except Exception as exc:  # noqa: BLE001 - keep walking older epochs
+            print(f"WARNING: cannot serve checkpoint {candidate!r} "
+                  f"({exc!r}); trying the next-older epoch", flush=True)
+    if boot_path is not None:
+        print(f"serving checkpoint {boot_path!r} (epoch {epoch})",
+              flush=True)
+    elif getattr(args, "require_checkpoint", False):
+        raise SystemExit(
+            f"--require-checkpoint: no loadable published checkpoint in "
+            f"{args.checkpoint_dir!r}")
+    else:
+        params, epoch = template.params, None
+        print(f"WARNING: no loadable checkpoint in "
+              f"{args.checkpoint_dir!r}; serving fresh-init params "
+              f"(seed {args.seed}) until one is published", flush=True)
+
+    serve_log = ServeLog()
+    sink = None
+    metrics_file = getattr(args, "metrics_file", None)
+    if metrics_file:
+        sink = JsonlSink(metrics_file)
+        serve_log.set_sink(sink, source="serve")
+
+    engine = InferenceEngine(
+        model.apply, params, buckets=_parse_buckets(args.buckets),
+        serve_log=serve_log, params_epoch=epoch,
+    )
+    t0 = time.perf_counter()
+    engine.warmup()
+    stats = compile_log.stats()["programs"]
+    compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
+                      if name.startswith("serve_forward_"))
+    print(f"AOT-compiled {len(engine.buckets)} bucket programs "
+          f"{list(engine.buckets)} in {time.perf_counter() - t0:.1f}s "
+          f"(compile wall {compiled_ms:.0f} ms); steady-state serving "
+          f"never recompiles", flush=True)
+
+    def infer(images):
+        # Row-tagged outputs (label, epoch): the epoch is captured WITH
+        # the params inside the engine, and all rows of one batcher batch
+        # ride one engine call, so per-request slices stay consistent and
+        # the HTTP reply reports the checkpoint that really computed it.
+        labels, epoch = engine.predict_with_epoch(images)
+        tag = np.full_like(labels, -1 if epoch is None else epoch)
+        return np.stack([labels, tag], axis=1)
+
+    batcher = MicroBatcher(
+        infer, max_batch=engine.max_batch,
+        max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
+        serve_log=serve_log,
+    ).start()
+
+    watcher = None
+    if not getattr(args, "no_reload", False):
+        watcher = CheckpointWatcher(
+            args.checkpoint_dir, template, engine.swap_params,
+            poll_interval_s=args.poll_interval, serve_log=serve_log,
+            current_path=boot_path,
+        ).start()
+
+    httpd = ThreadingHTTPServer((args.host, args.port), _Handler)
+    httpd.daemon_threads = True
+    httpd.ctx = ServeContext(  # type: ignore[attr-defined]
+        engine, batcher, watcher, serve_log, sink, args.model,
+        boot_path=boot_path,
+        max_request_images=getattr(args, "max_request_images", 1024))
+    return httpd
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = build_parser().parse_args(argv)
+    httpd = create_server(args)
+    host, port = httpd.server_address[:2]
+    print(f"serving on http://{host}:{port}  "
+          f"(/predict, /healthz, /stats)", flush=True)
+    stats_interval = getattr(args, "stats_interval", 0.0)
+    stats_timer = None
+    if httpd.ctx.sink is not None and stats_interval > 0:
+        import threading
+
+        stop = threading.Event()
+
+        def _periodic():
+            while not stop.wait(stats_interval):
+                httpd.ctx.serve_log.write_stats()
+
+        stats_timer = (threading.Thread(target=_periodic, daemon=True,
+                                        name="serve-stats"), stop)
+        stats_timer[0].start()
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down", flush=True)
+    finally:
+        if stats_timer is not None:
+            stats_timer[1].set()
+        httpd.ctx.close()
+        httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -613,16 +613,37 @@ def latest_checkpoint(directory: str) -> Optional[str]:
 
 
 def prune_checkpoints(directory: str, keep_last: int) -> None:
-    """Delete all but the ``keep_last`` newest per-epoch checkpoints.
+    """Delete per-epoch checkpoints strictly older than the latest
+    *published* epoch minus ``keep_last``.
 
     The reference retains every epoch's file with no GC (``:267-268``) and
     so does this framework by default (``keep_last <= 0``); this is the
     opt-in bound for long runs. ``model_best`` copies are never pruned.
     Only process 0 calls this (same gate as the npz write).
+
+    ORDERING GUARANTEE (the serve hot-reload contract,
+    ``serve/reload.py``): pruning is keyed off the latest PUBLISHED epoch
+    ``L`` and deletes only epochs ``e < L - keep_last`` — the window
+    ``[L - keep_last, L]`` always survives. A reload watcher only ever
+    starts loading the latest published checkpoint it can see, and
+    pruning runs only as part of publishing a newer one, so with
+    ``keep_last >= 1`` the checkpoint a watcher is mid-load on stays on
+    disk for at least ``keep_last`` further publishes (one full epoch of
+    training each) before it can be deleted — a load would have to
+    straddle ``keep_last`` whole epochs to race the GC. A count-based
+    "keep the N newest files" rule (the pre-serving behavior) has no such
+    bound: publish + prune could delete the previous latest at the exact
+    moment a watcher opened it.
     """
     if keep_last <= 0:
         return
-    for _, path in _epoch_checkpoints(directory)[:-keep_last]:
+    found = _epoch_checkpoints(directory)
+    if not found:
+        return
+    latest_epoch = found[-1][0]
+    for epoch, path in found:
+        if epoch >= latest_epoch - keep_last:
+            break  # sorted: everything from here on is inside the window
         if os.path.isdir(path):
             shutil.rmtree(path)
         else:

@@ -149,6 +149,25 @@ def make_accum_train_step_fn(accum: int, aux_weight: float = 0.0):
     return step
 
 
+def make_forward_program(apply_fn):
+    """``forward(params, images) -> logits`` — the ONE inference forward
+    pass, shared by the ``-e/--evaluate`` eval step below and the serving
+    engine's bucketed AOT programs (``serve/engine.py``).
+
+    Both consumers trace exactly this function (``train=False``, params as
+    an explicit argument), so evaluate and serve cannot disagree on the
+    forward math or dtype policy — ``tests/test_serve_engine.py`` pins
+    their logits equal. Params are an argument rather than a closure
+    capture so the serve engine can hot-swap checkpoints without
+    invalidating its compiled executables (the no-recompile invariant).
+    """
+
+    def forward(params, images):
+        return apply_fn(params, images, train=False)
+
+    return forward
+
+
 def _eval_step(state, batch):
     """Forward + metrics, no gradient (reference ``evaluate``, ``:99-116``).
 
@@ -156,7 +175,7 @@ def _eval_step(state, batch):
     sharded eval reports exact whole-dataset metrics (the reference instead
     evaluates the full set redundantly on every rank, ``:143-144``)."""
     mask = batch.get("mask")
-    logits = state.apply_fn(state.params, batch["image"], train=False)
+    logits = make_forward_program(state.apply_fn)(state.params, batch["image"])
     loss = cross_entropy(logits, batch["label"], mask)
     return metrics_update(metrics_init(), loss, logits, batch["label"], mask)
 

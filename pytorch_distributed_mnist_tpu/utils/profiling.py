@@ -9,10 +9,13 @@ trace for xprof/tensorboard.
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import json
+import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -226,6 +229,53 @@ class CompileLog:
 compile_log = CompileLog()
 
 
+class JsonlSink:
+    """Append-only JSONL file shared by every metrics producer.
+
+    One line per record, written atomically under a lock (the async
+    checkpoint writer, watchdog timers, the serve batcher worker, and the
+    reload watcher all record from their own threads). ``--metrics-file``
+    resolves to ONE of these per process, so training epoch rows,
+    supervision events, and serving stats land in the same file in the
+    same format — a consumer tails one stream whichever mode produced it.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._warned = False
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+
+    def write(self, record: Dict) -> None:
+        """Append one record; raises on I/O failure (the per-epoch metric
+        row keeps its historical fail-loudly contract)."""
+        line = json.dumps(record)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    def try_write(self, record: Dict) -> bool:
+        """Best-effort append for callers on failure/supervision paths:
+        a metrics-disk error (ENOSPC/EIO — plausible exactly when the
+        run is already failing) must never mask the event being
+        reported or break the agreed-exit machinery. Warns once."""
+        try:
+            self.write(record)
+            return True
+        except OSError as exc:
+            with self._lock:
+                first, self._warned = not self._warned, True
+            if first:
+                import sys
+
+                print(f"WARNING: metrics sink {self.path!r} write failed "
+                      f"({exc!r}); further events stay in memory only",
+                      file=sys.stderr, flush=True)
+            return False
+
+
 class EventLog:
     """Append-only log of supervision/failure events for the run summary.
 
@@ -237,17 +287,39 @@ class EventLog:
     live, instead of a grep through interleaved stderr. Thread-safe:
     watchdog timers and the async checkpoint writer record from their own
     threads.
+
+    With a :class:`JsonlSink` attached (``set_sink``), every event is also
+    appended to the sink as it happens — the ``--metrics-file`` stream —
+    tagged with ``kind`` and ``source`` so train and serve events are
+    distinguishable in the shared file.
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._events = []
+        self._sink: Optional[JsonlSink] = None
+        self._source = "train"
+
+    def set_sink(self, sink: Optional[JsonlSink],
+                 source: str = "train") -> None:
+        """Attach (or detach, ``None``) the shared JSONL sink. ``source``
+        stamps each mirrored line so a file shared by a trainer and a
+        serve process stays attributable."""
+        with self._lock:
+            self._sink = sink
+            self._source = source
 
     def record(self, kind: str, detail: str, **fields) -> Dict:
         event = {"t": round(time.time(), 3), "kind": kind,
                  "detail": detail, **fields}
         with self._lock:
             self._events.append(event)
+            sink, source = self._sink, self._source
+        if sink is not None:
+            # try_write: record() runs inside poison-pill delivery and
+            # watchdog escalation — a sink I/O error must not mask the
+            # failure being recorded.
+            sink.try_write({**event, "source": source})
         return event
 
     def snapshot(self) -> list:
@@ -255,13 +327,162 @@ class EventLog:
             return [dict(e) for e in self._events]
 
     def reset(self) -> None:
+        """Clear events (and detach any sink: a re-entrant run must not
+        keep appending to the previous run's metrics file)."""
         with self._lock:
             self._events.clear()
+            self._sink = None
+            self._source = "train"
 
 
 # Singleton for the same reason as compile_log: one run, one failure story.
 # cli.run resets it at entry so re-entrant runs report their own events.
 failure_events = EventLog()
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (0 when empty).
+    Nearest-rank (not interpolated) so p99 of a small sample is a latency
+    that actually happened, never an optimistic blend."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[idx])
+
+
+class ServeLog:
+    """Serving observability: latency quantiles, batch-size histogram,
+    queue depth, admission-control rejections, and hot reloads.
+
+    The serve-side sibling of :class:`EventLog` + :class:`StepTimer`: the
+    batcher worker records per-request latency, the engine records each
+    executed bucket, the HTTP layer records rejections, and the reload
+    watcher records checkpoint swaps — ``snapshot()`` is the ``/stats``
+    payload. Thread-safe throughout (requests complete on the batcher
+    worker thread while ``/stats`` reads from HTTP handler threads).
+
+    Latency samples live in a bounded deque (recent-window quantiles, no
+    unbounded growth under sustained load). With a :class:`JsonlSink`
+    attached, ``write_stats()`` appends a ``{"kind": "serve_stats", ...}``
+    snapshot line — the same ``--metrics-file`` stream training writes its
+    epoch rows and failure events to.
+    """
+
+    def __init__(self, max_samples: int = 8192) -> None:
+        self._lock = threading.Lock()
+        self._max_samples = max_samples
+        self._sink: Optional[JsonlSink] = None
+        self._source = "serve"
+        self._queue_depth_probe: Optional[Callable[[], int]] = None
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._latency = collections.deque(maxlen=self._max_samples)
+            self._queue_wait = collections.deque(maxlen=self._max_samples)
+            self._batch_hist: Dict[int, int] = {}
+            self._counts = {"requests": 0, "images": 0, "batches": 0,
+                            "rejected": 0, "reloads": 0,
+                            "reload_failures": 0}
+
+    def set_sink(self, sink: Optional[JsonlSink],
+                 source: str = "serve") -> None:
+        with self._lock:
+            self._sink = sink
+            self._source = source
+
+    def set_queue_depth_probe(self, probe: Optional[Callable[[], int]]) -> None:
+        """Register a live queue-depth callable (the batcher's); read at
+        snapshot time so ``/stats`` shows the instantaneous depth."""
+        with self._lock:
+            self._queue_depth_probe = probe
+
+    # -- recorders (each from its owning thread) --------------------------
+
+    def record_request(self, latency_s: float, queue_wait_s: float = 0.0,
+                       images: int = 1) -> None:
+        with self._lock:
+            self._counts["requests"] += 1
+            self._counts["images"] += images
+            self._latency.append(latency_s)
+            self._queue_wait.append(queue_wait_s)
+
+    def record_batch(self, rows: int, bucket: int) -> None:
+        """One executed forward program: ``rows`` real examples padded up
+        to ``bucket``."""
+        with self._lock:
+            self._counts["batches"] += 1
+            self._batch_hist[bucket] = self._batch_hist.get(bucket, 0) + 1
+
+    def record_rejection(self) -> None:
+        with self._lock:
+            self._counts["rejected"] += 1
+
+    def record_reload(self, path: str, epoch: int) -> None:
+        with self._lock:
+            self._counts["reloads"] += 1
+            sink, source = self._sink, self._source
+        if sink is not None:
+            sink.try_write({"t": round(time.time(), 3),
+                            "kind": "serve_reload", "path": path,
+                            "epoch": epoch, "source": source})
+
+    def record_reload_failure(self, path: str, detail: str) -> None:
+        with self._lock:
+            self._counts["reload_failures"] += 1
+            sink, source = self._sink, self._source
+        if sink is not None:
+            sink.try_write({"t": round(time.time(), 3),
+                            "kind": "serve_reload_failed", "path": path,
+                            "detail": detail, "source": source})
+
+    # -- consumers --------------------------------------------------------
+
+    @staticmethod
+    def _quantiles(samples) -> Dict[str, float]:
+        vals = sorted(samples)
+        ms = lambda s: round(s * 1e3, 3)  # noqa: E731
+        return {
+            "p50": ms(_percentile(vals, 0.50)),
+            "p95": ms(_percentile(vals, 0.95)),
+            "p99": ms(_percentile(vals, 0.99)),
+            "mean": ms(sum(vals) / len(vals)) if vals else 0.0,
+            "max": ms(vals[-1]) if vals else 0.0,
+            "count": len(vals),
+        }
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            counts = dict(self._counts)
+            latency = list(self._latency)
+            queue_wait = list(self._queue_wait)
+            hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
+            probe = self._queue_depth_probe
+        depth = 0
+        if probe is not None:
+            try:
+                depth = int(probe())
+            except Exception:  # noqa: BLE001 - stats must never raise
+                depth = -1
+        return {
+            **counts,
+            "queue_depth": depth,
+            "latency_ms": self._quantiles(latency),
+            "queue_wait_ms": self._quantiles(queue_wait),
+            "batch_histogram": hist,
+        }
+
+    def write_stats(self, **extra) -> Dict:
+        """Snapshot + append it to the attached sink (no-op without one);
+        returns the snapshot either way."""
+        snap = self.snapshot()
+        with self._lock:
+            sink, source = self._sink, self._source
+        if sink is not None:
+            sink.try_write({"t": round(time.time(), 3),
+                            "kind": "serve_stats", "source": source,
+                            **snap, **extra})
+        return snap
 
 
 @contextlib.contextmanager

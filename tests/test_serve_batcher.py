@@ -1,0 +1,223 @@
+"""Batcher state machine: deadline flush, full-batch flush, admission
+control under a stalled engine, error propagation, latency accounting.
+All in-process with stub infer functions — no device, no sockets."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
+from pytorch_distributed_mnist_tpu.utils.profiling import ServeLog
+
+pytestmark = pytest.mark.serve
+
+
+def _rows(n, base=0.0):
+    """n distinct single-feature rows (row i carries base + i)."""
+    return (np.arange(n, dtype=np.float32) + base).reshape(n, 1)
+
+
+class RecordingInfer:
+    """Identity infer stub that records the row count of every batch."""
+
+    def __init__(self):
+        self.batch_sizes = []
+        self.lock = threading.Lock()
+
+    def __call__(self, images):
+        with self.lock:
+            self.batch_sizes.append(images.shape[0])
+        return images
+
+    def total_batches(self):
+        with self.lock:
+            return len(self.batch_sizes)
+
+
+def test_deadline_flush_coalesces_trickle():
+    """Requests trickling in under the deadline ride ONE batch; the flush
+    happens at the deadline, not at max_batch."""
+    infer = RecordingInfer()
+    with MicroBatcher(infer, max_batch=64, max_wait_s=0.25) as b:
+        pendings = [b.submit(_rows(1, base=i)) for i in range(3)]
+        results = [b.result(p, timeout=10.0) for p in pendings]
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r, _rows(1, base=i))
+    # All three arrived well inside the 250ms window -> coalesced. Allow 2
+    # batches for scheduling jitter (worker waking between submits), but a
+    # per-request batch would mean coalescing is broken.
+    assert infer.total_batches() <= 2
+
+
+def test_full_batch_flushes_before_deadline():
+    """max_batch rows waiting -> the batch flushes immediately; a 10s
+    deadline must not be what releases it."""
+    infer = RecordingInfer()
+    t0 = time.perf_counter()
+    with MicroBatcher(infer, max_batch=8, max_wait_s=10.0) as b:
+        pendings = [b.submit(_rows(1, base=i)) for i in range(8)]
+        for i, p in enumerate(pendings):
+            np.testing.assert_array_equal(b.result(p, timeout=10.0),
+                                          _rows(1, base=i))
+    assert time.perf_counter() - t0 < 5.0  # nowhere near the deadline
+    assert max(infer.batch_sizes) == 8
+
+
+def test_multi_row_requests_keep_row_mapping():
+    """Requests of different sizes coalesce; each gets exactly its own
+    rows back (slice bookkeeping)."""
+    infer = RecordingInfer()
+    with MicroBatcher(infer, max_batch=16, max_wait_s=0.05) as b:
+        pa = b.submit(_rows(3, base=100))
+        pb = b.submit(_rows(5, base=200))
+        ra = b.result(pa, timeout=10.0)
+        rb = b.result(pb, timeout=10.0)
+    np.testing.assert_array_equal(ra, _rows(3, base=100))
+    np.testing.assert_array_equal(rb, _rows(5, base=200))
+
+
+def test_requests_never_split_across_batches():
+    """A request whose rows would straddle max_batch waits for the next
+    batch whole — results map back by contiguous slices."""
+    infer = RecordingInfer()
+    with MicroBatcher(infer, max_batch=4, max_wait_s=0.05) as b:
+        pendings = [b.submit(_rows(3, base=100 * i)) for i in range(3)]
+        for i, p in enumerate(pendings):
+            np.testing.assert_array_equal(b.result(p, timeout=10.0),
+                                          _rows(3, base=100 * i))
+    assert all(s <= 4 for s in infer.batch_sizes)
+
+
+def test_admission_control_rejects_when_stalled():
+    """A stalled engine fills the bounded queue; the next submit raises
+    Overloaded IMMEDIATELY (no work done for it), and everything already
+    admitted completes once the engine recovers."""
+    started = threading.Event()
+    release = threading.Event()
+    log = ServeLog()
+
+    def stalled(images):
+        started.set()
+        assert release.wait(30.0), "test deadlock"
+        return images
+
+    with MicroBatcher(stalled, max_batch=2, max_wait_s=0.001,
+                      max_queue=3, serve_log=log) as b:
+        first = b.submit(_rows(1))
+        assert started.wait(10.0)  # worker is now wedged inside infer_fn
+        admitted = [b.submit(_rows(1, base=i + 1)) for i in range(3)]
+        t0 = time.perf_counter()
+        with pytest.raises(Overloaded):
+            b.submit(_rows(1, base=99))
+        assert time.perf_counter() - t0 < 1.0  # rejected, not queued
+        release.set()
+        b.result(first, timeout=10.0)
+        for p in admitted:
+            b.result(p, timeout=10.0)
+    snap = log.snapshot()
+    assert snap["rejected"] == 1
+    assert snap["requests"] == 4  # the rejected request never completes
+
+
+def test_infer_error_propagates_to_every_rider():
+    def boom(images):
+        raise RuntimeError("engine on fire")
+
+    with MicroBatcher(boom, max_batch=8, max_wait_s=0.01) as b:
+        pa, pb = b.submit(_rows(1)), b.submit(_rows(1))
+        for p in (pa, pb):
+            with pytest.raises(RuntimeError, match="engine on fire"):
+                b.result(p, timeout=10.0)
+
+
+def test_latency_accounting():
+    log = ServeLog()
+    with MicroBatcher(lambda x: x, max_batch=4, max_wait_s=0.001,
+                      serve_log=log) as b:
+        for i in range(5):
+            b.predict(_rows(2, base=i), timeout=10.0)
+    snap = log.snapshot()
+    assert snap["requests"] == 5
+    assert snap["images"] == 10
+    lat = snap["latency_ms"]
+    assert lat["count"] == 5
+    assert lat["p50"] >= 0.0 and lat["p99"] >= lat["p50"]
+    assert lat["max"] >= lat["p99"]
+    # queue wait is part of latency, never more than it
+    assert snap["queue_wait_ms"]["p50"] <= lat["p50"] + 1e-6
+
+
+def test_timed_out_request_is_dropped_not_executed():
+    """A caller that gave up (TimeoutError) must not cost device work or
+    pollute stats: its still-queued request is dropped, and the freed
+    queue slot goes back to admission control."""
+    started = threading.Event()
+    release = threading.Event()
+    infer = RecordingInfer()
+    log = ServeLog()
+
+    def stalled(images):
+        started.set()
+        assert release.wait(30.0), "test deadlock"
+        return infer(images)
+
+    with MicroBatcher(stalled, max_batch=1, max_wait_s=0.001,
+                      max_queue=2, serve_log=log) as b:
+        first = b.submit(_rows(1, base=0))
+        assert started.wait(10.0)  # worker wedged; queue is empty again
+        doomed = b.submit(_rows(1, base=77))
+        with pytest.raises(TimeoutError):
+            b.result(doomed, timeout=0.1)
+        survivor = b.submit(_rows(1, base=5))
+        release.set()
+        np.testing.assert_array_equal(b.result(first, timeout=10.0),
+                                      _rows(1, base=0))
+        np.testing.assert_array_equal(b.result(survivor, timeout=10.0),
+                                      _rows(1, base=5))
+    # Two batches executed (first + survivor); the abandoned request was
+    # dropped before execution and never entered the stats.
+    assert infer.total_batches() == 2
+    snap = log.snapshot()
+    assert snap["requests"] == 2  # doomed is not a phantom completion
+    assert snap["images"] == 2
+
+
+def test_oversized_follower_does_not_flush_small_request_early():
+    """Trigger/take consistency: a small request followed by an
+    oversized one must keep its full coalescing window (the oversized
+    request cannot co-batch, so it must not count toward the flush
+    threshold)."""
+    infer = RecordingInfer()
+    with MicroBatcher(infer, max_batch=4, max_wait_s=0.3) as b:
+        t0 = time.perf_counter()
+        small = b.submit(_rows(1, base=0))
+        big = b.submit(_rows(9, base=100))  # > max_batch: rides alone
+        np.testing.assert_array_equal(b.result(small, timeout=10.0),
+                                      _rows(1, base=0))
+        waited = time.perf_counter() - t0
+        np.testing.assert_array_equal(b.result(big, timeout=10.0),
+                                      _rows(9, base=100))
+    # The 1-row request held its window open for co-riders instead of
+    # flushing the moment the un-batchable 9-row arrived.
+    assert waited >= 0.2, waited
+    assert infer.batch_sizes[0] == 1 and 9 in infer.batch_sizes
+
+
+def test_close_drains_queue():
+    """close() after submits must complete them, not strand callers."""
+    b = MicroBatcher(lambda x: x, max_batch=4, max_wait_s=5.0).start()
+    pendings = [b.submit(_rows(1, base=i)) for i in range(3)]
+    b.close()
+    for i, p in enumerate(pendings):
+        np.testing.assert_array_equal(b.result(p, timeout=1.0),
+                                      _rows(1, base=i))
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.submit(_rows(1))
+
+
+def test_submit_rejects_non_stacks():
+    with MicroBatcher(lambda x: x, max_batch=4) as b:
+        with pytest.raises(ValueError, match="stack"):
+            b.submit(np.zeros(28, np.float32))

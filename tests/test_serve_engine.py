@@ -1,0 +1,196 @@
+"""InferenceEngine: bucket padding exactness, the evaluate-vs-engine
+logits pin (one forward-program builder for both), zero steady-state
+recompiles, hot-swap atomicity mid-batch."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    normalize_images,
+    synthetic_dataset,
+)
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import make_forward_program
+from pytorch_distributed_mnist_tpu.utils.profiling import ServeLog, compile_log
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    images, labels = synthetic_dataset(64, seed=3)
+    return model, state, images, labels
+
+
+def _direct_logits(model, state, raw_images):
+    """The evaluate path's forward: the shared builder applied to the
+    training-normalized batch, full precision of the real batch size."""
+    fwd = make_forward_program(model.apply)
+    return np.asarray(fwd(state.params, jnp.asarray(
+        normalize_images(raw_images))))
+
+
+def test_bucket_padding_does_not_change_real_rows(linear_setup):
+    """Padded rows must not perturb real rows' logits, across every
+    bucket boundary (1..9 rows against buckets 4/8)."""
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(4, 8))
+    engine.warmup()
+    for n in range(1, 10):
+        got = engine.logits(images[:n])
+        want = _direct_logits(model, state, images[:n])
+        assert got.shape == want.shape == (n, 10)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_exact_bucket_is_bitwise_identical(linear_setup):
+    """n == bucket: identical program, identical shapes -> the engine's
+    logits are the eval forward's logits bit for bit."""
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,))
+    engine.warmup()
+    got = engine.logits(images[:8])
+    want = _direct_logits(model, state, images[:8])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_evaluate_and_engine_agree(linear_setup):
+    """The satellite pin: -e/--evaluate and the serve engine share ONE
+    forward-program builder, so their accuracies over the same test set
+    are identical — preprocessing, dtype policy, and forward math cannot
+    drift apart."""
+    from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+    from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+
+    model, state, images, labels = linear_setup
+    norm = normalize_images(images)
+    loader = MNISTDataLoader(norm, labels.astype(np.int32), batch_size=16,
+                             train=False)
+    trainer = Trainer(state, loader, loader, mode="scan")
+    _, eval_acc = trainer.evaluate()
+
+    engine = InferenceEngine(model.apply, state.params, buckets=(16,))
+    engine.warmup()
+    preds = engine.predict(images)  # raw uint8 in: engine normalizes
+    engine_acc = float((preds == labels).mean())
+    np.testing.assert_allclose(engine_acc, eval_acc.accuracy, atol=1e-9)
+
+    # And per-row logits agree with the eval-path program exactly.
+    np.testing.assert_allclose(
+        engine.logits(images), _direct_logits(model, state, images),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_zero_recompiles_steady_state(linear_setup):
+    """After warmup, serving any admissible batch size — including
+    oversized chunked batches — triggers ZERO further XLA compiles."""
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(1, 4, 8))
+    engine.warmup()
+    compiled_programs = {f"serve_forward_b{b}" for b in (1, 4, 8)}
+    stats = compile_log.stats()["programs"]
+    assert compiled_programs <= set(stats)
+    baseline = compile_log.stats()["totals"]["backend_compiles"]
+    for n in (1, 2, 3, 4, 5, 8, 11, 16, 20):  # 11/16/20 chunk through 8
+        out = engine.logits(images[:n])
+        assert out.shape == (n, 10)
+    assert compile_log.stats()["totals"]["backend_compiles"] == baseline
+
+
+def test_oversized_batch_chunks_match_direct(linear_setup):
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(4,))
+    engine.warmup()
+    got = engine.logits(images[:11])  # 4 + 4 + 3(padded)
+    np.testing.assert_allclose(got, _direct_logits(model, state, images[:11]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_swap_params_changes_predictions(linear_setup):
+    model, state, images, _ = linear_setup
+    other = create_train_state(model, jax.random.key(123))
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,),
+                             params_epoch=0)
+    engine.warmup()
+    before = engine.logits(images[:8])
+    engine.swap_params(other.params, epoch=7)
+    assert engine.params_epoch == 7
+    after = engine.logits(images[:8])
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(
+        after, np.asarray(make_forward_program(model.apply)(
+            other.params, jnp.asarray(normalize_images(images[:8])))),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_swap_mid_batch_finishes_on_old_params(linear_setup):
+    """The hot-reload atomicity contract: a batch captures its params at
+    call entry; a swap landing while the forward runs does not leak the
+    new params into the in-flight batch, and the next batch sees them."""
+    model, state, images, _ = linear_setup
+    other = create_train_state(model, jax.random.key(123))
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,))
+    engine.warmup()
+    want_old = engine.logits(images[:8])
+    engine.swap_params(state.params)  # reset after the probe above
+
+    entered = threading.Event()
+    proceed = threading.Event()
+    real = engine._compiled[8]
+
+    def gated(params, x):
+        entered.set()
+        assert proceed.wait(30.0), "test deadlock"
+        return real(params, x)
+
+    engine._compiled[8] = gated
+    results = {}
+
+    def infer():
+        results["old"] = engine.logits(images[:8])
+
+    t = threading.Thread(target=infer, daemon=True)
+    t.start()
+    assert entered.wait(10.0)
+    engine.swap_params(other.params, epoch=9)  # swap while in flight
+    proceed.set()
+    t.join(30.0)
+    engine._compiled[8] = real
+    # The in-flight batch computed with the OLD params it captured...
+    np.testing.assert_array_equal(results["old"], want_old)
+    # ...and the very next batch runs on the new ones.
+    want_new = np.asarray(make_forward_program(model.apply)(
+        other.params, jnp.asarray(normalize_images(images[:8]))))
+    np.testing.assert_allclose(engine.logits(images[:8]), want_new,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_batch_histogram_records_buckets(linear_setup):
+    model, state, images, _ = linear_setup
+    log = ServeLog()
+    engine = InferenceEngine(model.apply, state.params, buckets=(2, 8),
+                             serve_log=log)
+    engine.warmup()
+    engine.logits(images[:1])  # -> bucket 2
+    engine.logits(images[:2])  # -> bucket 2
+    engine.logits(images[:5])  # -> bucket 8
+    snap = log.snapshot()
+    assert snap["batch_histogram"] == {"2": 2, "8": 1}
+    assert snap["batches"] == 3
+
+
+def test_preprocess_rejects_garbage(linear_setup):
+    model, state, _, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(2,))
+    with pytest.raises(ValueError, match="expected"):
+        engine.preprocess(np.zeros((2, 13, 13), np.uint8))
+    with pytest.raises(ValueError, match="expected"):
+        engine.preprocess(np.zeros((2, 28, 28, 3), np.float32))

@@ -1,0 +1,90 @@
+"""The shared JSONL metrics sink: train epoch rows, supervision events,
+and serving stats land in ONE file in one line-per-record format
+(utils/profiling.py JsonlSink + EventLog/ServeLog wiring)."""
+
+import json
+
+import pytest
+
+from pytorch_distributed_mnist_tpu.utils.profiling import (
+    EventLog,
+    JsonlSink,
+    ServeLog,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def _lines(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+def test_event_log_mirrors_to_sink(tmp_path):
+    mf = tmp_path / "metrics.jsonl"
+    log = EventLog()
+    log.record("before_sink", "not mirrored")
+    log.set_sink(JsonlSink(str(mf)), source="train")
+    log.record("publish_retry", "attempt 1", extra=3)
+    log.record("checkpoint_quarantined", "bad file")
+    rows = _lines(mf)
+    assert [r["kind"] for r in rows] == ["publish_retry",
+                                        "checkpoint_quarantined"]
+    assert rows[0]["source"] == "train" and rows[0]["extra"] == 3
+    assert all("t" in r and "detail" in r for r in rows)
+    # the in-memory snapshot keeps everything, sink or not
+    assert len(log.snapshot()) == 3
+    # reset detaches: a re-entrant run must not append to the old file
+    log.reset()
+    log.record("after_reset", "dropped from sink")
+    assert len(_lines(mf)) == 2
+
+
+def test_serve_log_stats_lines_share_the_format(tmp_path):
+    mf = tmp_path / "metrics.jsonl"
+    sink = JsonlSink(str(mf))
+    slog = ServeLog()
+    slog.set_sink(sink, source="serve")
+    slog.record_request(0.010, queue_wait_s=0.002, images=4)
+    slog.record_batch(rows=4, bucket=8)
+    slog.record_rejection()
+    slog.record_reload("/ckpt/checkpoint_3.npz", epoch=3)
+    slog.record_reload_failure("/ckpt/checkpoint_4.npz", "corrupt")
+    snap = slog.write_stats(final=True)
+    rows = _lines(mf)
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["serve_reload", "serve_reload_failed", "serve_stats"]
+    assert all(r["source"] == "serve" for r in rows)
+    stats_row = rows[-1]
+    assert stats_row["final"] is True
+    assert stats_row["requests"] == snap["requests"] == 1
+    assert stats_row["rejected"] == 1 and stats_row["reloads"] == 1
+    assert stats_row["batch_histogram"] == {"8": 1}
+    assert stats_row["latency_ms"]["p50"] == pytest.approx(10.0, abs=0.1)
+
+
+def test_train_and_serve_can_share_one_file(tmp_path):
+    """Both sides appending to the same path interleave cleanly (one
+    line per record, each self-describing via kind/source or the epoch
+    schema)."""
+    mf = tmp_path / "metrics.jsonl"
+    sink = JsonlSink(str(mf))
+    elog, slog = EventLog(), ServeLog()
+    elog.set_sink(sink, source="train")
+    slog.set_sink(sink, source="serve")
+    sink.write({"epoch": 0, "train_loss": 1.0})  # cli.run's epoch row
+    elog.record("publish_retry", "x")
+    slog.record_reload("/ckpt/checkpoint_0.npz", epoch=0)
+    rows = _lines(mf)
+    assert len(rows) == 3
+    assert rows[0]["epoch"] == 0
+    assert {rows[1]["source"], rows[2]["source"]} == {"train", "serve"}
+
+
+def test_serve_log_percentiles_ordering():
+    slog = ServeLog()
+    for i in range(100):
+        slog.record_request((i + 1) / 1000.0)
+    lat = slog.snapshot()["latency_ms"]
+    assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    assert lat["count"] == 100
+    assert lat["p50"] == pytest.approx(51.0, abs=2.0)

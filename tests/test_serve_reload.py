@@ -1,0 +1,238 @@
+"""CheckpointWatcher: publish -> poll -> atomic swap; corrupt/vanished
+checkpoints never take the server down; the GC window keeps the
+watcher's load target alive."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import synthetic_dataset
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
+from pytorch_distributed_mnist_tpu.train.checkpoint import (
+    prune_checkpoints,
+    save_checkpoint,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.utils.profiling import ServeLog
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture()
+def setup(tmp_path):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    template = create_train_state(model, jax.random.key(0))
+    images, _ = synthetic_dataset(8, seed=1)
+    return model, template, images, str(tmp_path)
+
+
+def _publish(template, epoch, seed, directory, keep_last=0):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(seed))
+    save_checkpoint(state, epoch=epoch, best_acc=0.5, is_best=False,
+                    directory=directory, process_index=0,
+                    keep_last=keep_last)
+    return state
+
+
+def test_poll_installs_newly_published(setup):
+    model, template, images, ckpt_dir = setup
+    engine = InferenceEngine(model.apply, template.params, buckets=(8,))
+    engine.warmup()
+    log = ServeLog()
+    watcher = CheckpointWatcher(ckpt_dir, template, engine.swap_params,
+                                serve_log=log)
+    assert not watcher.poll_once()  # empty dir: nothing to do
+
+    state_a = _publish(template, epoch=0, seed=10, directory=ckpt_dir)
+    assert watcher.poll_once()
+    assert engine.params_epoch == 0
+    got = engine.logits(images)
+    want = np.asarray(model.apply(state_a.params, jnp.asarray(
+        engine.preprocess(images)), train=False))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert not watcher.poll_once()  # same path: no redundant reload
+
+    state_b = _publish(template, epoch=1, seed=20, directory=ckpt_dir)
+    assert watcher.poll_once()
+    assert engine.params_epoch == 1
+    want_b = np.asarray(model.apply(state_b.params, jnp.asarray(
+        engine.preprocess(images)), train=False))
+    np.testing.assert_allclose(engine.logits(images), want_b,
+                               rtol=1e-6, atol=1e-6)
+    assert log.snapshot()["reloads"] == 2
+
+
+def test_corrupt_checkpoint_keeps_serving(setup):
+    model, template, images, ckpt_dir = setup
+    engine = InferenceEngine(model.apply, template.params, buckets=(8,))
+    engine.warmup()
+    log = ServeLog()
+    watcher = CheckpointWatcher(ckpt_dir, template, engine.swap_params,
+                                serve_log=log)
+    _publish(template, epoch=0, seed=10, directory=ckpt_dir)
+    assert watcher.poll_once()
+    before = engine.logits(images)
+
+    # A torn write that somehow escaped the atomic-publish discipline
+    # (or plain disk corruption of the newest file).
+    with open(os.path.join(ckpt_dir, "checkpoint_3.npz"), "wb") as f:
+        f.write(b"this is not an npz file")
+    assert not watcher.poll_once()
+    np.testing.assert_array_equal(engine.logits(images), before)
+    assert engine.params_epoch == 0
+    snap = log.snapshot()
+    assert snap["reload_failures"] == 1 and snap["reloads"] == 1
+    # The bad path is remembered: no retry hot-loop...
+    assert not watcher.poll_once()
+    assert log.snapshot()["reload_failures"] == 1
+    # ...but a NEWER publish is picked up immediately.
+    _publish(template, epoch=5, seed=30, directory=ckpt_dir)
+    assert watcher.poll_once()
+    assert engine.params_epoch == 5
+
+
+def test_model_mismatch_rejected_not_served(setup):
+    """A checkpoint from a different architecture fails template
+    validation and is refused; the server keeps its params."""
+    model, template, images, ckpt_dir = setup
+    engine = InferenceEngine(model.apply, template.params, buckets=(8,))
+    engine.warmup()
+    before = engine.logits(images)
+    cnn = get_model("cnn")
+    cnn_state = create_train_state(cnn, jax.random.key(0))
+    save_checkpoint(cnn_state, epoch=2, best_acc=0.9, is_best=False,
+                    directory=ckpt_dir, process_index=0)
+    log = ServeLog()
+    watcher = CheckpointWatcher(ckpt_dir, template, engine.swap_params,
+                                serve_log=log)
+    assert not watcher.poll_once()
+    np.testing.assert_array_equal(engine.logits(images), before)
+    assert log.snapshot()["reload_failures"] == 1
+
+
+def test_transient_failure_retries_same_path(setup, monkeypatch):
+    """A transient load error (EIO, momentary OOM) must NOT blacklist the
+    path: after training's final publish no newer checkpoint will ever
+    appear to clear it, so the next poll retries and succeeds."""
+    model, template, images, ckpt_dir = setup
+    engine = InferenceEngine(model.apply, template.params, buckets=(8,))
+    engine.warmup()
+    log = ServeLog()
+    watcher = CheckpointWatcher(ckpt_dir, template, engine.swap_params,
+                                serve_log=log)
+    _publish(template, epoch=0, seed=10, directory=ckpt_dir)
+
+    import pytorch_distributed_mnist_tpu.serve.reload as reload_mod
+
+    calls = {"n": 0}
+    from pytorch_distributed_mnist_tpu.serve.engine import (
+        load_params_for_serving as real_load,
+    )
+
+    def flaky(path, tmpl):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(5, "Input/output error")  # flaky NFS read
+        return real_load(path, tmpl)
+
+    import pytorch_distributed_mnist_tpu.serve.engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "load_params_for_serving", flaky)
+    assert not watcher.poll_once()  # transient failure recorded...
+    assert log.snapshot()["reload_failures"] == 1
+    assert watcher.poll_once()  # ...and the SAME path succeeds next poll
+    assert engine.params_epoch == 0
+    assert log.snapshot()["reloads"] == 1
+
+
+def test_stale_nfs_missing_shards_retries(setup, monkeypatch):
+    """_load_sharded's missing-shards ValueError is absence-level (stale
+    NFS readdir of an atomically-published dir), NOT corruption — the
+    same taxonomy is_corrupt_checkpoint_error documents — so the watcher
+    must retry the same path, not blacklist it."""
+    model, template, images, ckpt_dir = setup
+    engine = InferenceEngine(model.apply, template.params, buckets=(8,))
+    engine.warmup()
+    log = ServeLog()
+    watcher = CheckpointWatcher(ckpt_dir, template, engine.swap_params,
+                                serve_log=log)
+    _publish(template, epoch=0, seed=10, directory=ckpt_dir)
+
+    calls = {"n": 0}
+    from pytorch_distributed_mnist_tpu.serve.engine import (
+        load_params_for_serving as real_load,
+    )
+
+    def stale_then_ok(path, tmpl):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError(
+                f"{path}: leaf params is missing shards (0/10 elements "
+                f"present) — incomplete save?")
+        return real_load(path, tmpl)
+
+    import pytorch_distributed_mnist_tpu.serve.engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "load_params_for_serving",
+                        stale_then_ok)
+    assert not watcher.poll_once()
+    assert watcher.poll_once()  # same path, next poll: view settled
+    assert engine.params_epoch == 0
+
+
+def test_watcher_thread_polls(setup):
+    """The daemon thread variant actually installs a publish."""
+    import time
+
+    model, template, images, ckpt_dir = setup
+    engine = InferenceEngine(model.apply, template.params, buckets=(8,))
+    engine.warmup()
+    watcher = CheckpointWatcher(ckpt_dir, template, engine.swap_params,
+                                poll_interval_s=0.05).start()
+    try:
+        _publish(template, epoch=4, seed=40, directory=ckpt_dir)
+        deadline = time.time() + 10.0
+        while engine.params_epoch != 4 and time.time() < deadline:
+            time.sleep(0.02)
+        assert engine.params_epoch == 4
+    finally:
+        watcher.stop()
+
+
+def test_gc_window_never_deletes_watcher_target(setup):
+    """The prune/reload ordering guarantee: publishing epoch E with
+    --keep-last N leaves every epoch in [E-N, E] on disk — in particular
+    the PREVIOUS latest, which is the file a watcher may be mid-load on
+    when the publish happens."""
+    model, template, images, ckpt_dir = setup
+    for e in range(6):
+        _publish(template, epoch=e, seed=e, directory=ckpt_dir,
+                 keep_last=2)
+        names = sorted(n for n in os.listdir(ckpt_dir)
+                       if n.startswith("checkpoint_"))
+        window = [f"checkpoint_{k}.npz" for k in range(max(0, e - 2), e + 1)]
+        assert names == window
+        # the previous latest — the watcher's possible in-flight load —
+        # is always inside the window
+        if e:
+            assert f"checkpoint_{e - 1}.npz" in names
+
+
+def test_prune_window_with_gaps(tmp_path):
+    """Window semantics are epoch-distance, not file-count: epochs 1/5/9
+    with keep_last=2 prunes everything older than 9-2=7."""
+    model = get_model("linear", compute_dtype=jnp.float32)
+    for e in (1, 5, 9):
+        state = create_train_state(model, jax.random.key(e))
+        save_checkpoint(state, epoch=e, best_acc=0.1, is_best=False,
+                        directory=str(tmp_path), process_index=0)
+    prune_checkpoints(str(tmp_path), keep_last=2)
+    names = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith("checkpoint_"))
+    assert names == ["checkpoint_9.npz"]

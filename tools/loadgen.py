@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""Load generator for the serving endpoint (`tpu-mnist serve`).
+
+Pure stdlib on purpose — no jax, no numpy — so it starts in milliseconds,
+runs from any box that can reach the server, and measures the SERVER, not
+its own import time. Two disciplines:
+
+- **closed loop** (default): C workers each keep exactly one request in
+  flight, back to back — measures throughput at a fixed concurrency and
+  the latency that concurrency buys.
+- **open loop**: requests fire on a fixed-rate schedule regardless of
+  completions — the honest tail-latency discipline (closed-loop
+  coordinated omission hides queueing collapse: a slow server slows the
+  CLIENTS down). Overload shows up as 503 rejections and p99 growth
+  instead of a silently reduced send rate.
+
+Report: one JSON line — throughput, p50/p95/p99/mean/max latency, status
+counts, rejection count. `--smoke` is the CI entry: closed-loop burst
+with tight defaults, nonzero exit unless every request succeeded and the
+server's /stats and /healthz answer.
+
+Examples:
+    python tools/loadgen.py --url http://127.0.0.1:8000 \
+        --requests 2000 --concurrency 16
+    python tools/loadgen.py --url http://127.0.0.1:8000 \
+        --mode open --rate 500 --duration 10
+    python tools/loadgen.py --smoke --url http://127.0.0.1:8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _make_images(n_templates: int, images_per_request: int, seed: int):
+    """Deterministic raw 28x28 uint8-valued images as nested lists,
+    pre-serialized to JSON bodies (serialization cost paid once, not per
+    request)."""
+    rng = random.Random(seed)
+    bodies = []
+    for _ in range(n_templates):
+        imgs = [[[rng.randrange(256) for _ in range(28)] for _ in range(28)]
+                for _ in range(images_per_request)]
+        bodies.append(json.dumps({"images": imgs}).encode())
+    return bodies
+
+
+class Collector:
+    """Thread-safe result accumulator."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.latencies = []
+        self.status = {}
+        self.errors = 0
+
+    def record(self, status: int, latency_s: float) -> None:
+        with self.lock:
+            self.status[status] = self.status.get(status, 0) + 1
+            if status == 200:
+                self.latencies.append(latency_s)
+
+    def record_error(self) -> None:
+        with self.lock:
+            self.errors += 1
+
+
+def _one_request(url: str, body: bytes, timeout: float,
+                 collector: Collector) -> None:
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+            collector.record(resp.status, time.perf_counter() - t0)
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        collector.record(exc.code, time.perf_counter() - t0)
+    except Exception:  # noqa: BLE001 - connection/timeout errors
+        collector.record_error()
+
+
+def run_closed(url: str, requests: int, concurrency: int, bodies,
+               timeout: float) -> Collector:
+    collector = Collector()
+    counter = {"next": 0}
+    lock = threading.Lock()
+
+    def worker(wid: int) -> None:
+        while True:
+            with lock:
+                i = counter["next"]
+                if i >= requests:
+                    return
+                counter["next"] = i + 1
+            _one_request(url, bodies[i % len(bodies)], timeout, collector)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return collector
+
+
+def run_open(url: str, rate: float, duration: float, bodies,
+             timeout: float, max_outstanding: int = 512) -> Collector:
+    collector = Collector()
+    sem = threading.Semaphore(max_outstanding)
+    threads = []
+    interval = 1.0 / max(rate, 1e-9)
+    t_start = time.perf_counter()
+    i = 0
+    while True:
+        t_next = t_start + i * interval
+        now = time.perf_counter()
+        if t_next - t_start >= duration:
+            break
+        if t_next > now:
+            time.sleep(t_next - now)
+        if not sem.acquire(blocking=False):
+            # The schedule never waits for the server (that would be
+            # closed-loop in disguise); a send the client can't launch is
+            # counted as an error, not silently skipped.
+            collector.record_error()
+            i += 1
+            continue
+
+        def fire(body=bodies[i % len(bodies)]):
+            try:
+                _one_request(url, body, timeout, collector)
+            finally:
+                sem.release()
+
+        th = threading.Thread(target=fire, daemon=True)
+        th.start()
+        threads.append(th)
+        i += 1
+    for th in threads:
+        th.join(timeout)
+    return collector
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def report(collector: Collector, wall_s: float, mode: str) -> dict:
+    lats = sorted(collector.latencies)
+    ms = lambda s: round(s * 1e3, 3)  # noqa: E731
+    ok = collector.status.get(200, 0)
+    return {
+        "mode": mode,
+        "wall_s": round(wall_s, 3),
+        "ok": ok,
+        "rejected": collector.status.get(503, 0),
+        "status_counts": {str(k): v
+                          for k, v in sorted(collector.status.items())},
+        "transport_errors": collector.errors,
+        "throughput_rps": round(ok / wall_s, 2) if wall_s > 0 else 0.0,
+        "latency_ms": {
+            "p50": ms(_percentile(lats, 0.50)),
+            "p95": ms(_percentile(lats, 0.95)),
+            "p99": ms(_percentile(lats, 0.99)),
+            "mean": ms(sum(lats) / len(lats)) if lats else 0.0,
+            "max": ms(lats[-1]) if lats else 0.0,
+        },
+    }
+
+
+def _get_json(url: str, path: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", type=str, default="http://127.0.0.1:8000")
+    p.add_argument("--mode", type=str, default="closed",
+                   choices=["closed", "open"])
+    p.add_argument("--requests", type=int, default=1000,
+                   help="closed loop: total requests")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed loop: workers with one request in flight "
+                        "each")
+    p.add_argument("--rate", type=float, default=200.0,
+                   help="open loop: target requests/sec")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="open loop: seconds to run")
+    p.add_argument("--images-per-request", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: closed-loop burst; exit nonzero unless "
+                        "every request succeeded and /healthz + /stats "
+                        "answer")
+    args = p.parse_args(argv)
+
+    url = args.url.rstrip("/")
+    bodies = _make_images(
+        n_templates=min(16, max(1, args.requests)),
+        images_per_request=args.images_per_request, seed=args.seed)
+
+    t0 = time.perf_counter()
+    if args.mode == "open" and not args.smoke:
+        collector = run_open(url, args.rate, args.duration, bodies,
+                             args.timeout)
+    else:
+        collector = run_closed(url, args.requests, args.concurrency,
+                               bodies, args.timeout)
+    out = report(collector, time.perf_counter() - t0,
+                 "closed" if args.smoke else args.mode)
+
+    rc = 0
+    if args.smoke:
+        # The smoke bar: every request answered 200, and the health/stats
+        # surface is live and carries the latency quantiles + batch
+        # histogram the acceptance criteria name.
+        try:
+            health = _get_json(url, "/healthz", args.timeout)
+            stats = _get_json(url, "/stats", args.timeout)
+            out["healthz"] = health
+            out["stats_keys"] = sorted(stats)
+            smoke_ok = (
+                health.get("ok") is True
+                and out["ok"] == args.requests
+                and out["transport_errors"] == 0
+                and "p50" in stats.get("latency_ms", {})
+                and "p99" in stats.get("latency_ms", {})
+                and stats.get("batch_histogram")
+            )
+        except Exception as exc:  # noqa: BLE001
+            out["smoke_error"] = repr(exc)
+            smoke_ok = False
+        out["smoke_ok"] = bool(smoke_ok)
+        rc = 0 if smoke_ok else 1
+    print(json.dumps(out))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
