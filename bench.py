@@ -846,6 +846,42 @@ SERVE_REQUESTS = 2000
 SERVE_CONCURRENCY = 16
 
 
+def _isolate_cpu_serve_devices() -> bool:
+    """Make the forced-multi-device CPU backend behave like N chips.
+
+    With ``--xla_force_host_platform_device_count=N`` (the CI stand-in
+    for an N-chip host), a SINGLE XLA:CPU execution still grabs the whole
+    host Eigen threadpool — so the N "devices" the replica pool fans out
+    across contend for every core and the scaling/pipelining measurement
+    measures only that contention. ``--xla_cpu_multi_thread_eigen=false``
+    pins each execution to one thread, which is exactly the resource
+    model the forced device count is simulating (one chip != the whole
+    host). Probed in a throwaway child first because XLA ABORTS the
+    process on an unknown flag (same pattern as tests/conftest.py);
+    returns whether the isolation is active so the JSON line can record
+    the measurement environment honestly. No-op on real accelerators
+    (the flag only gates the CPU backend's intra-op pool).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        return False  # single-device CPU or a real backend: nothing to fix
+    if "xla_cpu_multi_thread_eigen" in flags:
+        return "xla_cpu_multi_thread_eigen=false" in flags
+    candidate = (flags + " --xla_cpu_multi_thread_eigen=false").strip()
+    probe = ("import os; os.environ['XLA_FLAGS'] = %r; "
+             "from jaxlib import xla_client; xla_client.make_cpu_client()"
+             % candidate)
+    try:
+        supported = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, timeout=120
+        ).returncode == 0
+    except (OSError, subprocess.SubprocessError):
+        supported = False
+    if supported:
+        os.environ["XLA_FLAGS"] = candidate
+    return supported
+
+
 def main_serve() -> None:
     """``--mode serve``: the serving trajectory's BENCH line.
 
@@ -858,6 +894,25 @@ def main_serve() -> None:
     histogram, and the zero-steady-state-recompiles invariant checked
     via ``CompileLog``. Never raises; failures become an ``error`` line
     (the always-emit-JSON contract the training bench follows).
+
+    The multi-chip data plane rides the same line:
+
+    - ``replica_scaling``: requests/sec through an :class:`EnginePool`
+      at 1, 2, ..., ``n_devices`` replicas (pipelined dispatch, window
+      replicas+1), each point re-checking zero steady-state recompiles
+      PER REPLICA via the per-replica ``CompileLog`` program names;
+    - ``pipeline_speedup``: the full pool driven with the in-flight
+      window at replicas+1 vs 1 — window 1 serializes every batch's
+      host-side staging behind the previous batch's result fetch AND
+      caps the fleet at one busy replica, so this is the pipelining
+      win the PR claims (>1.0 on any backend with real parallelism).
+      Pool drives use fixed 8-row exact-bucket requests (batch
+      formation pinned — see ``pool_stacks``) and the ratio is the
+      median of interleaved paired drives, so CPU-share drift on a
+      shared CI box cancels instead of deciding the sign.
+
+    In CI this runs on CPU with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=4``.
     """
     out = {
         "metric": "mnist_serve_requests_per_sec",
@@ -867,6 +922,10 @@ def main_serve() -> None:
                     "speedup",
     }
     try:
+        # Must run before the first jax device query: XLA_FLAGS are read
+        # once, at backend init.
+        cpu_isolated = _isolate_cpu_serve_devices()
+
         import jax
 
         configure_jax(jax, force_cpu=bool(os.environ.get("BENCH_FORCE_CPU")))
@@ -903,6 +962,14 @@ def main_serve() -> None:
 
         images, _ = synthetic_dataset(64, seed=0)
         stacks = [engine.preprocess(images[i:i + 1]) for i in range(16)]
+        # Pool drives use 8-row exact-bucket requests with max_batch=8:
+        # one request == one bucket-8 batch, every time. Single-row
+        # coalescing would couple batch FORMATION with the in-flight
+        # window (a serialized window backs the queue up into larger,
+        # better-packed batches), turning the pipeline on/off ratio into
+        # a batch-size-efficiency measurement; fixed-shape requests pin
+        # the device work per request so the ratio isolates pipelining.
+        pool_stacks = [engine.preprocess(images[i:i + 8]) for i in range(8)]
 
         requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
                                       SERVE_REQUESTS))
@@ -911,7 +978,8 @@ def main_serve() -> None:
 
         drive_errors: list = []
 
-        def drive(requests_n: int) -> float:
+        def drive(batcher, requests_n: int, req_stacks=None) -> float:
+            req_stacks = stacks if req_stacks is None else req_stacks
             counter = {"next": 0}
             lock = threading.Lock()
 
@@ -923,7 +991,7 @@ def main_serve() -> None:
                             return
                         counter["next"] = i + 1
                     try:
-                        batcher.predict(stacks[i % len(stacks)])
+                        batcher.predict(req_stacks[i % len(req_stacks)])
                     except Exception as exc:  # noqa: BLE001
                         # A silently-dead worker would let the drive
                         # finish with unserved requests counted into the
@@ -942,9 +1010,13 @@ def main_serve() -> None:
         with MicroBatcher(engine.predict, max_batch=engine.max_batch,
                           max_wait_s=0.002, max_queue=4 * concurrency,
                           serve_log=serve_log) as batcher:
-            drive(max(64, requests // 10))  # warm the path end to end
+            drive(batcher, max(64, requests // 10))  # warm the path E2E
             serve_log.reset()
-            wall = drive(requests)
+            # Best-of-2 (BASELINE.md timing protocol): one descheduled
+            # burst on a shared CI box halves a single drive's apparent
+            # throughput. The ServeLog keeps both drives' samples; the
+            # headline uses the cleaner wall.
+            wall = min(drive(batcher, requests) for _ in range(2))
 
         totals_after_load = dict(compile_log.stats()["totals"])
         zero_recompiles = (
@@ -956,7 +1028,111 @@ def main_serve() -> None:
         # program alone through a max_batch=1 batcher.
         with MicroBatcher(engine.predict, max_batch=1, max_wait_s=0.0,
                           max_queue=4 * concurrency) as batcher:
-            baseline_wall = drive(requests)
+            baseline_wall = min(drive(batcher, requests)
+                                for _ in range(2))
+
+        # -- multi-chip data plane: replica scaling + pipelined dispatch.
+        from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
+
+        def _serve_program_compiles() -> dict:
+            return {name: rec["backend_compiles"]
+                    for name, rec in compile_log.stats()["programs"].items()
+                    if name.startswith("serve_forward_")}
+
+        def _recompile_delta(before: dict, after: dict) -> dict:
+            """Per-program compile-count changes across one drive (empty
+            == the zero-steady-state-recompiles invariant held)."""
+            return {name: (count, after[name])
+                    for name, count in before.items()
+                    if after[name] != count}
+
+        def drive_pool(pool, window: int, requests_n: int,
+                       reps: int = 3, fixed_shape: bool = False) -> float:
+            """Best-of-``reps`` wall seconds for ``requests_n`` requests
+            (the BASELINE.md timing protocol: best-of filters scheduler
+            noise on a shared-core CI box, where one descheduled burst
+            can halve a single drive's apparent throughput).
+            ``fixed_shape`` drives the 8-row exact-bucket requests with
+            ``max_batch=8`` — one request == one bucket-8 batch, every
+            time — instead of realistic single-row coalescing."""
+            req_stacks = pool_stacks if fixed_shape else stacks
+            with MicroBatcher(
+                    None, max_batch=8 if fixed_shape else pool.max_batch,
+                    max_wait_s=0.002, max_queue=4 * concurrency,
+                    dispatch_fn=pool.dispatch,
+                    complete_fn=lambda h: pool.predict_complete(h)[0],
+                    max_inflight=window) as pool_batcher:
+                drive(pool_batcher, max(64, requests_n // 10),
+                      req_stacks)  # warm E2E
+                return min(drive(pool_batcher, requests_n, req_stacks)
+                           for _ in range(reps))
+
+        def drive_pool_interleaved(pool, windows, requests_n: int,
+                                   reps: int = 5) -> dict:
+            """``reps`` fixed-shape drives per window, INTERLEAVED in
+            time with ABBA ordering (w0w1, w1w0, w0w1, ...): on a
+            shares-throttled CI box the available CPU drifts with
+            invisible neighbors, so the honest window-vs-window
+            comparison pairs drives that ran next to each other — and
+            alternating which window goes first cancels first-mover and
+            linear-drift bias. Returns {window: [wall, ...]} in rep
+            order."""
+            walls = {w: [] for w in windows}
+            for rep in range(reps):
+                order = windows if rep % 2 == 0 else tuple(reversed(windows))
+                for window in order:
+                    walls[window].append(
+                        drive_pool(pool, window=window,
+                                   requests_n=requests_n, reps=1,
+                                   fixed_shape=True))
+            return walls
+
+        n_devices = jax.device_count()
+        # A quarter of the headline count per pool drive: the pool
+        # section runs ~15 drives (3 scaling points x best-of-3 + 6
+        # interleaved pipeline drives), so full-size drives would
+        # quintuple the bench's wall time; 500-request drives keep the
+        # ratio's sign stable (measured) at a bounded cost.
+        pool_requests = int(os.environ.get("BENCH_SERVE_POOL_REQUESTS",
+                                           max(400, requests // 4)))
+        points = sorted({n for n in (1, 2, n_devices)
+                         if 1 <= n <= n_devices})
+        replica_scaling = []
+        recompiled_replicas: list = []
+        pipeline_speedup = 0.0
+        pipeline_pairs: list = []
+        for n in points:
+            pool = EnginePool(model.apply, state.params,
+                              devices=jax.local_devices()[:n])
+            pool.warmup()
+            before = _serve_program_compiles()
+            pool_wall = drive_pool(pool, window=n + 1,
+                                   requests_n=pool_requests)
+            if n == n_devices:
+                # Full pool: pipeline on (window n+1) vs off (window 1 —
+                # strict dispatch->complete alternation, one busy
+                # replica), on the FIXED-SHAPE drive so batch formation
+                # cannot couple with the window (a serialized window
+                # backs the queue up into larger, better-packed batches,
+                # which would measure packing, not pipelining). The
+                # speedup is the MEDIAN of the per-rep paired ratios
+                # from interleaved drives: adjacent pairs see the same
+                # neighbor load, so the ratio survives the CPU-share
+                # drift that best-of-each-side would turn into noise.
+                walls = drive_pool_interleaved(
+                    pool, windows=(n + 1, 1), requests_n=pool_requests)
+                pipeline_pairs = [round(off / on, 3) for on, off
+                                  in zip(walls[n + 1], walls[1])]
+                ratios = sorted(pipeline_pairs)
+                pipeline_speedup = ratios[len(ratios) // 2]
+            delta = _recompile_delta(before, _serve_program_compiles())
+            if delta:
+                recompiled_replicas.append(delta)
+            replica_scaling.append({
+                "replicas": n,
+                "requests_per_sec": round(pool_requests / pool_wall, 1),
+                "zero_steady_state_recompiles": not delta,
+            })
 
         value = requests / wall
         out.update({
@@ -972,24 +1148,36 @@ def main_serve() -> None:
             "rejected": snap["rejected"],
             "warmup_compile_s": round(warmup_s, 3),
             "zero_steady_state_recompiles": zero_recompiles,
+            "replica_scaling": replica_scaling,
+            "pipeline_speedup": round(pipeline_speedup, 3),
+            "pipeline_pairs": pipeline_pairs,
+            "pool_requests": pool_requests,
+            "pool_images_per_request": 8,
+            "cpu_serve_devices_isolated": cpu_isolated,
+            "zero_steady_state_recompiles_per_replica":
+                not recompiled_replicas,
             "backend": device.platform,
             "device_kind": device.device_kind,
             "n_chips": jax.device_count(),
             "compile_stats": compile_log.stats(),
         })
-        # The measured drive really served every request (phantom
+        # The measured drives really served every request (phantom
         # completions would inflate the headline), and nothing failed.
-        served_all = snap["requests"] == requests
-        ok = zero_recompiles and not drive_errors and served_all
+        served_all = snap["requests"] == 2 * requests  # best-of-2 drives
+        ok = (zero_recompiles and not drive_errors and served_all
+              and not recompiled_replicas)
         if not zero_recompiles:
             out["error"] = ("steady-state serving recompiled: "
                             f"{totals_after_warmup} -> {totals_after_load}")
+        elif recompiled_replicas:
+            out["error"] = ("steady-state pool serving recompiled: "
+                            f"{recompiled_replicas}")
         elif drive_errors:
             out["error"] = (f"{len(drive_errors)} requests failed during "
                             f"the drive: {drive_errors[:3]}")
         elif not served_all:
-            out["error"] = (f"served {snap['requests']} of {requests} "
-                            f"requests")
+            out["error"] = (f"served {snap['requests']} of {2 * requests} "
+                            f"requests across the measured drives")
     except Exception as exc:  # noqa: BLE001 - bench must always emit JSON
         out.update({"value": 0.0, "vs_baseline": 0.0, "error": repr(exc)})
         ok = False

@@ -1436,8 +1436,11 @@ def main(argv: Optional[list] = None) -> None:
         # The serving subsystem: `tpu-mnist serve --checkpoint-dir ...`
         # boots the bucketed AOT inference engine + micro-batcher + hot
         # reload watcher over a training run's checkpoint directory
-        # (serve/server.py). A subcommand, not a flag: serving has its
-        # own flag surface and lifecycle (a process that never exits).
+        # (serve/server.py); `--serve-devices N` scales the data plane
+        # to N engine replicas x N local chips with `--max-inflight`
+        # pipelined dispatch (serve/pool.py). A subcommand, not a flag:
+        # serving has its own flag surface and lifecycle (a process that
+        # never exits).
         from pytorch_distributed_mnist_tpu.serve.server import (
             main as serve_main,
         )

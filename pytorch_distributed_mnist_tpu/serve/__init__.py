@@ -10,9 +10,14 @@ REQUESTS — the north-star's "serves heavy traffic" capability. Pieces:
 - ``batcher.py``: :class:`MicroBatcher` — dynamic micro-batching with a
   max-wait deadline, max-batch coalescing, and bounded-queue admission
   control (:class:`Overloaded` instead of unbounded latency);
+- ``pool.py``: :class:`EnginePool` — the multi-chip data plane: one
+  engine replica per local device (per-device params + AOT programs)
+  behind a least-loaded dispatcher, driven through the batcher's
+  pipelined dispatch/complete stages (``--serve-devices`` /
+  ``--max-inflight``);
 - ``reload.py``: :class:`CheckpointWatcher` — polls a published
   checkpoint directory (``train/checkpoint.py`` conventions) and swaps
-  params atomically between batches;
+  params atomically between batches (fanned out per replica on a pool);
 - ``server.py``: the ``serve`` CLI subcommand — a stdlib HTTP JSON
   endpoint with ``/predict``, ``/healthz``, ``/stats``.
 
@@ -22,10 +27,13 @@ Drive it with ``tools/loadgen.py``; measure it with
 
 from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
 from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.serve.pool import EnginePool, EngineReplica
 from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
 
 __all__ = [
     "CheckpointWatcher",
+    "EnginePool",
+    "EngineReplica",
     "InferenceEngine",
     "MicroBatcher",
     "Overloaded",

@@ -16,6 +16,23 @@ bound, a stalled or slow engine converts overload into unbounded queue
 growth and minutes-long latency for every request already in line, which
 is strictly worse than telling new arrivals to back off.
 
+The worker is split into two stages. The **form/dispatch** stage
+coalesces a batch and hands it to ``dispatch_fn`` — which, against the
+engine/pool two-phase API, stages + pads the batch and ENQUEUES the
+device execution without waiting (JAX async dispatch) — then
+immediately forms the next batch. The **completion** stage pops
+dispatched batches FIFO, blocks on ``complete_fn`` (the result fetch),
+and delivers results, errors, and accounting exactly as the single
+worker did. ``max_inflight`` bounds how many batches may sit between
+dispatch and completion: batch N+1's host-side preprocessing/padding
+overlaps batch N's device execution instead of serializing behind its
+result fetch, and across a replica pool up to ``max_inflight`` batches
+execute on different chips concurrently. ``max_inflight=1`` restores
+strict dispatch→complete alternation — byte-for-byte the pre-pipelining
+behavior — and the classic single-callable ``infer_fn`` form runs the
+whole inference inside the dispatch stage, so stub-driven tests and the
+single-device server are unchanged.
+
 Per-request accounting: enqueue->batch-formed (queue wait) and
 enqueue->result (total latency) land in the :class:`ServeLog` the server
 exposes at ``/stats``.
@@ -23,6 +40,7 @@ exposes at ``/stats``.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Callable, List, Optional
@@ -71,33 +89,70 @@ class _Pending:
 class MicroBatcher:
     """Coalesces concurrent requests into engine-sized batches.
 
-    ``infer_fn(images) -> outputs`` maps a float/uint8 row-stack to a
-    per-row output stack (first dims equal); the engine's ``predict`` is
-    the production value, but any callable works — the unit tests drive
-    the state machine with stubs, no device or socket required.
+    Two inference forms:
+
+    - ``infer_fn(images) -> outputs`` maps a float/uint8 row-stack to a
+      per-row output stack (first dims equal); the engine's ``predict``
+      is the production value, but any callable works — the unit tests
+      drive the state machine with stubs, no device or socket required.
+      The whole call runs inside the dispatch stage (no pipelining gain,
+      full behavioral compatibility).
+    - ``dispatch_fn(images) -> handle`` + ``complete_fn(handle) ->
+      outputs`` (passed together, ``infer_fn=None``): the two-phase form
+      the engine/pool expose. Dispatch enqueues device work and returns
+      immediately; completion blocks on the fetch — with
+      ``max_inflight > 1`` the stages overlap.
+
+    ``max_inflight`` bounds batches dispatched but not completed
+    (default 1: strict alternation, the pre-pipelining behavior).
     """
 
     def __init__(
         self,
-        infer_fn: Callable[[np.ndarray], np.ndarray],
+        infer_fn: Optional[Callable[[np.ndarray], np.ndarray]],
         max_batch: int,
         max_wait_s: float = 0.005,
         max_queue: int = 256,
         serve_log=None,
+        dispatch_fn: Optional[Callable] = None,
+        complete_fn: Optional[Callable] = None,
+        max_inflight: int = 1,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {max_inflight}")
+        if (dispatch_fn is None) != (complete_fn is None):
+            raise ValueError(
+                "dispatch_fn and complete_fn come as a pair")
+        if (infer_fn is None) == (dispatch_fn is None):
+            raise ValueError(
+                "exactly one of infer_fn or dispatch_fn/complete_fn "
+                "is required")
+        if infer_fn is not None:
+            # Classic form: the full inference runs at dispatch; the
+            # "handle" is already the output stack.
+            dispatch_fn, complete_fn = infer_fn, lambda out: out
         self.infer_fn = infer_fn
+        self.dispatch_fn = dispatch_fn
+        self.complete_fn = complete_fn
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self.max_queue = int(max_queue)
+        self.max_inflight = int(max_inflight)
         self.serve_log = serve_log
         self._cv = threading.Condition()
         self._queue: List[_Pending] = []
         self._stopped = False
+        # dispatch -> completion conduit: (taken, handle, dispatch_error)
+        # triples, FIFO; bounded by the _window semaphore, not the queue.
+        self._inflight: "queue.Queue" = queue.Queue()
+        self._window = threading.Semaphore(self.max_inflight)
         self._thread: Optional[threading.Thread] = None
+        self._completion: Optional[threading.Thread] = None
         if serve_log is not None:
             serve_log.set_queue_depth_probe(self.queue_depth)
 
@@ -106,19 +161,28 @@ class MicroBatcher:
     def start(self) -> "MicroBatcher":
         if self._thread is None:
             self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="serve-batcher")
+                target=self._dispatch_loop, daemon=True,
+                name="serve-batcher")
+            self._completion = threading.Thread(
+                target=self._completion_loop, daemon=True,
+                name="serve-completion")
             self._thread.start()
+            self._completion.start()
         return self
 
     def close(self) -> None:
-        """Stop the worker; queued requests are drained first so a clean
-        shutdown never strands a caller blocked on ``result``."""
+        """Stop the workers; queued requests are drained first (formed,
+        dispatched, completed) so a clean shutdown never strands a caller
+        blocked on ``result``."""
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._completion is not None:
+            self._completion.join()
+            self._completion = None
 
     def __enter__(self) -> "MicroBatcher":
         return self.start()
@@ -239,30 +303,79 @@ class MicroBatcher:
                     p.t_batched = t
                 return taken
 
-    def _run_batch(self, taken: List[_Pending]) -> None:
-        images = (taken[0].images if len(taken) == 1
-                  else np.concatenate([p.images for p in taken], axis=0))
+    def _dispatch_loop(self) -> None:
+        """Form/dispatch stage: coalesce a batch, hand it to
+        ``dispatch_fn`` (which enqueues device work and returns — or, in
+        the classic ``infer_fn`` form, runs the whole inference), and
+        immediately form the next one. The ``_window`` semaphore holds
+        dispatch ``max_inflight`` batches ahead of completion at most;
+        with a window of 1 this loop alternates with completion exactly
+        like the original single worker."""
         try:
-            out = np.asarray(self.infer_fn(images))
-        except BaseException as exc:  # noqa: BLE001 - delivered per request
+            while True:
+                self._window.acquire()
+                taken = self._take_batch()
+                if not taken:
+                    self._window.release()
+                    return  # stopped and drained
+                handle, error = None, None
+                try:
+                    # Concatenation inside the try: co-batched requests
+                    # with mismatched trailing shapes (submit validates
+                    # only ndim) must become per-request errors, not a
+                    # dead worker.
+                    images = (taken[0].images if len(taken) == 1
+                              else np.concatenate(
+                                  [p.images for p in taken], axis=0))
+                    handle = self.dispatch_fn(images)
+                except BaseException as exc:  # noqa: BLE001 - per-request
+                    error = exc
+                self._inflight.put((taken, handle, error))
+        finally:
+            # ALWAYS hand completion its shutdown sentinel — a dispatch
+            # thread dying any other way would otherwise leave close()
+            # blocked forever on the completion join.
+            self._inflight.put(None)
+
+    def _completion_loop(self) -> None:
+        """Completion stage: pop dispatched batches FIFO, block on the
+        result fetch, deliver results/errors/accounting per request —
+        exactly what the tail of the original worker loop did."""
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            taken, handle, error = item
+            try:
+                self._complete_batch(taken, handle, error)
+            finally:
+                self._window.release()
+
+    def _complete_batch(self, taken: List[_Pending], handle,
+                        error) -> None:
+        out = None
+        if error is None:
+            # Validation INSIDE the try: a malformed return (0-d array,
+            # wrong row count) must become a per-request error — an
+            # exception escaping here would kill the completion thread
+            # and wedge close() behind the window semaphore.
+            try:
+                out = np.asarray(self.complete_fn(handle))
+                rows = sum(p.rows for p in taken)
+                if out.ndim == 0 or out.shape[0] != rows:
+                    which = ("infer_fn" if self.infer_fn is not None
+                             else "complete_fn")
+                    raise RuntimeError(
+                        f"{which} returned "
+                        f"{'a scalar' if out.ndim == 0 else out.shape[0]}"
+                        f" row(s) for {rows} inputs")
+            except BaseException as exc:  # noqa: BLE001 - per-request delivery
+                error = exc
+        if error is not None:
             for p in taken:
-                p.finish(None, exc, self.serve_log)
-            return
-        if out.shape[0] != sum(p.rows for p in taken):
-            exc = RuntimeError(
-                f"infer_fn returned {out.shape[0]} rows for "
-                f"{sum(p.rows for p in taken)} inputs")
-            for p in taken:
-                p.finish(None, exc, self.serve_log)
+                p.finish(None, error, self.serve_log)
             return
         off = 0
         for p in taken:
             p.finish(out[off:off + p.rows], None, self.serve_log)
             off += p.rows
-
-    def _loop(self) -> None:
-        while True:
-            taken = self._take_batch()
-            if not taken:
-                return  # stopped and drained
-            self._run_batch(taken)

@@ -21,12 +21,39 @@ loaders use. Params are an explicit argument of the compiled programs
 ``swap_params`` is an atomic reference swap between batches; an in-flight
 batch keeps the params it captured at call entry, the next batch sees the
 new ones, and no executable is invalidated.
+
+Two data-plane mechanisms serve the multi-chip pool (``serve/pool.py``):
+
+- **Device pinning.** An engine built with ``device=`` commits params
+  and compiles its bucket programs for THAT device
+  (``SingleDeviceSharding`` on params, inputs, and outputs), so N
+  engines on N local chips execute concurrently instead of contending
+  for ``devices()[0]``. ``device=None`` keeps today's default placement
+  bit-for-bit.
+- **Dispatch/complete split.** ``dispatch_logits`` stages the batch,
+  enqueues the device execution, and returns immediately with an
+  :class:`_InFlightBatch` (JAX async dispatch: the returned arrays are
+  futures); ``complete`` blocks on the result fetch. The pipelined
+  batcher overlaps batch N+1's host-side preprocessing and padding with
+  batch N's device execution through exactly this seam —
+  ``logits_with_epoch`` is just dispatch immediately followed by
+  complete, so the synchronous path cannot drift from the pipelined one.
+
+Staging-buffer lifecycle: padding a batch up to its bucket reuses a
+per-bucket float32 buffer from a free-list instead of allocating per
+batch. A buffer is acquired at dispatch, referenced by the in-flight
+batch until its completion fetch proves the device has consumed the
+input, then returned to the free-list — so the steady-state pool depth
+equals the in-flight window and per-batch allocation drops to zero, and
+the reuse is safe even on backends that alias host buffers into device
+arrays. Exact-fit float32 C-contiguous batches skip the staging copy
+entirely (the bitwise-exactness tests pin that path).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -41,15 +68,45 @@ from pytorch_distributed_mnist_tpu.train.steps import (
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 
+class _InFlightBatch:
+    """One dispatched-but-not-fetched batch: the device arrays (futures
+    under JAX async dispatch), the epoch of the params that computed
+    them, and the staging buffers the batch still pins. ``complete()``
+    blocks on the fetch and releases the buffers."""
+
+    __slots__ = ("engine", "chunks", "epoch", "buffers")
+
+    def __init__(self, engine: "InferenceEngine", chunks, epoch,
+                 buffers) -> None:
+        self.engine = engine
+        self.chunks = chunks  # [(device_logits, real_rows), ...]
+        self.epoch = epoch
+        self.buffers = buffers  # staging buffers pinned until complete
+
+    def complete(self) -> Tuple[np.ndarray, Optional[int]]:
+        return self.engine.complete(self)
+
+
 class InferenceEngine:
     """Params + one AOT-compiled forward executable per batch bucket.
 
-    Threading contract: ``logits``/``predict`` are called from ONE thread
-    at a time (the batcher worker serializes device work — concurrent
-    forward calls would just contend for the same chips); ``swap_params``
-    may be called from any thread (the reload watcher) at any moment.
-    The only shared mutable state is the params reference, read once per
-    batch under the lock.
+    Threading contract: ``logits``/``predict``/``dispatch_logits`` are
+    called from ONE thread at a time (the batcher's dispatch worker
+    serializes device submission — concurrent forward calls to one chip
+    would just contend for it); ``complete`` runs on the batcher's
+    completion worker, which only touches the in-flight batch's own
+    state plus the staging free-list (its own lock); ``swap_params`` may
+    be called from any thread (the reload watcher) at any moment. The
+    only shared mutable state is the params reference + epoch, read
+    together once per batch under the lock.
+
+    ``device``: pin this engine to one local device — params are
+    committed there and every bucket program is AOT-compiled for it
+    (the replica-pool placement). ``None`` keeps jax's default
+    placement, identical to the single-device data plane this engine
+    shipped with. ``name`` suffixes the per-bucket ``CompileLog``
+    program names (``serve_forward_b8@r2``) so a pool's compile stats
+    and the zero-recompile check stay attributable per replica.
     """
 
     def __init__(
@@ -60,6 +117,8 @@ class InferenceEngine:
         input_shape: Tuple[int, ...] = (28, 28, 1),
         serve_log=None,
         params_epoch: Optional[int] = None,
+        device=None,
+        name: Optional[str] = None,
     ) -> None:
         buckets = sorted({int(b) for b in buckets})
         if not buckets or buckets[0] < 1:
@@ -67,13 +126,35 @@ class InferenceEngine:
         self.buckets = tuple(buckets)
         self.input_shape = tuple(input_shape)
         self.serve_log = serve_log
+        self.device = device
+        self.name = name
         self._forward = make_forward_program(apply_fn)
-        self._jit = jax.jit(self._forward)  # lazy fallback, identical program
+        if device is not None:
+            # Pin params, inputs, and outputs to THIS device so the AOT
+            # executables land there (default lowering would compile for
+            # devices()[0] and reject arguments committed elsewhere).
+            self._sharding = jax.sharding.SingleDeviceSharding(device)
+            self._jit = jax.jit(self._forward, in_shardings=self._sharding,
+                                out_shardings=self._sharding)
+        else:
+            self._sharding = None
+            self._jit = jax.jit(self._forward)  # lazy fallback, same program
         self._lock = threading.Lock()
         # Committed to device once per swap, not once per request.
-        self._params = jax.device_put(params)
+        self._params = self._place(params)
         self._params_epoch = params_epoch
         self._compiled = {}  # bucket -> Compiled executable
+        # bucket -> free staging buffers (see module docstring lifecycle).
+        self._staging_lock = threading.Lock()
+        self._staging: dict = {b: [] for b in self.buckets}
+        self._staging_allocated = {b: 0 for b in self.buckets}
+
+    def _place(self, tree):
+        """Commit ``tree`` to this engine's device (default placement
+        when unpinned)."""
+        if self._sharding is not None:
+            return jax.device_put(tree, self._sharding)
+        return jax.device_put(tree)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -86,14 +167,21 @@ class InferenceEngine:
         with self._lock:
             return self._params_epoch
 
+    def program_name(self, bucket: int) -> str:
+        """The ``CompileLog`` program name of one bucket's executable —
+        ``serve_forward_b{bucket}``, suffixed ``@{name}`` on a named
+        (pool-replica) engine so compile stats stay per-replica."""
+        base = f"serve_forward_b{bucket}"
+        return f"{base}@{self.name}" if self.name else base
+
     def warmup(self) -> None:
         """AOT-compile every bucket's forward program (idempotent).
 
-        Each program is measured under ``serve_forward_b{bucket}`` in the
+        Each program is measured under ``program_name(bucket)`` in the
         process ``CompileLog``, so startup cost is attributable per bucket
-        and the zero-steady-state-recompiles acceptance check has an
-        anchor to diff against. With a warm persistent compile cache these
-        degenerate to executable fetches.
+        (and per replica) and the zero-steady-state-recompiles acceptance
+        check has an anchor to diff against. With a warm persistent
+        compile cache these degenerate to executable fetches.
         """
         with self._lock:
             params_spec = abstract_spec(self._params)
@@ -104,22 +192,34 @@ class InferenceEngine:
                 (bucket,) + self.input_shape, np.float32)
             self._compiled[bucket] = precompile(
                 self._jit, params_spec, image_spec,
-                program=f"serve_forward_b{bucket}")
+                program=self.program_name(bucket))
 
     def swap_params(self, params, epoch: Optional[int] = None,
-                    path: Optional[str] = None) -> None:
+                    path: Optional[str] = None) -> bool:
         """Atomically install new params (checkpoint hot-reload); the
         signature is exactly the reload watcher's ``on_params`` callback.
+        Returns True when installed, False when rejected as stale.
 
         The device_put runs OUTSIDE the lock (it is the slow part); the
         installed reference swap is what in-flight batches race against,
         and they only ever read the reference once, at call entry.
+        Because the slow part is unlocked, two concurrent swaps can reach
+        the install point in either order — so the install compares
+        epochs UNDER the lock and refuses to put an older checkpoint over
+        a newer one (the swap-ordering guarantee; a pool fan-out applies
+        this rule per replica). Epoch-less swaps (fresh-init params, unit
+        tests) always install: the ordering rule is about checkpoint
+        provenance, and they have none.
         """
         del path  # provenance lives on the watcher (current_path)
-        placed = jax.device_put(params)
+        placed = self._place(params)
         with self._lock:
+            if (epoch is not None and self._params_epoch is not None
+                    and epoch < self._params_epoch):
+                return False  # a newer checkpoint already installed
             self._params = placed
             self._params_epoch = epoch
+            return True
 
     # -- inference ---------------------------------------------------------
 
@@ -159,16 +259,56 @@ class InferenceEngine:
             f"images or float32 (N, {', '.join(map(str, self.input_shape))})"
             f" normalized images; got {arr.dtype} {arr.shape}")
 
-    def _run_bucket(self, params, images: np.ndarray) -> np.ndarray:
-        """One padded forward on one bucket executable; returns logits for
-        the real rows only."""
+    # -- staging-buffer lifecycle -----------------------------------------
+
+    def _acquire_staging(self, bucket: int) -> np.ndarray:
+        """Pop a free staging buffer for ``bucket`` (allocate only when
+        the free-list is dry — i.e. only until the pool has grown to the
+        in-flight window's depth)."""
+        with self._staging_lock:
+            free = self._staging[bucket]
+            if free:
+                return free.pop()
+            self._staging_allocated[bucket] += 1
+        return np.zeros((bucket,) + self.input_shape, np.float32)
+
+    def _release_staging(self, buffers: List[Tuple[int, np.ndarray]]) -> None:
+        with self._staging_lock:
+            for bucket, buf in buffers:
+                self._staging[bucket].append(buf)
+
+    def staging_allocated(self) -> dict:
+        """Total buffers ever allocated per bucket — the steady-state
+        invariant (no per-batch allocation) is that this stops growing
+        once the in-flight window is warm; tests pin it."""
+        with self._staging_lock:
+            return dict(self._staging_allocated)
+
+    # -- dispatch / complete ----------------------------------------------
+
+    def _dispatch_bucket(self, params, images: np.ndarray, buffers):
+        """Stage one chunk into its bucket and enqueue the forward on the
+        device (JAX async dispatch: returns the un-fetched device logits
+        without waiting). Any staging buffer used is appended to
+        ``buffers`` so the in-flight batch pins it until completion."""
         n = images.shape[0]
         bucket = self.bucket_for(n)
-        if n < bucket:
-            pad = np.zeros((bucket - n,) + images.shape[1:], images.dtype)
-            images = np.concatenate([images, pad], axis=0)
+        if (n == bucket and images.dtype == np.float32
+                and images.flags["C_CONTIGUOUS"]):
+            # Exact fit, already float32-contiguous: no pad, no copy —
+            # the array goes to the device as-is (bitwise-pinned equal to
+            # the padded path by the exactness tests).
+            staged = images
+        else:
+            buf = self._acquire_staging(bucket)
+            buf[:n] = images
+            if n < bucket:
+                buf[n:] = 0.0  # padded rows are zeros, as they always were
+            staged = buf
+            buffers.append((bucket, buf))
         compiled = self._compiled.get(bucket)
-        x = jax.numpy.asarray(images)
+        x = self._place(staged) if self._sharding is not None \
+            else jax.numpy.asarray(staged)
         if compiled is not None:
             out = compiled(params, x)
         else:
@@ -177,25 +317,51 @@ class InferenceEngine:
             # what warmup buys.
             out = self._jit(params, x)
         if self.serve_log is not None:
-            self.serve_log.record_batch(n, bucket)
-        return np.asarray(out)[:n]
+            self.serve_log.record_batch(n, bucket, replica=self.name)
+        return out
+
+    def dispatch_logits(self, images) -> _InFlightBatch:
+        """Preprocess + stage + enqueue the forward WITHOUT waiting for
+        the result: the returned :class:`_InFlightBatch` holds device
+        arrays that materialize under JAX async dispatch while the caller
+        goes on to form/stage the next batch. Params and epoch are
+        captured together under the lock, once for every chunk — the same
+        swap-atomicity boundary the synchronous path has. Batches larger
+        than the top bucket are chunked through it."""
+        x = self.preprocess(images)
+        with self._lock:
+            params = self._params  # captured ONCE: swap-atomicity boundary
+            epoch = self._params_epoch
+        chunks, buffers = [], []
+        try:
+            for start in range(0, x.shape[0], self.max_batch):
+                chunk = x[start:start + self.max_batch]
+                chunks.append((self._dispatch_bucket(params, chunk, buffers),
+                               chunk.shape[0]))
+        except BaseException:
+            self._release_staging(buffers)
+            raise
+        return _InFlightBatch(self, chunks, epoch, buffers)
+
+    def complete(self, inflight: _InFlightBatch) \
+            -> Tuple[np.ndarray, Optional[int]]:
+        """Block on an in-flight batch's device results, release its
+        staging buffers, and return ``(logits (N, classes), epoch)``."""
+        try:
+            out = [np.asarray(dev)[:n] for dev, n in inflight.chunks]
+        finally:
+            self._release_staging(inflight.buffers)
+            inflight.buffers = []
+        return np.concatenate(out, axis=0), inflight.epoch
 
     def logits_with_epoch(self, images) -> Tuple[np.ndarray, Optional[int]]:
         """Forward ``images`` (raw uint8 or normalized float32) through
         the bucketed programs; returns ``(logits (N, classes), epoch)``
         where ``epoch`` is the checkpoint epoch of the params that
-        ACTUALLY computed these logits — params and epoch are captured
-        together under the lock, so a hot reload landing mid-call can
-        never mislabel a batch's provenance. Batches larger than the top
-        bucket are chunked through it (one capture for all chunks)."""
-        x = self.preprocess(images)
-        with self._lock:
-            params = self._params  # captured ONCE: swap-atomicity boundary
-            epoch = self._params_epoch
-        out = []
-        for start in range(0, x.shape[0], self.max_batch):
-            out.append(self._run_bucket(params, x[start:start + self.max_batch]))
-        return np.concatenate(out, axis=0), epoch
+        ACTUALLY computed these logits. Dispatch immediately followed by
+        complete: the synchronous path and the pipelined one are the same
+        code."""
+        return self.dispatch_logits(images).complete()
 
     def logits(self, images) -> np.ndarray:
         return self.logits_with_epoch(images)[0]

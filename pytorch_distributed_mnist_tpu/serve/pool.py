@@ -1,0 +1,224 @@
+"""Multi-chip serving data plane: one engine replica per local device.
+
+A single :class:`~pytorch_distributed_mnist_tpu.serve.engine.
+InferenceEngine` drives exactly one chip; on an 8-chip host that leaves
+7 idle. The pool owns one :class:`EngineReplica` per local device — each
+replica is a full engine pinned to its device (params ``device_put``
+there, every bucket program AOT-compiled for it through the same
+``precompile``/``CompileLog`` path, so compile stats and the
+zero-recompile invariant stay per replica) — behind a dispatcher that
+hands each formed batch to the least-loaded replica. MNIST inference is
+embarrassingly parallel across batches, so replica fan-out is the whole
+scaling story: no cross-chip collective runs on the serve path.
+
+Dispatch is two-phase, mirroring the engine's dispatch/complete split:
+``dispatch`` picks a replica, enqueues the device execution (JAX async
+dispatch — returns immediately), and tracks the replica's in-flight
+count; ``complete`` blocks on that batch's fetch and releases the
+count. The pipelined batcher calls dispatch from its form/dispatch
+worker and complete from its completion worker, so up to
+``max_inflight`` batches execute concurrently across replicas while
+host-side staging for the next batch proceeds.
+
+Checkpoint hot-reload fans out: the watcher loads the checkpoint from
+disk ONCE on the host, then ``swap_params`` installs it per replica
+(one ``device_put`` per device). Each replica applies the engine's
+swap-ordering rule — epochs compared under the replica's lock, an older
+checkpoint never installs over a newer one — and each batch still
+reports the epoch of the params that ACTUALLY computed it, captured
+under the owning replica's lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    InferenceEngine,
+    _InFlightBatch,
+)
+
+
+class EngineReplica:
+    """One pinned engine + the pool's dispatch bookkeeping for it.
+
+    ``pending`` (batches dispatched, not yet completed) is owned by the
+    POOL's lock, not the replica: dispatch-time placement decisions need
+    a consistent view across all replicas.
+    """
+
+    __slots__ = ("index", "name", "device", "engine", "pending",
+                 "dispatched")
+
+    def __init__(self, index: int, device, engine: InferenceEngine) -> None:
+        self.index = index
+        self.name = f"r{index}"
+        self.device = device
+        self.engine = engine
+        self.pending = 0  # in-flight batches (pool lock)
+        self.dispatched = 0  # lifetime batches assigned (pool lock)
+
+
+class _PoolHandle:
+    """An in-flight batch plus the replica that owns it."""
+
+    __slots__ = ("replica", "inflight")
+
+    def __init__(self, replica: EngineReplica,
+                 inflight: _InFlightBatch) -> None:
+        self.replica = replica
+        self.inflight = inflight
+
+
+class EnginePool:
+    """N engine replicas × N local devices behind a least-loaded
+    dispatcher.
+
+    Exposes the same surface the server's handlers and the reload
+    watcher use on a bare engine (``preprocess``, ``buckets``,
+    ``max_batch``, ``params_epoch``, ``swap_params``), so a pool drops
+    in wherever one engine did.
+    """
+
+    def __init__(
+        self,
+        apply_fn,
+        params,
+        devices: Optional[Sequence] = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        input_shape: Tuple[int, ...] = (28, 28, 1),
+        serve_log=None,
+        params_epoch: Optional[int] = None,
+    ) -> None:
+        devices = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        if not devices:
+            raise ValueError("EnginePool needs at least one device")
+        self.serve_log = serve_log
+        self._lock = threading.Lock()
+        self.replicas: List[EngineReplica] = []
+        for i, device in enumerate(devices):
+            name = f"r{i}"
+            engine = InferenceEngine(
+                apply_fn, params, buckets=buckets, input_shape=input_shape,
+                serve_log=serve_log, params_epoch=params_epoch,
+                device=device, name=name)
+            self.replicas.append(EngineReplica(i, device, engine))
+        if serve_log is not None:
+            serve_log.set_replicas_probe(self.snapshot)
+
+    # -- engine-compatible surface ----------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def buckets(self):
+        return self.replicas[0].engine.buckets
+
+    @property
+    def max_batch(self) -> int:
+        return self.replicas[0].engine.max_batch
+
+    @property
+    def params_epoch(self) -> Optional[int]:
+        """The fleet's serving epoch: replica 0's (the swap fan-out is
+        all-or-stale, so replicas only ever disagree for the microseconds
+        a fan-out is mid-walk)."""
+        return self.replicas[0].engine.params_epoch
+
+    def preprocess(self, images) -> np.ndarray:
+        return self.replicas[0].engine.preprocess(images)
+
+    def warmup(self) -> None:
+        """AOT-compile every replica's bucket programs, replicas in
+        parallel (CompileLog attribution is thread-local, so each
+        replica's compiles land under its own ``@r{i}`` program names).
+        With a warm persistent cache these are fetches; cold, the
+        parallelism overlaps N replicas' compile wall-clock."""
+        errors: List[BaseException] = []
+
+        def _warm(replica: EngineReplica) -> None:
+            try:
+                replica.engine.warmup()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=_warm, args=(r,), daemon=True,
+                                    name=f"pool-warmup-{r.name}")
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    def swap_params(self, params, epoch: Optional[int] = None,
+                    path: Optional[str] = None) -> int:
+        """Fan one host-side checkpoint load out to every replica (one
+        ``device_put`` per device). Each replica enforces the
+        swap-ordering rule under its own lock, so a stale fan-out racing
+        a newer one can never downgrade any replica. Returns the number
+        of replicas that installed (0 == stale everywhere)."""
+        installed = 0
+        for replica in self.replicas:
+            if replica.engine.swap_params(params, epoch=epoch, path=path):
+                installed += 1
+        return installed
+
+    # -- dispatch / complete ----------------------------------------------
+
+    def dispatch(self, images) -> _PoolHandle:
+        """Assign one formed batch to the least-loaded replica and
+        enqueue it there (JAX async dispatch: returns immediately; the
+        bounded in-flight window lives in the batcher, which is the only
+        caller that can overrun the fleet)."""
+        with self._lock:
+            replica = min(self.replicas, key=lambda r: (r.pending, r.index))
+            replica.pending += 1
+            replica.dispatched += 1
+        try:
+            inflight = replica.engine.dispatch_logits(images)
+        except BaseException:
+            with self._lock:
+                replica.pending -= 1
+            raise
+        return _PoolHandle(replica, inflight)
+
+    def complete(self, handle: _PoolHandle) \
+            -> Tuple[np.ndarray, Optional[int]]:
+        """Block on one dispatched batch's results; returns
+        ``(logits (N, classes), epoch)`` with the epoch captured at that
+        batch's dispatch on its replica."""
+        try:
+            return handle.inflight.complete()
+        finally:
+            with self._lock:
+                handle.replica.pending -= 1
+
+    def predict_complete(self, handle: _PoolHandle) \
+            -> Tuple[np.ndarray, Optional[int]]:
+        """``complete`` + host-side argmax: ``(labels (N,), epoch)``."""
+        logits, epoch = self.complete(handle)
+        return np.argmax(logits, axis=-1), epoch
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Per-replica rows for ``/stats`` and the JSONL sink: device,
+        serving epoch, in-flight and lifetime dispatch counts."""
+        with self._lock:
+            rows = {r.name: {"device": str(r.device),
+                             "pending": r.pending,
+                             "dispatched": r.dispatched}
+                    for r in self.replicas}
+        for replica in self.replicas:
+            rows[replica.name]["params_epoch"] = replica.engine.params_epoch
+        return rows

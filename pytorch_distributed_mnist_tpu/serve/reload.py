@@ -33,7 +33,11 @@ class CheckpointWatcher:
     """Polls ``directory`` and hands newly published params to ``on_params``.
 
     ``on_params(params, epoch, path)`` runs on the watcher thread and must
-    be cheap + thread-safe (the engine's ``swap_params`` is both).
+    be cheap + thread-safe (the engine's ``swap_params`` is both; the
+    pool's fans the ONE host-side load out to a per-replica swap). A
+    falsy non-None return means the swap was refused as stale — every
+    engine behind the callback already serves a newer epoch — and is not
+    recorded as a reload.
     ``current_path`` marks the checkpoint already loaded at boot so the
     first poll doesn't redundantly reload it. ``poll_once`` is public and
     thread-free so tests drive the state machine deterministically.
@@ -111,9 +115,18 @@ class CheckpointWatcher:
             print(f"serve reload: failed to load {path!r} ({policy}; "
                   f"still serving current params): {exc!r}", flush=True)
             return False
-        self._on_params(params, epoch, path)
+        installed = self._on_params(params, epoch, path)
         self._current = path
         self._failed = None
+        if installed is not None and not installed:
+            # The engine/pool applied its swap-ordering rule and refused:
+            # every replica already serves a NEWER epoch than this file
+            # (e.g. a slow load raced a faster one). The file itself was
+            # fine — mark it current so it isn't re-loaded, but it never
+            # served, so no reload is recorded.
+            print(f"serve reload: {path!r} (epoch {epoch}) is staler than "
+                  f"the serving params; skipped", flush=True)
+            return False
         if self.serve_log is not None:
             self.serve_log.record_reload(path, epoch)
         print(f"serve reload: now serving {path!r} (epoch {epoch})",

@@ -8,11 +8,16 @@
 pay a compile), the
 :class:`~pytorch_distributed_mnist_tpu.serve.batcher.MicroBatcher`, and
 the :class:`~pytorch_distributed_mnist_tpu.serve.reload.CheckpointWatcher`
-sharing the training run's checkpoint directory.
+sharing the training run's checkpoint directory. With
+``--serve-devices N`` (0 = all local devices) the engine becomes an
+:class:`~pytorch_distributed_mnist_tpu.serve.pool.EnginePool` — one
+replica per chip behind a least-loaded dispatcher — and the batcher
+pipelines up to ``--max-inflight`` batches (default replicas+1) between
+its form/dispatch and completion stages.
 
 Endpoints (stdlib ``http.server``; one handler thread per connection,
-all of them funneling into the single batcher worker that owns the
-device):
+all of them funneling into the batcher's dispatch worker that owns
+device submission):
 
 - ``POST /predict`` — body ``{"images": ...}``: one 28x28 image or a
   list of them, raw 0-255 pixel values. Replies
@@ -78,6 +83,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated batch buckets, each AOT-compiled "
                         "at startup; batches pad up to the nearest bucket "
                         "so steady-state serving never recompiles")
+    p.add_argument("--serve-devices", type=int, default=1,
+                   help="engine replicas, one per local device (0 = every "
+                        "local device): params are committed and bucket "
+                        "programs AOT-compiled per device, and formed "
+                        "batches go to the least-loaded replica. Default "
+                        "1 is the single-device data plane")
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="pipelined dispatch window: batches dispatched "
+                        "but not yet completed (0 = auto: replicas+1 on "
+                        "a multi-replica pool, 1 otherwise; 1 disables "
+                        "pipelining — batch N+1's host-side staging then "
+                        "serializes behind batch N's result fetch)")
     p.add_argument("--max-wait-ms", type=float, default=5.0,
                    help="micro-batcher deadline: a request waits at most "
                         "this long for co-riders before its batch flushes")
@@ -126,13 +143,22 @@ MAX_BODY_BYTES = 16 << 20
 
 class ServeContext:
     """Everything one serving process owns; built by :func:`create_server`
-    and shared with the HTTP handlers via the server object."""
+    and shared with the HTTP handlers via the server object.
+
+    ``engine`` is the data plane the handlers talk to: a bare
+    :class:`InferenceEngine` on the single-device plane, an
+    :class:`~pytorch_distributed_mnist_tpu.serve.pool.EnginePool` on the
+    multi-chip one (same surface: ``preprocess``/``buckets``/
+    ``params_epoch``). ``pool`` is set only in the pooled case."""
 
     def __init__(self, engine, batcher, watcher, serve_log, sink,
                  model_name: str, boot_path: Optional[str] = None,
-                 max_request_images: int = 1024) -> None:
+                 max_request_images: int = 1024, pool=None,
+                 max_inflight: int = 1) -> None:
         self.max_request_images = max_request_images
         self.engine = engine
+        self.pool = pool
+        self.max_inflight = max_inflight
         self.batcher = batcher
         self.watcher = watcher
         self.serve_log = serve_log
@@ -204,6 +230,9 @@ class _Handler(BaseHTTPRequestHandler):
             }
             stats["buckets"] = list(ctx.engine.buckets)
             stats["model_epoch"] = ctx.engine.params_epoch
+            if ctx.pool is not None:
+                stats["serve_devices"] = ctx.pool.n_replicas
+                stats["max_inflight"] = ctx.max_inflight
             self._reply(200, stats)
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
@@ -340,37 +369,85 @@ def create_server(args) -> ThreadingHTTPServer:
         sink = JsonlSink(metrics_file)
         serve_log.set_sink(sink, source="serve")
 
-    engine = InferenceEngine(
-        model.apply, params, buckets=_parse_buckets(args.buckets),
-        serve_log=serve_log, params_epoch=epoch,
-    )
+    # Data-plane shape: --serve-devices replicas (0 = all local devices)
+    # with a --max-inflight pipelined dispatch window (0 = auto). The
+    # default (1 replica, window 1) is the single-device plane, built
+    # exactly as it always was.
+    devices = jax.local_devices()
+    n_devices = getattr(args, "serve_devices", 1)
+    if n_devices == 0:
+        n_devices = len(devices)
+    if n_devices < 0 or n_devices > len(devices):
+        raise SystemExit(
+            f"--serve-devices {n_devices}: this host has "
+            f"{len(devices)} local device(s)")
+    max_inflight = getattr(args, "max_inflight", 0)
+    if max_inflight < 0:
+        raise SystemExit(f"--max-inflight {max_inflight}: must be >= 0")
+    if max_inflight == 0:
+        max_inflight = n_devices + 1 if n_devices > 1 else 1
+    pooled = n_devices > 1 or max_inflight > 1
+
+    def _tag(labels, epoch):
+        # Row-tagged outputs (label, epoch): the epoch is captured WITH
+        # the params inside the engine, and all rows of one batcher batch
+        # ride one engine call (hence ONE replica), so per-request slices
+        # stay consistent and the HTTP reply reports the checkpoint that
+        # really computed it.
+        tag = np.full_like(labels, -1 if epoch is None else epoch)
+        return np.stack([labels, tag], axis=1)
+
     t0 = time.perf_counter()
-    engine.warmup()
+    pool = None
+    if pooled:
+        from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
+
+        pool = EnginePool(
+            model.apply, params, devices=devices[:n_devices],
+            buckets=_parse_buckets(args.buckets), serve_log=serve_log,
+            params_epoch=epoch,
+        )
+        engine = pool
+        pool.warmup()
+        batcher = MicroBatcher(
+            None, max_batch=pool.max_batch,
+            max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
+            serve_log=serve_log,
+            dispatch_fn=pool.dispatch,
+            complete_fn=lambda handle: _tag(*pool.predict_complete(handle)),
+            max_inflight=max_inflight,
+        ).start()
+    else:
+        engine = InferenceEngine(
+            model.apply, params, buckets=_parse_buckets(args.buckets),
+            serve_log=serve_log, params_epoch=epoch,
+        )
+        engine.warmup()
+
+        def infer(images):
+            return _tag(*engine.predict_with_epoch(images))
+
+        batcher = MicroBatcher(
+            infer, max_batch=engine.max_batch,
+            max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
+            serve_log=serve_log,
+        ).start()
     stats = compile_log.stats()["programs"]
     compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
                       if name.startswith("serve_forward_"))
-    print(f"AOT-compiled {len(engine.buckets)} bucket programs "
+    plane = (f"{n_devices} replica(s) x {len(engine.buckets)} buckets, "
+             f"in-flight window {max_inflight}" if pooled
+             else f"{len(engine.buckets)} bucket programs")
+    print(f"AOT-compiled {plane} "
           f"{list(engine.buckets)} in {time.perf_counter() - t0:.1f}s "
           f"(compile wall {compiled_ms:.0f} ms); steady-state serving "
           f"never recompiles", flush=True)
 
-    def infer(images):
-        # Row-tagged outputs (label, epoch): the epoch is captured WITH
-        # the params inside the engine, and all rows of one batcher batch
-        # ride one engine call, so per-request slices stay consistent and
-        # the HTTP reply reports the checkpoint that really computed it.
-        labels, epoch = engine.predict_with_epoch(images)
-        tag = np.full_like(labels, -1 if epoch is None else epoch)
-        return np.stack([labels, tag], axis=1)
-
-    batcher = MicroBatcher(
-        infer, max_batch=engine.max_batch,
-        max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
-        serve_log=serve_log,
-    ).start()
-
     watcher = None
     if not getattr(args, "no_reload", False):
+        # engine is the pool in the pooled case: ONE host-side checkpoint
+        # load fans out to an atomic (and stale-rejecting) per-replica
+        # swap.
         watcher = CheckpointWatcher(
             args.checkpoint_dir, template, engine.swap_params,
             poll_interval_s=args.poll_interval, serve_log=serve_log,
@@ -382,7 +459,8 @@ def create_server(args) -> ThreadingHTTPServer:
     httpd.ctx = ServeContext(  # type: ignore[attr-defined]
         engine, batcher, watcher, serve_log, sink, args.model,
         boot_path=boot_path,
-        max_request_images=getattr(args, "max_request_images", 1024))
+        max_request_images=getattr(args, "max_request_images", 1024),
+        pool=pool, max_inflight=max_inflight)
     return httpd
 
 

@@ -374,6 +374,7 @@ class ServeLog:
         self._sink: Optional[JsonlSink] = None
         self._source = "serve"
         self._queue_depth_probe: Optional[Callable[[], int]] = None
+        self._replicas_probe: Optional[Callable[[], Dict]] = None
         self.reset()
 
     def reset(self) -> None:
@@ -384,6 +385,10 @@ class ServeLog:
             self._counts = {"requests": 0, "images": 0, "batches": 0,
                             "rejected": 0, "reloads": 0,
                             "reload_failures": 0}
+            # Per-replica execution counters (multi-chip pool only): the
+            # single-engine data plane records with replica=None and this
+            # stays empty, keeping its snapshot/JSONL schema unchanged.
+            self._replica_counts: Dict[str, Dict] = {}
 
     def set_sink(self, sink: Optional[JsonlSink],
                  source: str = "serve") -> None:
@@ -397,6 +402,14 @@ class ServeLog:
         with self._lock:
             self._queue_depth_probe = probe
 
+    def set_replicas_probe(self, probe: Optional[Callable[[], Dict]]) -> None:
+        """Register the pool's per-replica snapshot callable (device,
+        serving epoch, in-flight count per replica); merged into this
+        log's per-replica batch counters at snapshot time so ``/stats``
+        and the JSONL ``serve_stats`` lines carry one row per replica."""
+        with self._lock:
+            self._replicas_probe = probe
+
     # -- recorders (each from its owning thread) --------------------------
 
     def record_request(self, latency_s: float, queue_wait_s: float = 0.0,
@@ -407,12 +420,21 @@ class ServeLog:
             self._latency.append(latency_s)
             self._queue_wait.append(queue_wait_s)
 
-    def record_batch(self, rows: int, bucket: int) -> None:
+    def record_batch(self, rows: int, bucket: int,
+                     replica: Optional[str] = None) -> None:
         """One executed forward program: ``rows`` real examples padded up
-        to ``bucket``."""
+        to ``bucket``, on ``replica`` (None = the single-engine plane)."""
         with self._lock:
             self._counts["batches"] += 1
             self._batch_hist[bucket] = self._batch_hist.get(bucket, 0) + 1
+            if replica is not None:
+                rec = self._replica_counts.setdefault(
+                    replica, {"batches": 0, "images": 0,
+                              "batch_histogram": {}})
+                rec["batches"] += 1
+                rec["images"] += rows
+                hist = rec["batch_histogram"]
+                hist[bucket] = hist.get(bucket, 0) + 1
 
     def record_rejection(self) -> None:
         with self._lock:
@@ -458,19 +480,38 @@ class ServeLog:
             queue_wait = list(self._queue_wait)
             hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
             probe = self._queue_depth_probe
+            replicas_probe = self._replicas_probe
+            replicas = {name: {**rec,
+                               "batch_histogram": {
+                                   str(k): v for k, v in
+                                   sorted(rec["batch_histogram"].items())}}
+                        for name, rec in self._replica_counts.items()}
         depth = 0
         if probe is not None:
             try:
                 depth = int(probe())
             except Exception:  # noqa: BLE001 - stats must never raise
                 depth = -1
-        return {
+        if replicas_probe is not None:
+            try:
+                for name, row in replicas_probe().items():
+                    replicas.setdefault(
+                        name, {"batches": 0, "images": 0,
+                               "batch_histogram": {}}).update(row)
+            except Exception:  # noqa: BLE001 - stats must never raise
+                pass
+        snap = {
             **counts,
             "queue_depth": depth,
             "latency_ms": self._quantiles(latency),
             "queue_wait_ms": self._quantiles(queue_wait),
             "batch_histogram": hist,
         }
+        # Per-replica rows appear only on the pooled data plane — the
+        # single-engine snapshot/JSONL schema is unchanged.
+        if replicas:
+            snap["replicas"] = {k: replicas[k] for k in sorted(replicas)}
+        return snap
 
     def write_stats(self, **extra) -> Dict:
         """Snapshot + append it to the attached sink (no-op without one);

@@ -1,0 +1,64 @@
+"""End-to-end smoke of ``bench.py --mode serve`` on a forced 4-device
+CPU backend: the report must carry the replica scaling curve and the
+pipeline on/off speedup with the per-replica zero-recompile verdicts —
+so the serving BENCH schema can't silently rot while CI only exercises
+the in-process pieces."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.serve, pytest.mark.slow]
+
+
+def test_bench_serve_reports_scaling_and_pipeline_fields():
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "BENCH_FORCE_CPU": "1",
+        # Small drives: this asserts SCHEMA, not throughput. The compile
+        # cache stays off — the bench child both writes and re-reads
+        # entries in one process, the exact pattern DESIGN.md 6c bans.
+        "BENCH_SERVE_REQUESTS": "64",
+        "BENCH_SERVE_POOL_REQUESTS": "64",
+        "BENCH_SERVE_CONCURRENCY": "8",
+        "BENCH_COMPILE_CACHE": "",
+        "TPUMNIST_COMPILE_CACHE": "",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode", "serve"],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    assert report["metric"] == "mnist_serve_requests_per_sec"
+    assert report.get("error") is None
+    assert report["value"] > 0
+    assert report["n_chips"] == 4
+
+    # The replica scaling curve: one point per replica count, each with
+    # a positive rate and a per-point zero-recompile verdict.
+    scaling = report["replica_scaling"]
+    assert [pt["replicas"] for pt in scaling] == [1, 2, 4]
+    for pt in scaling:
+        assert pt["requests_per_sec"] > 0
+        assert pt["zero_steady_state_recompiles"] is True
+
+    # Pipeline on/off speedup at the full pool, and the fleet-wide
+    # recompile verdict.
+    assert isinstance(report["pipeline_speedup"], (int, float))
+    assert report["pipeline_speedup"] > 0
+    assert report["zero_steady_state_recompiles"] is True
+    assert report["zero_steady_state_recompiles_per_replica"] is True
+
+    # Per-replica compile rows really are per replica in the stats blob.
+    programs = report["compile_stats"]["programs"]
+    assert any(name.endswith("@r3") for name in programs)
+    assert any(name.endswith("@r0") for name in programs)

@@ -1,0 +1,61 @@
+"""Tier-1 guard: every pytest marker used under tests/ is registered in
+pyproject.toml.
+
+An unregistered marker is a silent hole: ``-m chaos`` style selection
+quietly matches nothing (or everything), and pytest's warning scrolls
+past in CI — a test marked with a misspelling like ``serv`` would run
+in the default profile AND be invisible to the marker-filtered
+profiles. This guard turns that drift into a red test with the
+offending names. (This file itself never spells out the
+``pytest  . mark  . name`` attribute form for its examples — the scan
+below would flag them.)"""
+
+import pathlib
+import re
+
+# Markers pytest itself defines; everything else must be declared.
+_BUILTIN = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+            "filterwarnings", "tryfirst", "trylast"}
+
+
+def _registered_markers(pyproject_text: str) -> set:
+    """Parse ``[tool.pytest.ini_options] markers`` without tomllib
+    (python 3.10): the entries are quoted "name: description" strings
+    inside the markers = [...] list."""
+    section = re.search(r"markers\s*=\s*\[(.*?)\]", pyproject_text, re.S)
+    assert section, "pyproject.toml has no pytest markers list"
+    return set(re.findall(r'"\s*([A-Za-z_]\w*)\s*[:(]', section.group(1)))
+
+
+def _used_markers(tests_dir: pathlib.Path) -> dict:
+    """marker name -> first file using it, from both the decorator and
+    the module-level ``pytestmark`` assignment forms."""
+    used = {}
+    for path in sorted(tests_dir.glob("**/*.py")):
+        for match in re.finditer(r"pytest\.mark\.([A-Za-z_]\w*)",
+                                 path.read_text()):
+            used.setdefault(match.group(1), path.name)
+    return used
+
+
+def test_every_marker_used_in_tests_is_registered():
+    tests_dir = pathlib.Path(__file__).resolve().parent
+    pyproject = tests_dir.parent / "pyproject.toml"
+    registered = _registered_markers(pyproject.read_text())
+    used = _used_markers(tests_dir)
+    unregistered = {name: where for name, where in used.items()
+                    if name not in registered and name not in _BUILTIN}
+    assert not unregistered, (
+        f"markers used but not registered in pyproject.toml "
+        f"[tool.pytest.ini_options] markers: {unregistered}")
+
+
+def test_known_markers_really_parse():
+    """The parser above sees the markers we know exist — a guard on the
+    guard (a regex that matched nothing would pass vacuously)."""
+    tests_dir = pathlib.Path(__file__).resolve().parent
+    registered = _registered_markers(
+        (tests_dir.parent / "pyproject.toml").read_text())
+    assert {"slow", "chaos", "serve"} <= registered
+    used = _used_markers(tests_dir)
+    assert {"slow", "chaos", "serve"} <= set(used)

@@ -194,3 +194,137 @@ def test_preprocess_rejects_garbage(linear_setup):
         engine.preprocess(np.zeros((2, 13, 13), np.uint8))
     with pytest.raises(ValueError, match="expected"):
         engine.preprocess(np.zeros((2, 28, 28, 3), np.float32))
+
+
+def test_stale_swap_rejected_under_lock(linear_setup):
+    """The swap-ordering guarantee, sequential form: once epoch 7 is
+    installed, an epoch-3 install attempt is refused and changes
+    nothing."""
+    model, state, images, _ = linear_setup
+    newer = create_train_state(model, jax.random.key(41))
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,),
+                             params_epoch=5)
+    engine.warmup()
+    assert engine.swap_params(newer.params, epoch=7) is True
+    want = engine.logits(images[:8])
+    assert engine.swap_params(state.params, epoch=3) is False
+    assert engine.params_epoch == 7
+    np.testing.assert_array_equal(engine.logits(images[:8]), want)
+    # Epoch-less swaps (fresh init, tests) are exempt from ordering.
+    assert engine.swap_params(state.params) is True
+    assert engine.params_epoch is None
+
+
+def test_swap_race_old_never_overwrites_new(linear_setup):
+    """The reload/swap ordering hazard, raced: an OLD swap whose (slow,
+    unlocked) device_put straddles a NEW swap's install must lose — the
+    epoch comparison under the lock, not device_put timing, decides."""
+    model, state, images, _ = linear_setup
+    old = create_train_state(model, jax.random.key(1))
+    new = create_train_state(model, jax.random.key(2))
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,),
+                             params_epoch=0)
+    engine.warmup()
+    real_place = engine._place
+    old_placed = threading.Event()
+    proceed = threading.Event()
+
+    def gated_place(tree):
+        placed = real_place(tree)
+        if tree is old.params:
+            # The old swap pauses BETWEEN its device_put and its
+            # install — the exact window the hazard lives in.
+            old_placed.set()
+            assert proceed.wait(30.0), "test deadlock"
+        return placed
+
+    engine._place = gated_place
+    outcome = {}
+    t = threading.Thread(
+        target=lambda: outcome.update(
+            old=engine.swap_params(old.params, epoch=3)), daemon=True)
+    t.start()
+    assert old_placed.wait(10.0)
+    assert engine.swap_params(new.params, epoch=7) is True
+    proceed.set()
+    t.join(10.0)
+    engine._place = real_place
+    assert outcome["old"] is False  # the stale install was refused
+    assert engine.params_epoch == 7
+    np.testing.assert_allclose(
+        engine.logits(images[:8]),
+        np.asarray(make_forward_program(model.apply)(
+            new.params, jnp.asarray(normalize_images(images[:8])))),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_exact_bucket_fast_path_skips_staging(linear_setup):
+    """n == bucket with float32 C-contiguous input: no staging buffer is
+    touched (the no-copy fast path), and the logits stay BITWISE equal
+    to the direct eval forward — extending the exactness suite over the
+    staging-reuse change."""
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,))
+    engine.warmup()
+    for _ in range(3):
+        got = engine.logits(engine.preprocess(images[:8]))
+        np.testing.assert_array_equal(
+            got, _direct_logits(model, state, images[:8]))
+    assert engine.staging_allocated()[8] == 0  # never staged
+
+
+def test_staging_buffers_reused_not_reallocated(linear_setup):
+    """Steady-state padded serving allocates NO per-batch pad buffer: the
+    synchronous path holds the per-bucket pool at ONE buffer however
+    many batches run, and results stay exact."""
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(4, 8))
+    engine.warmup()
+    for i in range(12):
+        n = 1 + (i % 7)  # every padded size across both buckets
+        got = engine.logits(images[:n])
+        np.testing.assert_allclose(
+            got, _direct_logits(model, state, images[:n]),
+            rtol=1e-6, atol=1e-6)
+    allocated = engine.staging_allocated()
+    assert allocated[4] == 1 and allocated[8] == 1
+
+
+def test_staging_pinned_until_complete(linear_setup):
+    """Dispatch/complete split: a dispatched-but-unfetched batch keeps
+    its staging buffer out of the free-list (reusing it would corrupt
+    the in-flight input on aliasing backends); completion returns it."""
+    model, state, images, _ = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(8,))
+    engine.warmup()
+    first = engine.dispatch_logits(images[:3])
+    assert engine.staging_allocated()[8] == 1
+    second = engine.dispatch_logits(images[3:6])  # first still pinned
+    assert engine.staging_allocated()[8] == 2  # had to grow, not reuse
+    got1, _ = first.complete()
+    got2, _ = second.complete()
+    np.testing.assert_allclose(got1, _direct_logits(model, state, images[:3]),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got2, _direct_logits(model, state,
+                                                    images[3:6]),
+                               rtol=1e-6, atol=1e-6)
+    # Both released: the next padded batch reuses, the pool stays at 2.
+    engine.logits(images[:2])
+    assert engine.staging_allocated()[8] == 2
+
+
+def test_device_pinned_engine_matches_default(linear_setup):
+    """An engine pinned to a non-default device computes the same
+    program: logits identical to the default-placement engine, and its
+    compiled executables live on that device."""
+    model, state, images, _ = linear_setup
+    device = jax.local_devices()[3]
+    pinned = InferenceEngine(model.apply, state.params, buckets=(8,),
+                             device=device, name="r3")
+    pinned.warmup()
+    got = pinned.logits(images[:8])
+    np.testing.assert_array_equal(got,
+                                  _direct_logits(model, state, images[:8]))
+    # The pinned engine's programs are attributed per replica name.
+    stats = compile_log.stats()["programs"]
+    assert "serve_forward_b8@r3" in stats

@@ -1,0 +1,250 @@
+"""Pipelined MicroBatcher: the form/dispatch + completion stage split,
+the bounded in-flight window, error delivery from both stages, and
+drain-on-close with batches in flight. All with stub dispatch/complete
+callables — no device required."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher
+
+pytestmark = pytest.mark.serve
+
+
+def _rows(n, base=0.0):
+    return (np.arange(n, dtype=np.float32) + base).reshape(n, 1)
+
+
+class GatedPipe:
+    """dispatch records and passes through; complete blocks until
+    released — the stub device whose executions never finish until the
+    test says so."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.dispatched = []
+        self.completed = []
+        self.release = threading.Event()
+
+    def dispatch(self, images):
+        with self.lock:
+            self.dispatched.append(images.shape[0])
+        return images
+
+    def complete(self, handle):
+        assert self.release.wait(30.0), "test deadlock"
+        with self.lock:
+            self.completed.append(handle.shape[0])
+        return handle
+
+    def dispatch_count(self):
+        with self.lock:
+            return len(self.dispatched)
+
+
+def test_window_bounds_inflight_dispatch():
+    """With completion wedged, dispatch runs exactly ``max_inflight``
+    batches ahead and then stalls; releasing completion lets the rest
+    through and every request gets its own rows back."""
+    pipe = GatedPipe()
+    with MicroBatcher(None, max_batch=1, max_wait_s=0.001,
+                      dispatch_fn=pipe.dispatch, complete_fn=pipe.complete,
+                      max_inflight=3) as b:
+        pendings = [b.submit(_rows(1, base=i)) for i in range(6)]
+        deadline = time.time() + 10.0
+        while pipe.dispatch_count() < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert pipe.dispatch_count() == 3  # the window, no further
+        time.sleep(0.15)  # would overrun here if the window leaked
+        assert pipe.dispatch_count() == 3
+        pipe.release.set()
+        for i, p in enumerate(pendings):
+            np.testing.assert_array_equal(b.result(p, timeout=10.0),
+                                          _rows(1, base=i))
+    assert pipe.dispatched == [1] * 6 and pipe.completed == [1] * 6
+
+
+def test_window_one_is_strict_alternation():
+    """max_inflight=1 (the default, and the single-device server): batch
+    N+1 is NOT dispatched until batch N completed — the pre-pipelining
+    serialization, pinned."""
+    pipe = GatedPipe()
+    with MicroBatcher(None, max_batch=1, max_wait_s=0.001,
+                      dispatch_fn=pipe.dispatch, complete_fn=pipe.complete,
+                      max_inflight=1) as b:
+        pendings = [b.submit(_rows(1, base=i)) for i in range(3)]
+        deadline = time.time() + 10.0
+        while pipe.dispatch_count() < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.15)
+        assert pipe.dispatch_count() == 1  # strictly one in flight
+        pipe.release.set()
+        for i, p in enumerate(pendings):
+            np.testing.assert_array_equal(b.result(p, timeout=10.0),
+                                          _rows(1, base=i))
+
+
+def test_results_map_back_across_inflight_batches():
+    """Multiple batches in flight at once: each request's slice still
+    comes back exact (the completion stage owns the slice bookkeeping)."""
+    with MicroBatcher(None, max_batch=4, max_wait_s=0.001,
+                      dispatch_fn=lambda x: x * 10.0,
+                      complete_fn=lambda h: h,
+                      max_inflight=4) as b:
+        pendings = [b.submit(_rows(3, base=100 * i)) for i in range(8)]
+        for i, p in enumerate(pendings):
+            np.testing.assert_array_equal(b.result(p, timeout=10.0),
+                                          _rows(3, base=100 * i) * 10.0)
+
+
+def test_dispatch_error_delivered_to_riders():
+    def boom(images):
+        raise RuntimeError("staging on fire")
+
+    with MicroBatcher(None, max_batch=8, max_wait_s=0.01,
+                      dispatch_fn=boom, complete_fn=lambda h: h,
+                      max_inflight=2) as b:
+        pa, pb = b.submit(_rows(1)), b.submit(_rows(1))
+        for p in (pa, pb):
+            with pytest.raises(RuntimeError, match="staging on fire"):
+                b.result(p, timeout=10.0)
+
+
+def test_complete_error_delivered_to_riders():
+    def boom(handle):
+        raise RuntimeError("fetch on fire")
+
+    with MicroBatcher(None, max_batch=8, max_wait_s=0.01,
+                      dispatch_fn=lambda x: x, complete_fn=boom,
+                      max_inflight=2) as b:
+        pa, pb = b.submit(_rows(1)), b.submit(_rows(1))
+        for p in (pa, pb):
+            with pytest.raises(RuntimeError, match="fetch on fire"):
+                b.result(p, timeout=10.0)
+
+
+def test_error_batch_does_not_wedge_the_window():
+    """A window=1 batcher keeps serving after a failed batch (the window
+    slot is released on the error path too)."""
+    calls = {"n": 0}
+
+    def flaky(images):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("first batch dies")
+        return images
+
+    with MicroBatcher(None, max_batch=1, max_wait_s=0.001,
+                      dispatch_fn=flaky, complete_fn=lambda h: h,
+                      max_inflight=1) as b:
+        bad = b.submit(_rows(1, base=9))
+        with pytest.raises(RuntimeError, match="first batch dies"):
+            b.result(bad, timeout=10.0)
+        good = b.submit(_rows(1, base=5))
+        np.testing.assert_array_equal(b.result(good, timeout=10.0),
+                                      _rows(1, base=5))
+
+
+def test_mismatched_cobatch_is_an_error_not_a_dead_worker():
+    """Two co-batched requests whose trailing shapes disagree (submit
+    validates only the leading dim) must fail as per-request errors —
+    and close() must still return (the dispatch worker survives, and
+    even a dying worker hands completion its shutdown sentinel)."""
+    with MicroBatcher(None, max_batch=8, max_wait_s=0.05,
+                      dispatch_fn=lambda x: x, complete_fn=lambda h: h,
+                      max_inflight=2) as b:
+        pa = b.submit(np.zeros((1, 4), np.float32))
+        pb = b.submit(np.zeros((1, 5), np.float32))
+        for p in (pa, pb):
+            with pytest.raises(ValueError):
+                b.result(p, timeout=10.0)
+        # The worker is alive: a well-formed request still serves.
+        np.testing.assert_array_equal(b.result(b.submit(_rows(2)),
+                                               timeout=10.0), _rows(2))
+    # reaching here means close() returned (the with-exit join finished)
+
+
+def test_malformed_completion_is_an_error_not_a_dead_worker():
+    """A complete_fn returning garbage (scalar, wrong row count) becomes
+    a per-request error; the completion worker survives and close()
+    returns."""
+    returns = iter([np.float32(7.0),            # 0-d: no shape[0] at all
+                    np.zeros((9, 1), np.float32)])  # wrong row count
+
+    with MicroBatcher(None, max_batch=1, max_wait_s=0.001,
+                      dispatch_fn=lambda x: x,
+                      complete_fn=lambda h: next(returns, h),
+                      max_inflight=2) as b:
+        with pytest.raises(RuntimeError, match="scalar"):
+            b.result(b.submit(_rows(1)), timeout=10.0)
+        with pytest.raises(RuntimeError, match="9 row"):
+            b.result(b.submit(_rows(1)), timeout=10.0)
+        # Worker alive: a well-formed request still serves.
+        np.testing.assert_array_equal(
+            b.result(b.submit(_rows(1, base=3)), timeout=10.0),
+            _rows(1, base=3))
+
+
+def test_close_drains_queued_and_inflight():
+    """close() completes everything: batches already past dispatch AND
+    requests still queued behind them."""
+    pipe = GatedPipe()
+    b = MicroBatcher(None, max_batch=1, max_wait_s=5.0,
+                     dispatch_fn=pipe.dispatch, complete_fn=pipe.complete,
+                     max_inflight=2).start()
+    pendings = [b.submit(_rows(1, base=i)) for i in range(5)]
+    deadline = time.time() + 10.0
+    while pipe.dispatch_count() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    closer = threading.Thread(target=b.close, daemon=True)
+    closer.start()
+    pipe.release.set()
+    closer.join(30.0)
+    assert not closer.is_alive()
+    for i, p in enumerate(pendings):
+        np.testing.assert_array_equal(b.result(p, timeout=1.0),
+                                      _rows(1, base=i))
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.submit(_rows(1))
+
+
+def test_completion_keeps_request_accounting():
+    """Latency/queue-wait accounting rides the completion stage: counts
+    and quantiles behave exactly as in the synchronous batcher."""
+    from pytorch_distributed_mnist_tpu.utils.profiling import ServeLog
+
+    log = ServeLog()
+    with MicroBatcher(None, max_batch=4, max_wait_s=0.001,
+                      dispatch_fn=lambda x: x, complete_fn=lambda h: h,
+                      max_inflight=3, serve_log=log) as b:
+        for i in range(6):
+            b.predict(_rows(2, base=i), timeout=10.0)
+    snap = log.snapshot()
+    assert snap["requests"] == 6 and snap["images"] == 12
+    assert snap["latency_ms"]["count"] == 6
+    assert snap["queue_wait_ms"]["p50"] <= snap["latency_ms"]["p50"] + 1e-6
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(max_inflight=0),
+    dict(dispatch_fn=lambda x: x),                  # missing complete_fn
+    dict(),                                         # no inference at all
+])
+def test_constructor_validation(kwargs):
+    base = dict(infer_fn=None, max_batch=4)
+    if "dispatch_fn" not in kwargs and "max_inflight" not in kwargs:
+        pass  # neither form given
+    elif "max_inflight" in kwargs:
+        base.update(dispatch_fn=lambda x: x, complete_fn=lambda h: h)
+    base.update(kwargs)
+    with pytest.raises(ValueError):
+        MicroBatcher(**base)
+
+
+def test_infer_fn_and_two_phase_are_exclusive():
+    with pytest.raises(ValueError, match="exactly one"):
+        MicroBatcher(lambda x: x, max_batch=4,
+                     dispatch_fn=lambda x: x, complete_fn=lambda h: h)

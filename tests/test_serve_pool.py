@@ -1,0 +1,270 @@
+"""EnginePool: multi-replica correctness (1-replica and 4-replica pools
+answer identically), least-loaded dispatch, per-replica zero-recompile
+invariant, swap fan-out with per-replica stale rejection, and the
+no-mixed-epoch-within-a-batch guarantee under hot reload."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    normalize_images,
+    synthetic_dataset,
+)
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher
+from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
+from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.utils.profiling import ServeLog, compile_log
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    images, labels = synthetic_dataset(64, seed=3)
+    return model, state, images, labels
+
+
+def _direct_labels(model, state, raw_images):
+    logits = model.apply(state.params, jnp.asarray(
+        normalize_images(raw_images)), train=False)
+    return np.argmax(np.asarray(logits), axis=-1)
+
+
+def _drive_pool(pool, request_stacks, max_inflight):
+    """Closed-loop drive through the pipelined batcher; returns each
+    request's (labels, epoch) in submit order."""
+    def complete(handle):
+        labels, epoch = pool.predict_complete(handle)
+        tag = np.full_like(labels, -1 if epoch is None else epoch)
+        return np.stack([labels, tag], axis=1)
+
+    results = []
+    with MicroBatcher(None, max_batch=pool.max_batch, max_wait_s=0.002,
+                      dispatch_fn=pool.dispatch, complete_fn=complete,
+                      max_inflight=max_inflight) as batcher:
+        pendings = [batcher.submit(pool.preprocess(stack))
+                    for stack in request_stacks]
+        for p in pendings:
+            out = batcher.result(p, timeout=60.0)
+            results.append((out[:, 0].tolist(), sorted(set(out[:, 1]))))
+    return results
+
+
+def test_multi_replica_matches_single_replica(linear_setup):
+    """The deterministic correctness pin: the SAME requests through a
+    1-replica pool and a 4-replica pool produce identical predictions
+    and identical epochs — replica fan-out must be invisible to
+    clients."""
+    model, state, images, _ = linear_setup
+    stacks = [images[i:i + 1 + (i % 3)] for i in range(24)]
+    results = {}
+    for n in (1, 4):
+        pool = EnginePool(model.apply, state.params,
+                          devices=jax.local_devices()[:n],
+                          buckets=(1, 4, 8), params_epoch=2)
+        pool.warmup()
+        results[n] = _drive_pool(pool, stacks, max_inflight=n + 1)
+    assert results[1] == results[4]
+    # And both match the direct forward pass.
+    for stack, (labels, epochs) in zip(stacks, results[4]):
+        assert labels == _direct_labels(model, state, stack).tolist()
+        assert epochs == [2]
+
+
+def test_dispatch_picks_least_loaded_replica(linear_setup):
+    """Four batches dispatched with none completed land on four DIFFERENT
+    replicas (the pending count drives placement); completion drains the
+    counts back to zero."""
+    model, state, images, _ = linear_setup
+    log = ServeLog()
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:4], buckets=(4,),
+                      serve_log=log)
+    pool.warmup()
+    handles = [pool.dispatch(pool.preprocess(images[i:i + 2]))
+               for i in range(4)]
+    assert sorted(h.replica.name for h in handles) \
+        == ["r0", "r1", "r2", "r3"]
+    snap = pool.snapshot()
+    assert all(row["pending"] == 1 for row in snap.values())
+    for h in handles:
+        labels, _ = pool.predict_complete(h)
+        assert labels.shape == (2,)
+    assert all(row["pending"] == 0 for row in pool.snapshot().values())
+    # ServeLog carries one execution row per replica.
+    replicas = log.snapshot()["replicas"]
+    assert sorted(replicas) == ["r0", "r1", "r2", "r3"]
+    assert all(replicas[r]["batches"] == 1 for r in replicas)
+
+
+def test_zero_recompiles_per_replica_steady_state(linear_setup):
+    """After warmup, serving through every replica adds ZERO compiles to
+    any replica's programs — the per-replica CompileLog names make the
+    check attributable chip by chip."""
+    model, state, images, _ = linear_setup
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:4], buckets=(2, 8))
+    pool.warmup()
+    programs = compile_log.stats()["programs"]
+    expected = {f"serve_forward_b{b}@r{i}" for b in (2, 8)
+                for i in range(4)}
+    assert expected <= set(programs)
+    before = {name: programs[name]["backend_compiles"]
+              for name in expected}
+    handles = [pool.dispatch(pool.preprocess(images[i:i + 3]))
+               for i in range(8)]  # 2 batches per replica, padded to b8
+    for h in handles:
+        pool.complete(h)
+    after = compile_log.stats()["programs"]
+    assert {name: after[name]["backend_compiles"] for name in expected} \
+        == before
+
+
+def test_swap_fans_out_with_per_replica_stale_rejection(linear_setup):
+    """One fan-out installs on every replica; a stale fan-out installs on
+    NONE; and a replica that individually got ahead keeps its newer
+    epoch while the laggards catch up."""
+    model, state, images, _ = linear_setup
+    other = create_train_state(model, jax.random.key(9))
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:3], buckets=(8,),
+                      params_epoch=1)
+    pool.warmup()
+    assert pool.swap_params(other.params, epoch=5) == 3
+    assert [r.engine.params_epoch for r in pool.replicas] == [5, 5, 5]
+    # Stale fan-out: rejected by every replica, nothing changes.
+    assert pool.swap_params(state.params, epoch=3) == 0
+    assert [r.engine.params_epoch for r in pool.replicas] == [5, 5, 5]
+    np.testing.assert_array_equal(
+        pool.predict_complete(pool.dispatch(
+            pool.preprocess(images[:8])))[0],
+        _direct_labels(model, other, images[:8]))
+    # One replica races ahead; a fleet-wide epoch-7 fan-out upgrades only
+    # the laggards and leaves the leader alone.
+    leader = create_train_state(model, jax.random.key(11))
+    assert pool.replicas[1].engine.swap_params(leader.params, epoch=9)
+    assert pool.swap_params(other.params, epoch=7) == 2
+    assert [r.engine.params_epoch for r in pool.replicas] == [7, 9, 7]
+
+
+def test_hot_reload_never_mixes_epochs_within_a_batch(linear_setup):
+    """Hammer multi-row requests through a 4-replica pipelined pool while
+    params hot-swap repeatedly: every reply must carry EXACTLY ONE epoch
+    across its rows (params+epoch are captured once per batch on one
+    replica), and every epoch must be one that was actually installed."""
+    model, state, images, _ = linear_setup
+    states = {e: create_train_state(model, jax.random.key(e))
+              for e in (10, 11, 12, 13)}
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:4], buckets=(1, 8),
+                      params_epoch=10)
+    pool.warmup()
+    pool.swap_params(states[10].params, epoch=10)
+
+    def complete(handle):
+        labels, epoch = pool.predict_complete(handle)
+        tag = np.full_like(labels, -1 if epoch is None else epoch)
+        return np.stack([labels, tag], axis=1)
+
+    failures = []
+    stop = threading.Event()
+
+    def hammer(wid):
+        i = 0
+        while not stop.is_set():
+            stack = pool.preprocess(images[(wid + i) % 32:
+                                           (wid + i) % 32 + 4])
+            out = batcher.predict(stack, timeout=30.0)
+            epochs = set(out[:, 1].tolist())
+            if len(epochs) != 1 or not epochs <= {10, 11, 12, 13}:
+                failures.append(out[:, 1].tolist())
+            i += 1
+
+    with MicroBatcher(None, max_batch=8, max_wait_s=0.002,
+                      dispatch_fn=pool.dispatch, complete_fn=complete,
+                      max_inflight=5) as batcher:
+        threads = [threading.Thread(target=hammer, args=(w,), daemon=True)
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # traffic established before the first swap
+        for epoch in (11, 12, 13):
+            assert pool.swap_params(states[epoch].params,
+                                    epoch=epoch) == 4
+            time.sleep(0.1)  # batches in flight across each boundary
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+    assert not failures, failures[:5]
+    # Steady state: the final swap serves everywhere.
+    labels, epoch = pool.predict_complete(
+        pool.dispatch(pool.preprocess(images[:8])))
+    assert epoch == 13
+    np.testing.assert_array_equal(
+        labels, _direct_labels(model, states[13], images[:8]))
+
+
+def test_watcher_fans_out_to_pool(linear_setup, tmp_path):
+    """CheckpointWatcher.on_params = pool.swap_params: one host-side load
+    installs on every replica; a load that is stale fleet-wide is
+    skipped (not recorded as a reload)."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        save_checkpoint,
+    )
+
+    model, state, images, _ = linear_setup
+    template = create_train_state(model, jax.random.key(0))
+    pool = EnginePool(model.apply, template.params,
+                      devices=jax.local_devices()[:2], buckets=(8,))
+    pool.warmup()
+    log = ServeLog()
+    watcher = CheckpointWatcher(str(tmp_path), template, pool.swap_params,
+                                serve_log=log)
+    published = create_train_state(model, jax.random.key(21))
+    save_checkpoint(published, epoch=4, best_acc=0.5, is_best=False,
+                    directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once()
+    assert [r.engine.params_epoch for r in pool.replicas] == [4, 4]
+    assert log.snapshot()["reloads"] == 1
+    np.testing.assert_array_equal(
+        pool.predict_complete(pool.dispatch(
+            pool.preprocess(images[:8])))[0],
+        _direct_labels(model, published, images[:8]))
+    # The fleet moves ahead of the directory (e.g. a second directory's
+    # watcher): a newer publish that is STALE for the fleet is skipped.
+    ahead = create_train_state(model, jax.random.key(22))
+    pool.swap_params(ahead.params, epoch=9)
+    save_checkpoint(published, epoch=6, best_acc=0.5, is_best=False,
+                    directory=str(tmp_path), process_index=0)
+    assert not watcher.poll_once()
+    assert log.snapshot()["reloads"] == 1  # not recorded
+    assert [r.engine.params_epoch for r in pool.replicas] == [9, 9]
+
+
+def test_pool_snapshot_rows(linear_setup):
+    model, state, _, _ = linear_setup
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:2], buckets=(4,),
+                      params_epoch=3)
+    snap = pool.snapshot()
+    assert sorted(snap) == ["r0", "r1"]
+    for row in snap.values():
+        assert row["pending"] == 0 and row["dispatched"] == 0
+        assert row["params_epoch"] == 3
+        assert "cpu" in row["device"].lower()
+
+
+def test_pool_requires_a_device():
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    with pytest.raises(ValueError, match="at least one device"):
+        EnginePool(model.apply, state.params, devices=[])

@@ -1,0 +1,250 @@
+"""Pooled serving over real loopback HTTP: `--serve-devices 4` boots an
+EnginePool behind the pipelined batcher; loadgen's smoke gate passes
+with zero steady-state recompiles on EVERY replica; hot reload under
+live traffic swaps the whole fleet; and the default single-replica
+configuration keeps the exact pre-pool /stats schema."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    normalize_images,
+    synthetic_dataset,
+)
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.server import build_parser, create_server
+from pytorch_distributed_mnist_tpu.train.checkpoint import save_checkpoint
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.utils.profiling import compile_log
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _publish(ckpt_dir, epoch, seed):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(seed))
+    save_checkpoint(state, epoch=epoch, best_acc=0.5, is_best=False,
+                    directory=str(ckpt_dir), process_index=0)
+    return state
+
+
+def _serve_args(ckpt_dir, **overrides):
+    argv = [
+        "--checkpoint-dir", str(ckpt_dir),
+        "--model", "linear", "--dtype", "f32",
+        "--host", "127.0.0.1", "--port", "0",
+        "--buckets", "1,8,32",
+        "--max-wait-ms", "2", "--max-queue", "128",
+        "--poll-interval", "0.1",
+    ]
+    for k, v in overrides.items():
+        flag = "--" + k.replace("_", "-")
+        if v is True:
+            argv.append(flag)
+        else:
+            argv += [flag, str(v)]
+    return build_parser().parse_args(argv)
+
+
+class _Server:
+    def __init__(self, args):
+        self.httpd = create_server(args)
+        host, port = self.httpd.server_address[:2]
+        self.url = f"http://{host}:{port}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.ctx.close()
+        self.httpd.server_close()
+        self.thread.join(10.0)
+
+    def get(self, path):
+        with urllib.request.urlopen(self.url + path, timeout=30) as r:
+            return json.loads(r.read())
+
+    def post(self, path, payload):
+        req = urllib.request.Request(
+            self.url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+
+@pytest.fixture()
+def pooled_server(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    state = _publish(ckpt, epoch=0, seed=10)
+    srv = _Server(_serve_args(ckpt, serve_devices=4))
+    try:
+        yield srv, state, ckpt
+    finally:
+        srv.close()
+
+
+def _replica_program_compiles():
+    return {name: rec["backend_compiles"]
+            for name, rec in compile_log.stats()["programs"].items()
+            if name.startswith("serve_forward_") and "@" in name}
+
+
+def test_pooled_loadgen_smoke_zero_recompiles_every_replica(pooled_server):
+    """The pooled acceptance run: loadgen --smoke --expect-replicas 4
+    against a 4-replica server passes, with ZERO steady-state recompiles
+    on every replica (per-replica CompileLog program names)."""
+    srv, state, _ = pooled_server
+    images, _ = synthetic_dataset(3, seed=0)
+    reply = srv.post("/predict", {"images": images.tolist()})
+    # Predictions pinned to the direct forward pass through the pool.
+    model = get_model("linear", compute_dtype=jnp.float32)
+    want = np.argmax(np.asarray(model.apply(
+        state.params, jnp.asarray(normalize_images(images)), train=False)),
+        axis=-1)
+    assert reply["predictions"] == [int(v) for v in want]
+    assert reply["model_epoch"] == 0
+
+    before = _replica_program_compiles()
+    # 4 replicas x 3 buckets all AOT-compiled (superset: compile_log is a
+    # process singleton, other pool tests may have added replica names).
+    assert {f"serve_forward_b{b}@r{i}" for b in (1, 8, 32)
+            for i in range(4)} <= set(before)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         "--smoke", "--url", srv.url, "--requests", "600",
+         "--concurrency", "8", "--expect-replicas", "4"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["smoke_ok"] and report["ok"] == 600
+    assert len(report["replicas"]) == 4
+    # Zero steady-state recompiles, checked replica by replica.
+    assert _replica_program_compiles() == before
+
+    stats = srv.get("/stats")
+    assert stats["serve_devices"] == 4 and stats["max_inflight"] == 5
+    assert sorted(stats["replicas"]) == ["r0", "r1", "r2", "r3"]
+    assert sum(r["batches"] for r in stats["replicas"].values()) \
+        == stats["batches"]
+    assert all(r["params_epoch"] == 0 for r in stats["replicas"].values())
+
+
+def test_pooled_hot_reload_under_live_traffic(pooled_server):
+    """Publish a new checkpoint while clients hammer the pooled server:
+    no failures, every reply carries a real epoch (old or new), the
+    WHOLE fleet converges to the new epoch, and steady state serves the
+    new params."""
+    srv, _, ckpt = pooled_server
+    images, _ = synthetic_dataset(4, seed=3)
+    payload = {"images": images.tolist()}
+    failures = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                reply = srv.post("/predict", payload)
+                if (len(reply["predictions"]) != 4
+                        or reply["model_epoch"] not in (0, 9)):
+                    failures.append(("malformed", reply))
+            except Exception as exc:  # noqa: BLE001
+                failures.append(("error", repr(exc)))
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    state_b = _publish(ckpt, epoch=9, seed=77)
+    deadline = time.time() + 15.0
+    while time.time() < deadline:
+        if srv.get("/healthz")["model_epoch"] == 9:
+            break
+        time.sleep(0.05)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(10.0)
+
+    assert not failures, failures[:5]
+    stats = srv.get("/stats")
+    assert stats["reloads"] == 1
+    # One host-side load fanned out: EVERY replica serves epoch 9.
+    assert all(r["params_epoch"] == 9 for r in stats["replicas"].values())
+    model = get_model("linear", compute_dtype=jnp.float32)
+    want = np.argmax(np.asarray(model.apply(
+        state_b.params, jnp.asarray(normalize_images(images)),
+        train=False)), axis=-1)
+    assert srv.post("/predict", payload)["predictions"] \
+        == [int(v) for v in want]
+
+
+def test_default_single_replica_stats_schema_unchanged(tmp_path):
+    """Criterion: the default configuration (no --serve-devices /
+    --max-inflight) is the pre-pool data plane — /stats carries no
+    replica fields and the engine programs keep their unsuffixed
+    names."""
+    ckpt = tmp_path / "ckpt"
+    _publish(ckpt, epoch=0, seed=10)
+    args = _serve_args(ckpt)
+    assert args.serve_devices == 1 and args.max_inflight == 0
+    srv = _Server(args)
+    try:
+        assert srv.httpd.ctx.pool is None
+        images, _ = synthetic_dataset(2, seed=1)
+        srv.post("/predict", {"images": images.tolist()})
+        stats = srv.get("/stats")
+        assert "replicas" not in stats
+        assert "serve_devices" not in stats and "max_inflight" not in stats
+        assert {"serve_forward_b1", "serve_forward_b8",
+                "serve_forward_b32"} <= set(stats["compile"]["programs"])
+    finally:
+        srv.close()
+
+
+def test_serve_devices_zero_means_all_and_bounds_checked(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    _publish(ckpt, epoch=0, seed=10)
+    srv = _Server(_serve_args(ckpt, serve_devices=0, buckets="1,8"))
+    try:
+        stats = srv.get("/stats")
+        assert stats["serve_devices"] == len(jax.local_devices())
+    finally:
+        srv.close()
+    with pytest.raises(SystemExit, match="local device"):
+        create_server(_serve_args(ckpt, serve_devices=99))
+
+
+def test_pipelining_on_single_device(tmp_path):
+    """--max-inflight alone (one replica) still runs the pooled pipelined
+    plane: requests serve correctly with the window open."""
+    ckpt = tmp_path / "ckpt"
+    state = _publish(ckpt, epoch=0, seed=10)
+    srv = _Server(_serve_args(ckpt, serve_devices=1, max_inflight=3,
+                              buckets="1,8"))
+    try:
+        assert srv.httpd.ctx.pool is not None
+        assert srv.get("/stats")["max_inflight"] == 3
+        images, _ = synthetic_dataset(6, seed=4)
+        reply = srv.post("/predict", {"images": images.tolist()})
+        model = get_model("linear", compute_dtype=jnp.float32)
+        want = np.argmax(np.asarray(model.apply(
+            state.params, jnp.asarray(normalize_images(images)),
+            train=False)), axis=-1)
+        assert reply["predictions"] == [int(v) for v in want]
+    finally:
+        srv.close()

@@ -208,6 +208,12 @@ def main(argv=None) -> int:
                    help="CI gate: closed-loop burst; exit nonzero unless "
                         "every request succeeded and /healthz + /stats "
                         "answer")
+    p.add_argument("--expect-replicas", type=int, default=0,
+                   help="smoke: additionally require /stats to report "
+                        "exactly this many engine replicas (the pooled "
+                        "--serve-devices data plane) whose batch counts "
+                        "sum to the server's batch total; 0 skips the "
+                        "check")
     args = p.parse_args(argv)
 
     url = args.url.rstrip("/")
@@ -243,6 +249,20 @@ def main(argv=None) -> int:
                 and "p99" in stats.get("latency_ms", {})
                 and stats.get("batch_histogram")
             )
+            if args.expect_replicas:
+                # The pooled data plane really is pooled: one /stats row
+                # per replica, and every executed batch attributed to
+                # one of them. (No per-replica minimum: the least-loaded
+                # dispatcher legitimately concentrates an underloaded
+                # burst on few replicas.)
+                replicas = stats.get("replicas") or {}
+                out["replicas"] = replicas
+                smoke_ok = (
+                    smoke_ok
+                    and len(replicas) == args.expect_replicas
+                    and sum(r.get("batches", 0) for r in replicas.values())
+                    == stats.get("batches")
+                )
         except Exception as exc:  # noqa: BLE001
             out["smoke_error"] = repr(exc)
             smoke_ok = False
