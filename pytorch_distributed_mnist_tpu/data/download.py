@@ -79,7 +79,12 @@ def _looks_like_idx_gz(path: str) -> bool:
     try:
         with gzip.open(path, "rb") as f:
             head = f.read(4)
-    except (OSError, EOFError):  # EOFError: truncated after a valid header
+    except Exception:
+        # Broad on purpose (tpumnist-lint audit): this predicate answers
+        # "is the published file usable?" — truncated-after-header
+        # (EOFError), unreadable (OSError), AND corrupt mid-stream
+        # (zlib.error, not an OSError subclass) must all answer False so
+        # the fetch loop deletes and retries, never crashes.
         return False
     return len(head) == 4 and head[0] == 0 and head[1] == 0 and head[2] == 8
 

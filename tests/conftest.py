@@ -15,6 +15,13 @@ import sys
 # 8 virtual devices.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Repo root on sys.path: the analyzer suites import the uninstalled
+# ``tools`` package (conftest imports before every test module, so no
+# per-file bootstrap is needed).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 
 def _xla_flags_supported(flags: str) -> bool:
     """Whether this jaxlib's XLA knows ``flags``. XLA *aborts the process*

@@ -1,0 +1,286 @@
+"""Fixture suite: the collective-symmetry checker.
+
+Firing twins model the structural-hang class (a collective some hosts
+skip); non-firing twins are the sanctioned patterns the codebase uses —
+symmetric ``process_count()`` guards and branch-on-the-result.
+"""
+
+
+import pytest
+
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src):
+    return analyze_snippet(src, checkers=["collective-symmetry"])
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_collective_under_process_index_branch():
+    src = """
+from pytorch_distributed_mnist_tpu.runtime import supervision
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def publish(epoch):
+    if process_index() == 0:
+        supervision.allgather_records("publish", True)
+"""
+    (f,) = _findings(src)
+    assert f.line == 7 and f.symbol == "publish"
+    assert "host-dependent" in f.message
+
+
+def test_fires_on_collective_in_host_dependent_loop():
+    src = """
+def drain():
+    for _ in range(process_index()):
+        agree("drain_tick")
+"""
+    (f,) = _findings(src)
+    assert "trip count" in f.message
+
+
+def test_fires_on_collective_under_host_dependent_while():
+    src = """
+def spin():
+    while process_index() > 0:
+        _agree_phase_ok(None, 0, "x", "d")
+"""
+    assert len(_findings(src)) == 1
+
+
+def test_fires_in_else_branch_too():
+    src = """
+def f():
+    if process_index() == 0:
+        lead()
+    else:
+        allgather_records("follower_only", True)
+"""
+    assert len(_findings(src)) == 1
+
+
+def test_fires_on_collective_after_host_conditioned_early_return():
+    """The most natural way to write the bug: host 0 bails out early and
+    never reaches the collective its peers block in — the hazard is the
+    code AFTER the branch, not inside it."""
+    src = """
+def publish(ok):
+    if process_index() == 0:
+        return None
+    return allgather_records("phase", ok)
+"""
+    (f,) = _findings(src)
+    assert f.line == 5 and "early return/raise" in f.message
+
+
+def test_fires_on_pid_variable_guard():
+    """The codebase's dominant idiom binds the index first —
+    ``pid = process_index()`` then branching on ``pid`` must be treated
+    exactly like a literal process_index() test (taint through simple
+    assignment)."""
+    src = """
+def publish(ok):
+    pid = process_index()
+    if pid != 0:
+        return None
+    return _agree_phase_ok(None, 0, "publish", ok)
+"""
+    (f,) = _findings(src)
+    assert "early return/raise" in f.message
+
+
+def test_fires_on_mixed_exit_kinds():
+    """One arm leaves the function, the other only the loop: the
+    returning hosts never reach a later collective the loop-exiting
+    hosts do — exit KINDS must match, not just exit-ness."""
+    src = """
+def f(xs, ok):
+    for x in xs:
+        if process_index() == 0:
+            return None
+        else:
+            break
+    return agree("phase", ok)
+"""
+    (f,) = _findings(src)
+    assert f.line == 8 and "early return/raise" in f.message
+
+
+def test_fires_when_the_branch_itself_rebinds_the_tainted_name():
+    """The test is judged BEFORE the branch body runs: a clean rebind
+    inside the guarded arm must not retroactively hide the divergence
+    (the hosts already parted ways on the tainted value)."""
+    src = """
+def f(ok):
+    pid = process_index()
+    if pid:
+        pid = 0
+        return None
+    return allgather_records("x", ok)
+"""
+    (f,) = _findings(src)
+    assert "early return/raise" in f.message
+
+
+def test_fires_on_tuple_unpack_and_annotated_pid_bindings():
+    """Taint flows through positional unpack (only the element bound to
+    process_index()) and annotated assignments."""
+    unpack = """
+def f(ok):
+    pid, other = process_index(), 1
+    if pid == 0:
+        return None
+    return allgather_records("x", ok)
+"""
+    ann = """
+def h(ok):
+    pid: int = process_index()
+    if pid == 0:
+        return None
+    return allgather_records("x", ok)
+"""
+    for src in (unpack, ann):
+        (f,) = _findings(src)
+        assert "early return/raise" in f.message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_silent_on_clean_tuple_unpack():
+    """Positional unpack taints per element: a clean first element stays
+    clean even when unpacked alongside other values."""
+    src = """
+def g(ok):
+    pid, other = 0, compute()
+    if pid == 0:
+        return None
+    return allgather_records("x", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_when_branch_assigns_taint_but_test_is_clean():
+    """Divergence needs a host-dependent TEST; assigning a tainted name
+    inside a branch on a clean value is not a host split."""
+    src = """
+def g(flag, ok):
+    if flag:
+        flag2 = process_index()
+        return None
+    return allgather_records("x", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_rebound_clean_pid_variable():
+    """Taint ends at a clean rebinding: the name no longer carries a
+    host-dependent value."""
+    src = """
+def agreed(ok):
+    pid = process_index()
+    pid = 0
+    if pid != 0:
+        return None
+    return agree("phase", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_after_loop_when_break_vs_continue_diverged_inside():
+    """break/continue divergence ends with its loop (hosts rejoin at the
+    loop exit); only collectives still inside the loop are asymmetric."""
+    src = """
+def k(xs, ok):
+    for x in xs:
+        if process_index() == 0:
+            break
+        else:
+            continue
+    return agree("phase", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_when_every_arm_of_the_guard_exits():
+    """Both arms leave the function: no host reaches the code after the
+    branch, so a collective elsewhere is not made asymmetric by it."""
+    src = """
+def route(ok):
+    if process_index() == 0:
+        return serve(ok)
+    else:
+        return train(ok)
+
+def other(ok):
+    return allgather_records("phase", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_symmetric_early_return():
+    """``if process_count() <= 1: return`` then the collective — the
+    sanctioned single-process fast path must stay clean."""
+    src = """
+def agreed(ok):
+    if process_count() <= 1:
+        return []
+    records = prepare(ok)
+    return allgather_records("phase", records)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_symmetric_process_count_guard():
+    src = """
+def agreed(ok):
+    if process_count() <= 1:
+        return []
+    return allgather_records("phase", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_branch_on_the_result():
+    """The sanctioned shape: every host runs the collective; per-host
+    work happens AFTER, conditioned on the agreed outcome."""
+    src = """
+def publish(epoch):
+    err = None
+    if process_index() == 0:
+        err = do_local_publish()
+    failed = agree("publish", err)
+    if process_index() == 0 and not failed:
+        cleanup_tmp()
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_nested_def_defined_under_guard_but_symmetric():
+    """A function *defined* under a host guard is only defined there —
+    where it runs is its callers' concern (the checker resets hazard
+    context at scope boundaries)."""
+    src = """
+def f():
+    if process_index() == 0:
+        def helper():
+            return allgather_records("x", True)
+        register(helper)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_plain_symmetric_collective():
+    src = """
+def vote(ok):
+    records = allgather_records("dataset_load", ok)
+    raise_if_poisoned(records, "the dataset agreement")
+    return records
+"""
+    assert _findings(src) == []

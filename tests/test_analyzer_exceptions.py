@@ -1,0 +1,175 @@
+"""Fixture suite: the agreement-except-breadth checker (zlib-strand class)."""
+
+
+import pytest
+
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src):
+    return analyze_snippet(src, checkers=["agreement-except-breadth"])
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_the_zlib_strand_shape():
+    """The historical bug, minimized: a narrow funnel in a nested helper
+    whose outcome feeds the agreement."""
+    src = """
+import zlib
+
+def build_loaders(args):
+    def _try_load(train):
+        try:
+            return load_dataset(args.root, train=train)
+        except (FileNotFoundError, ValueError, OSError, EOFError):
+            return None
+    loaded = (_try_load(True), _try_load(False))
+    ok = all(s is not None for s in loaded)
+    allgather_records("dataset_load", ok)
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "build_loaders"
+    assert "zlib.error strand" in f.message
+    assert "OSError" in f.message
+
+
+def test_fires_on_narrow_single_type_at_agreement_level():
+    src = """
+def save(epoch):
+    err = None
+    try:
+        write_files(epoch)
+    except OSError as exc:
+        err = exc
+    _agree_phase_ok(err, epoch, "write", "dropping tmp")
+"""
+    (f,) = _findings(src)
+    assert "(OSError)" in f.message
+
+
+def test_fires_even_when_agreement_is_in_a_sibling_nested_def():
+    """The funnel and the collective may live in different nested defs of
+    one orchestrating scope — the scope is what agrees."""
+    src = """
+def orchestrate():
+    def stage():
+        try:
+            return fetch()
+        except (OSError, ValueError):
+            return None
+    def vote(ok):
+        return agree("stage", None if ok else RuntimeError("x"))
+    return vote(stage() is not None)
+"""
+    assert len(_findings(src)) == 1
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_silent_on_broad_exception_funnel():
+    src = """
+def build_loaders(args):
+    def _try_load(train):
+        try:
+            return load_dataset(args.root, train=train)
+        except Exception:
+            return None
+    ok = _try_load(True) is not None
+    allgather_records("dataset_load", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_narrow_special_case_before_broad_funnel():
+    """special-case-then-funnel is safe: the broad sibling catches every
+    type the narrow handler misses, so nothing can leak the try."""
+    src = """
+def agreed(path):
+    detail = ""
+    try:
+        do_work(path)
+    except FileNotFoundError:
+        detail = "missing"
+    except Exception as exc:
+        detail = str(exc)
+    return allgather_records("phase", not detail, detail)
+"""
+    assert _findings(src) == []
+
+
+def test_fires_on_narrow_tuple_without_any_broad_sibling():
+    """The sibling exemption needs a broad handler somewhere in the same
+    try — a lone narrow tuple still leaks."""
+    src = """
+def agreed(path):
+    try:
+        do_work(path)
+    except (OSError, ValueError):
+        pass
+    return allgather_records("phase", True, "")
+"""
+    (f,) = _findings(src)
+    assert "OSError, ValueError" in f.message
+
+
+def test_silent_on_narrow_sibling_after_a_broad_one():
+    """Broad-first means the narrow handler is dead code — a ruff
+    problem, not a strand hazard: nothing can leak this try."""
+    src = """
+def agreed(path):
+    try:
+        do_work(path)
+    except Exception:
+        pass
+    except ValueError:
+        pass
+    return allgather_records("phase", True, "")
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_narrow_translator_that_reraises():
+    src = """
+def collective(payload):
+    try:
+        return raw_allgather(payload)
+    except WatchdogTimeout as exc:
+        raise PeerFailure("peers silent") from exc
+    finally:
+        allgather_records("accounting", True)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_callless_attribute_poke():
+    """supervision.deliver_poison's try: there is no *call* in the try
+    body, so no exception type can leak a fallible operation past the
+    funnel — narrowness is fine."""
+    src = """
+def deliver(error):
+    try:
+        error._poison_delivered = True
+    except AttributeError:
+        pass
+    allgather_records("poison_exit", False, fatal=True)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_when_no_agreement_in_scope():
+    """Narrow swallows are only an invariant violation on agreement
+    paths; ordinary code keeps its idioms."""
+    src = """
+def probe(path):
+    try:
+        return read_header(path)
+    except (OSError, EOFError):
+        return None
+"""
+    assert _findings(src) == []

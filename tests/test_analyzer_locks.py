@@ -1,0 +1,331 @@
+"""Fixture suite: the lock-discipline checker + the real lock graph."""
+
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.analyzer import analyze_snippet, run_analysis  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src):
+    return analyze_snippet(src, checkers=["lock-discipline"])
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_device_put_under_lock():
+    src = """
+import threading, jax
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def swap_params(self, params):
+        with self._lock:
+            self._params = jax.device_put(params)
+"""
+    (f,) = _findings(src)
+    assert "device_put" in f.message and "Engine._lock" in f.message
+
+
+def test_fires_on_file_io_under_lock():
+    src = """
+import threading
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def write(self, line):
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+"""
+    (f,) = _findings(src)
+    assert "file IO" in f.message
+
+
+def test_fires_on_collective_under_module_lock():
+    src = """
+import threading
+
+_lock = threading.Lock()
+
+def agreed_update(ok):
+    with _lock:
+        return allgather_records("phase", ok)
+"""
+    (f,) = _findings(src)
+    assert "collective" in f.message
+
+
+def test_fires_on_queue_get_and_thread_join_under_lock():
+    src = """
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self):
+        with self._lock:
+            item = self._queue.get()
+            self._thread.join()
+            return item
+"""
+    assert len(_findings(src)) == 2
+
+
+def test_fires_on_inconsistent_lock_order():
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._staging_lock = threading.Lock()
+
+    def dispatch(self):
+        with self._lock:
+            with self._staging_lock:
+                return self.free.pop()
+
+    def release(self):
+        with self._staging_lock:
+            with self._lock:
+                self.free.append(None)
+"""
+    (f,) = _findings(src)
+    assert "inconsistent lock order" in f.message
+    assert "Pool._lock" in f.message and "Pool._staging_lock" in f.message
+
+
+def test_fires_on_module_level_with_lock():
+    """Init-time code in scripts runs at module scope — blocking work
+    under a module-level lock must be checked like function bodies."""
+    src = """
+import threading
+
+_lock = threading.Lock()
+
+with _lock:
+    DATA = open("state.json").read()
+"""
+    (f,) = _findings(src)
+    assert "file IO" in f.message and f.symbol == "<module>"
+
+
+def test_fires_on_bare_name_collective_under_lock():
+    """from-imported collectives call as bare names (the checkpoint.py
+    style) — they must be flagged exactly like the attribute form."""
+    src = """
+import threading
+from pytorch_distributed_mnist_tpu.runtime.supervision import _agree_phase_ok
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def publish(self, err, epoch):
+        with self._lock:
+            return _agree_phase_ok(err, epoch, "write", "x")
+"""
+    (f,) = _findings(src)
+    assert "collective" in f.message and "Writer._lock" in f.message
+
+
+def test_fires_on_blocking_second_with_item_under_lock():
+    """``with self._lock, open(...)``: items enter left to right, so the
+    open() runs while the lock is held."""
+    src = """
+import threading
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, path):
+        with self._lock, open(path, "a") as f:
+            f.write("x")
+"""
+    (f,) = _findings(src)
+    assert "file IO" in f.message
+
+
+def test_fires_on_three_lock_cycle():
+    """A 3-lock ring (A->B, B->C, C->A) deadlocks just as hard as a
+    direct inversion — the order graph must be acyclic, not merely free
+    of 2-cycles."""
+    src = """
+import threading
+
+class Trio:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def bc(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def ca(self):
+        with self._c:
+            with self._a:
+                pass
+"""
+    (f,) = _findings(src)
+    assert "acquisition cycle" in f.message
+    assert all(name in f.message
+               for name in ("Trio._a", "Trio._b", "Trio._c"))
+
+
+def test_fires_on_nested_same_lock_reacquisition():
+    """``with self._lock:`` inside itself is a self-deadlock on a plain
+    Lock — reported as a 1-node cycle, not an analyzer crash."""
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+    (f,) = _findings(src)
+    assert "acquisition cycle" in f.message
+    assert "C._lock -> C._lock" in f.message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_silent_on_blocking_with_item_before_lock():
+    """``with open(...), self._lock``: the open() completes BEFORE the
+    lock is acquired — flagging it would force a bogus baseline entry."""
+    src = """
+import threading
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, path):
+        with open(path, "a") as f, self._lock:
+            f.write("x")
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_snapshot_then_operate_after_release():
+    """The engine swap_params idiom: slow work outside, reference swap
+    under the lock."""
+    src = """
+import threading, jax
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def swap_params(self, params, epoch):
+        placed = jax.device_put(params)
+        with self._lock:
+            if self._epoch is not None and epoch < self._epoch:
+                return False
+            self._params = placed
+            return True
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_condition_variable_wait():
+    src = """
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def take(self):
+        with self._cv:
+            while not self._queue:
+                self._cv.wait()
+            self._cv.notify_all()
+            return self._queue.pop(0)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_str_join_and_dict_get_under_lock():
+    """join/get heuristics must not flag strings and dicts."""
+    src = """
+import threading
+
+class Log:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def snapshot(self, sep):
+        with self._lock:
+            rec = self._programs.get("name")
+            return ", ".join(self._lines) + sep.join(self._lines) + str(rec)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_consistent_nested_order():
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._staging_lock = threading.Lock()
+
+    def a(self):
+        with self._lock:
+            with self._staging_lock:
+                pass
+
+    def b(self):
+        with self._lock:
+            with self._staging_lock:
+                pass
+"""
+    assert _findings(src) == []
+
+
+# -- the real lock graph -----------------------------------------------------
+
+
+def test_reports_engine_and_pool_lock_graph():
+    """ISSUE 5 acceptance: the engine/pool lock graph is reported."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve")],
+        checkers=["lock-discipline"], baseline=None)
+    assert result.findings == []  # the serve plane is lock-clean
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    engine = graph["pytorch_distributed_mnist_tpu/serve/engine.py"]
+    assert set(engine["locks"]) == {"InferenceEngine._lock",
+                                    "InferenceEngine._staging_lock"}
+    # The two engine locks are never nested — that IS the discipline.
+    assert engine["order_edges"] == []
+    pool = graph["pytorch_distributed_mnist_tpu/serve/pool.py"]
+    assert pool["locks"] == ["EnginePool._lock"]
+    batcher = graph["pytorch_distributed_mnist_tpu/serve/batcher.py"]
+    assert batcher["locks"] == ["MicroBatcher._cv"]

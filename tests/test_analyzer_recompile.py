@@ -1,0 +1,134 @@
+"""Fixture suite: the recompile-hazard checker."""
+
+
+import pytest
+
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src):
+    return analyze_snippet(src, checkers=["recompile-hazard"])
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_scalar_into_precompile_product():
+    src = """
+def serve(fn, params_spec, image_spec, x):
+    exe = precompile(fn, params_spec, image_spec, program="fwd")
+    return exe(x, 0.5)
+"""
+    (f,) = _findings(src)
+    assert "argument 1" in f.message and "AOT-compiled" in f.message
+
+
+def test_fires_on_scalar_into_lower_compile_product():
+    src = """
+def bench(step, state_spec, batch_spec):
+    compiled = step.lower(state_spec, batch_spec).compile()
+    return compiled(-1, batch_spec)
+"""
+    (f,) = _findings(src)
+    assert "argument 0" in f.message
+
+
+def test_fires_on_scalar_into_self_attribute_executable():
+    src = """
+class Engine:
+    def warm(self, fn, spec):
+        self._fwd = precompile(fn, spec)
+
+    def infer(self, params):
+        return self._fwd(params, 3)
+"""
+    (f,) = _findings(src)
+    assert f.symbol.endswith("infer")
+
+
+def test_fires_on_jit_without_static_declaration():
+    src = """
+import jax
+
+def forward(params, x, train=False, impl="xla"):
+    return x
+
+prog = jax.jit(forward)
+"""
+    (f,) = _findings(src)
+    assert "train" in f.message and "impl" in f.message
+    assert "static_argnums" in f.message
+
+
+def test_fires_on_bare_jit_decorator_with_config_default():
+    src = """
+import jax
+
+@jax.jit
+def kernel(x, interpret=False):
+    return x
+"""
+    (f,) = _findings(src)
+    assert "interpret" in f.message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_silent_when_statics_are_declared():
+    src = """
+import functools, jax
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def kernel(x, interpret=False):
+    return x
+
+def forward(params, x, train=False):
+    return x
+
+prog = jax.jit(forward, static_argnames=("train",))
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_array_variables_into_executables():
+    """The trainer/engine idiom: staged arrays and specs, never bare
+    literals."""
+    src = """
+def serve(fn, params_spec, image_spec, params, staged):
+    exe = precompile(fn, params_spec, image_spec)
+    return exe(params, staged)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_partial_bound_config():
+    """functools.partial binding before jit is the steps.py idiom — the
+    bound value is baked in at trace time, nothing to declare."""
+    src = """
+import functools, jax
+
+def step(state, batch, aux_weight=0.0):
+    return state
+
+step_fn = functools.partial(step, aux_weight=0.5)
+prog = jax.jit(step_fn, donate_argnums=(0,))
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_float_default_without_static():
+    """Float defaults are weight-like (aux_weight), not config flags —
+    jit traces them fine; only hashable bool/str config is flagged."""
+    src = """
+import jax
+
+def step(state, batch, aux_weight=0.0):
+    return state
+
+prog = jax.jit(step)
+"""
+    assert _findings(src) == []

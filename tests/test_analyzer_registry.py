@@ -1,0 +1,137 @@
+"""Fixture suite: registry-drift (fault points) and marker-registry."""
+
+
+import pytest
+
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+from tools.analyzer.checkers import marker_registry  # noqa: E402
+from tools.analyzer.core import Module  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _drift(src):
+    return analyze_snippet(src, checkers=["registry-drift"])
+
+
+# -- fault-point drift: firing ----------------------------------------------
+
+
+def test_fires_on_unregistered_hook():
+    src = """
+FAULT_POINTS = {"ckpt_write": "shard file IO"}
+
+def save():
+    maybe_fault("ckpt_write")
+
+def publish():
+    maybe_fault("ckpt_publish")
+"""
+    (f,) = _drift(src)
+    assert "ckpt_publish" in f.message and "not in FAULT_POINTS" in f.message
+
+
+def test_fires_on_unreachable_registry_entry():
+    src = """
+FAULT_POINTS = {
+    "ckpt_write": "shard file IO",
+    "resume": "cli resume entry",
+}
+
+def save():
+    maybe_fault("ckpt_write")
+"""
+    (f,) = _drift(src)
+    assert "'resume'" in f.message and "no" in f.message
+    assert f.line == 4  # points at the registry key itself
+
+
+def test_fires_on_computed_point_name():
+    src = """
+FAULT_POINTS = {"a": "x"}
+
+def f(which):
+    maybe_fault("a")
+    maybe_fault(f"ckpt_{which}")
+"""
+    (f,) = _drift(src)
+    assert "string literal" in f.message
+
+
+# -- fault-point drift: non-firing -------------------------------------------
+
+
+def test_silent_when_registry_and_hooks_agree():
+    src = """
+FAULT_POINTS = {"a": "x", "b": "y"}
+
+def f():
+    maybe_fault("a")
+
+def g():
+    maybe_fault("b")
+"""
+    assert _drift(src) == []
+
+
+def test_silent_on_hooks_without_a_registry_in_view():
+    """Analyzing a lone hook-bearing file must not invent drift — the
+    registry module simply isn't in the analyzed set."""
+    src = """
+def save():
+    maybe_fault("ckpt_write")
+"""
+    assert _drift(src) == []
+
+
+# -- marker registry ---------------------------------------------------------
+
+
+def _marker_findings(src, registered):
+    import ast
+
+    module = Module(path="test_x.py", tree=ast.parse(src), source=src)
+    return marker_registry.check_usage(module, registered)
+
+
+def test_marker_fires_on_unregistered_and_misspelled():
+    src = """
+import pytest
+
+@pytest.mark.serv
+def test_a():
+    pass
+
+pytestmark = pytest.mark.choas
+"""
+    findings = _marker_findings(src, {"serve", "chaos", "slow"})
+    assert {f.symbol for f in findings} == {"serv", "choas"}
+
+
+def test_marker_silent_on_registered_and_builtin():
+    src = """
+import pytest
+
+@pytest.mark.slow
+@pytest.mark.parametrize("x", [1, 2])
+def test_a(x):
+    pass
+
+pytestmark = pytest.mark.serve
+"""
+    assert _marker_findings(src, {"serve", "chaos", "slow"}) == []
+
+
+def test_registered_markers_parser_matches_known_pyproject():
+    text = (
+        'markers = [\n'
+        '    "slow: spawns subprocesses",\n'
+        '    "serve: serving subsystem",\n'
+        '    "zero3(tol): sharded-optimizer tolerance",\n'
+        '    "flaky",\n'  # pytest accepts a description-less marker
+        ']\n'
+    )
+    assert marker_registry.registered_markers(text) == {
+        "slow", "serve", "zero3", "flaky"}
+    assert marker_registry.registered_markers("nothing here") == set()

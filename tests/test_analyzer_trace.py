@@ -1,0 +1,162 @@
+"""Fixture suite: the trace-purity checker.
+
+Traced-function discovery (decorators, the jit/shard_map factory idiom,
+the module-local call-graph walk) and each impurity class: host side
+effects (print/logging/time/random), tracer concretization
+(.item()/float()/np.asarray on traced params), and enclosing-state
+mutation (global/nonlocal).
+"""
+
+
+import pytest
+
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src):
+    return analyze_snippet(src, checkers=["trace-purity"])
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_print_in_jit_factory_product():
+    src = """
+import jax
+
+def make_step():
+    def step(state, batch):
+        print("debug", batch)
+        return state
+    return jax.jit(step, donate_argnums=(0,))
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "step" and "trace time" in f.message
+
+
+def test_fires_on_item_under_partial_jit_decorator():
+    src = """
+import functools, jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    loss = batch.item()
+    return state, loss
+"""
+    (f,) = _findings(src)
+    assert ".item()" in f.message and "batch" in f.message
+
+
+def test_fires_through_the_call_graph_walk():
+    """An impure helper is caught even though only its caller is jitted
+    — the walk follows module-local calls."""
+    src = """
+import jax, time
+
+def _stamp(x):
+    return x + time.time()
+
+def step(state):
+    return _stamp(state)
+
+step = jax.jit(step)
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "_stamp" and "time.time" in f.message
+
+
+def test_fires_on_global_mutation_in_shard_map_body():
+    src = """
+import functools, jax
+
+@functools.partial(jax.shard_map, mesh=None, in_specs=(), out_specs=())
+def body(batch):
+    global _seen
+    _seen += 1
+    return batch
+"""
+    (f,) = _findings(src)
+    assert "global" in f.message
+
+
+def test_fires_on_python_random_and_np_asarray():
+    src = """
+import random
+import numpy as np
+import jax
+
+def step(x):
+    noise = random.random()
+    host = np.asarray(x)
+    return host + noise
+
+step = jax.jit(step)
+"""
+    messages = " | ".join(f.message for f in _findings(src))
+    assert "random" in messages and "np.asarray" in messages
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_silent_on_static_param_concretization():
+    """float()/branching on a declared-static parameter is trace-time
+    resolution — the point of declaring it static."""
+    src = """
+import functools, jax
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def kernel(x, scale, interpret=False):
+    if interpret:
+        return x
+    return x * float(scale)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_jnp_asarray_and_shape_math():
+    """The codebase idiom: jnp.asarray stays abstract, and float() on a
+    non-parameter expression (static shapes) is fine."""
+    src = """
+import jax
+import jax.numpy as jnp
+
+def step(state, batch):
+    n = jnp.asarray(float(batch.shape[0]), jnp.float32)
+    return state, n
+
+step = jax.jit(step, donate_argnums=(0,))
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_host_side_code():
+    """print/time/.item() in UNtraced functions is ordinary host code."""
+    src = """
+import time
+
+def train_loop(trainer):
+    t0 = time.time()
+    loss = trainer.step().item()
+    print(f"epoch done in {time.time() - t0:.1f}s, loss {loss}")
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_raise_for_static_shape_validation():
+    """Raising on static shape mismatch at trace time is sanctioned
+    (the make_accum_train_step_fn idiom)."""
+    src = """
+import jax
+
+def step(state, batch):
+    if batch.shape[0] % 4:
+        raise ValueError(f"batch {batch.shape[0]} not divisible by 4")
+    return state
+
+step = jax.jit(step)
+"""
+    assert _findings(src) == []

@@ -1,61 +1,57 @@
-"""Tier-1 guard: every pytest marker used under tests/ is registered in
-pyproject.toml.
+"""Tier-1 guard: every pytest marker used under tests/ is registered.
+
+Since ISSUE 5 the actual logic lives in tpumnist-lint's
+``marker-registry`` checker (tools/analyzer/checkers/marker_registry.py)
+— this file is the thin tier-1 wrapper that runs it over tests/ and
+keeps the historical guard-on-the-guard (a parser that matched nothing
+would pass vacuously).
 
 An unregistered marker is a silent hole: ``-m chaos`` style selection
 quietly matches nothing (or everything), and pytest's warning scrolls
 past in CI — a test marked with a misspelling like ``serv`` would run
 in the default profile AND be invisible to the marker-filtered
-profiles. This guard turns that drift into a red test with the
-offending names. (This file itself never spells out the
-``pytest  . mark  . name`` attribute form for its examples — the scan
-below would flag them.)"""
+profiles. (This file never spells the ``pytest . mark . name``
+attribute form in prose — the checker would count it as a use.)
+"""
 
 import pathlib
-import re
-
-# Markers pytest itself defines; everything else must be declared.
-_BUILTIN = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
-            "filterwarnings", "tryfirst", "trylast"}
 
 
-def _registered_markers(pyproject_text: str) -> set:
-    """Parse ``[tool.pytest.ini_options] markers`` without tomllib
-    (python 3.10): the entries are quoted "name: description" strings
-    inside the markers = [...] list."""
-    section = re.search(r"markers\s*=\s*\[(.*?)\]", pyproject_text, re.S)
-    assert section, "pyproject.toml has no pytest markers list"
-    return set(re.findall(r'"\s*([A-Za-z_]\w*)\s*[:(]', section.group(1)))
+from tools.analyzer import run_analysis  # noqa: E402
+from tools.analyzer.checkers.marker_registry import (  # noqa: E402
+    registered_markers,
+)
 
-
-def _used_markers(tests_dir: pathlib.Path) -> dict:
-    """marker name -> first file using it, from both the decorator and
-    the module-level ``pytestmark`` assignment forms."""
-    used = {}
-    for path in sorted(tests_dir.glob("**/*.py")):
-        for match in re.finditer(r"pytest\.mark\.([A-Za-z_]\w*)",
-                                 path.read_text()):
-            used.setdefault(match.group(1), path.name)
-    return used
+_TESTS = pathlib.Path(__file__).resolve().parent
 
 
 def test_every_marker_used_in_tests_is_registered():
-    tests_dir = pathlib.Path(__file__).resolve().parent
-    pyproject = tests_dir.parent / "pyproject.toml"
-    registered = _registered_markers(pyproject.read_text())
-    used = _used_markers(tests_dir)
-    unregistered = {name: where for name, where in used.items()
-                    if name not in registered and name not in _BUILTIN}
-    assert not unregistered, (
-        f"markers used but not registered in pyproject.toml "
-        f"[tool.pytest.ini_options] markers: {unregistered}")
+    result = run_analysis([str(_TESTS)], checkers=["marker-registry"],
+                          baseline=None)
+    assert result.ok, "\n".join(f.render() for f in result.findings)
+    # The checker actually looked at marker uses (guard on the guard).
+    assert result.reports["marker-registry"]["marker_uses"] > 10
 
 
 def test_known_markers_really_parse():
-    """The parser above sees the markers we know exist — a guard on the
-    guard (a regex that matched nothing would pass vacuously)."""
-    tests_dir = pathlib.Path(__file__).resolve().parent
-    registered = _registered_markers(
-        (tests_dir.parent / "pyproject.toml").read_text())
-    assert {"slow", "chaos", "serve"} <= registered
-    used = _used_markers(tests_dir)
-    assert {"slow", "chaos", "serve"} <= set(used)
+    """The analyzer's pyproject parser sees the markers we know exist —
+    a regex that matched nothing would make the wrapper vacuous."""
+    pyproject = _TESTS.parent / "pyproject.toml"
+    registered = registered_markers(pyproject.read_text())
+    assert {"slow", "chaos", "serve", "lint"} <= registered
+
+
+def test_wrapper_fails_on_a_misspelled_marker(tmp_path):
+    """End-to-end drift proof: an unregistered marker in a test file is
+    a finding (the pre-ISSUE-5 assertion, now through the analyzer)."""
+    bad = tmp_path / "tests" / "test_bad.py"
+    bad.parent.mkdir()
+    bad.write_text("import pytest\npytestmark = pytest.mark.serv\n")
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.pytest.ini_options]\nmarkers = [\n'
+        '    "serve: serving subsystem",\n]\n')
+    result = run_analysis([str(bad)], checkers=["marker-registry"],
+                          baseline=None)
+    assert not result.ok
+    (finding,) = result.findings
+    assert finding.symbol == "serv"

@@ -1,0 +1,7 @@
+"""Operator tooling for the tpu-mnist framework.
+
+A regular package so ``python -m tools.analyzer`` and ``from
+tools.analyzer import run_analysis`` resolve identically everywhere
+(scripts in this directory also run standalone via their own
+sys.path bootstrap, unchanged).
+"""

@@ -1,0 +1,59 @@
+"""tpumnist-lint: AST-based invariant checker for the tpu-mnist codebase.
+
+Five invariant families, each encoding an incident or asserted property
+from PRs 1-4 (docs/DESIGN.md §8 maps checker -> incident):
+
+- ``collective-symmetry``   collectives never sit under host-conditional
+                            control flow (the structural-hang class)
+- ``agreement-except-breadth``  exception funnels on agreement paths
+                            catch broadly (the zlib.error strand class)
+- ``trace-purity``          traced/lowered functions are pure: no host
+                            side effects, no tracer concretization
+- ``recompile-hazard``      AOT executables get arrays; jit sites
+                            declare hashable config static
+- ``lock-discipline``       no blocking work under engine/pool/sink
+                            locks; one global acquisition order
+- ``registry-drift``        fault-point registry == maybe_fault hooks
+- ``marker-registry``       pytest markers used == markers registered
+
+Run it::
+
+    python -m tools.analyzer [--format text|json] [--baseline FILE] [paths]
+
+or from tests (the tier-1 gate)::
+
+    from tools.analyzer import run_analysis
+    result = run_analysis(["pytorch_distributed_mnist_tpu", "tools",
+                           "bench.py"])
+    assert result.ok, result.findings
+
+Pure stdlib; never imports the analyzed code.
+"""
+
+from tools.analyzer.core import (
+    SCHEMA_VERSION,
+    AnalysisResult,
+    CheckerResult,
+    Finding,
+    Module,
+    analyze_snippet,
+    checker_registry,
+    default_baseline_path,
+    load_baseline,
+    render_text,
+    run_analysis,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "AnalysisResult",
+    "CheckerResult",
+    "Finding",
+    "Module",
+    "analyze_snippet",
+    "checker_registry",
+    "default_baseline_path",
+    "load_baseline",
+    "render_text",
+    "run_analysis",
+]

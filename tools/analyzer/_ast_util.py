@@ -1,0 +1,166 @@
+"""Shared AST helpers for the tpumnist-lint checkers.
+
+Pure stdlib ``ast`` — no imports of the analyzed code. Everything here is
+syntactic: dotted-name rendering, scope walks that respect function
+boundaries, and small predicates the checkers share so their notion of
+"a call to X" cannot drift from one another.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+#: Node types that open a new runtime scope — traversals that reason about
+#: "code executed here" must not descend into these.
+SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` for a Name/Attribute chain; None for anything
+    dynamic (subscripts, call results) — callers treat None as 'unknown',
+    never as a match."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def last_segment(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def head_segment(name: Optional[str]) -> str:
+    return name.split(".", 1)[0] if name else ""
+
+
+def walk_in_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Yield ``node``'s subtree WITHOUT entering nested function/class
+    scopes: the statements that actually execute when this scope runs.
+    ``node`` itself is yielded (unless it is a scope node being entered
+    from outside — callers pass a function's *body* items, not the def)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, SCOPE_NODES):
+                continue
+            stack.append(child)
+
+
+def walk_body_in_scope(body: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    for stmt in body:
+        yield from walk_in_scope(stmt)
+
+
+def iter_functions(
+    tree: ast.AST,
+) -> Iterator[Tuple[ast.AST, str, Optional[str]]]:
+    """Yield ``(funcnode, qualname, classname)`` for every function in the
+    module, nested ones included. ``qualname`` is dotted through the
+    enclosing defs/classes; ``classname`` is the nearest enclosing class
+    (None at module level) — the lock checker keys lock objects by it."""
+
+    def visit(node: ast.AST, prefix: str, classname: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, qual, classname
+                yield from visit(child, qual, classname)
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield from visit(child, qual, child.name)
+            else:
+                yield from visit(child, prefix, classname)
+
+    yield from visit(tree, "", None)
+
+
+def defs_by_name(tree: ast.AST) -> dict:
+    """``{name: [def nodes]}`` over the whole module, nested defs included
+    — the shared "resolve a bare callee name" index (trace-purity's call
+    graph and recompile-hazard's jit-site lookup must agree on it)."""
+    defs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def function_param_names(fn: ast.AST) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def handler_type_names(handler: ast.ExceptHandler) -> List[str]:
+    """The dotted names an ``except`` clause catches; ``[]`` for a bare
+    ``except:``. Unresolvable entries (dynamic expressions) render as
+    ``"<dynamic>"`` so breadth checks stay conservative."""
+    t = handler.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return [dotted_name(e) or "<dynamic>" for e in elts]
+
+
+def contains_call_to(node: ast.AST, last_segments: set) -> bool:
+    """True when ``node``'s in-scope subtree calls any function whose last
+    dotted segment is in ``last_segments``."""
+    for sub in walk_in_scope(node):
+        if isinstance(sub, ast.Call) and \
+                last_segment(call_name(sub)) in last_segments:
+            return True
+    return False
+
+
+def body_contains_any_call(body: Sequence[ast.stmt]) -> bool:
+    for sub in walk_body_in_scope(body):
+        if isinstance(sub, ast.Call):
+            return True
+    return False
+
+
+def body_contains_raise(body: Sequence[ast.stmt]) -> bool:
+    for sub in walk_body_in_scope(body):
+        if isinstance(sub, ast.Raise):
+            return True
+    return False
+
+
+def str_constants(node: ast.AST) -> List[str]:
+    """String literals inside a tuple/list/single-constant expression."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+    return []
+
+
+def int_constants(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+        return out
+    return []

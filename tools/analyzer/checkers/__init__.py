@@ -1,0 +1,33 @@
+"""Checker registry for tpumnist-lint.
+
+Ordered: the order here is the order checkers run and the order
+``--list-checkers`` prints. Each module exposes ``CHECKER_ID`` and
+``run(modules) -> CheckerResult``.
+"""
+
+from __future__ import annotations
+
+from tools.analyzer.checkers import (
+    collective_symmetry,
+    exception_breadth,
+    lock_discipline,
+    marker_registry,
+    recompile_hazard,
+    registry_drift,
+    trace_purity,
+)
+
+REGISTRY = {
+    mod.CHECKER_ID: mod
+    for mod in (
+        collective_symmetry,
+        exception_breadth,
+        trace_purity,
+        recompile_hazard,
+        lock_discipline,
+        registry_drift,
+        marker_registry,
+    )
+}
+
+__all__ = ["REGISTRY"]

@@ -1,0 +1,264 @@
+"""Checker: collective/agreement calls must be host-symmetric.
+
+The invariant (runtime/supervision.py module docstring): multi-host
+collectives have no timeout, so EVERY host must reach every collective —
+a collective call under a ``process_index()``-conditioned branch, or in
+a loop whose trip count differs per host, is a structural hang. This is
+the "no host may fail alone" rule's static twin: the supervision layer
+can convert a host-local *error* into an agreed exit, but nothing can
+rescue a host that simply never calls the collective its peers are
+blocked in.
+
+``process_count()`` guards are symmetric (every host computes the same
+world size) and are NOT flagged — ``if process_count() <= 1: return`` is
+the sanctioned single-process fast path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyzer._ast_util import (
+    call_name,
+    contains_call_to,
+    last_segment,
+)
+from tools.analyzer.core import CheckerResult, Finding, Module
+
+CHECKER_ID = "collective-symmetry"
+
+#: Host-side collective entry points (matched on the last dotted segment).
+COLLECTIVE_CALLS = {
+    "allgather_records",
+    "agree",
+    "_agree_phase_ok",
+    "raise_if_poisoned",  # decodes an allgather every host must have run
+    "process_allgather",
+    "broadcast_one_to_all",
+    "sync_global_devices",
+}
+
+#: Calls whose result differs per host — a branch on one is asymmetric.
+HOST_DEPENDENT_CALLS = {"process_index"}
+
+
+def _is_host_dependent(expr: ast.AST) -> bool:
+    return contains_call_to(expr, HOST_DEPENDENT_CALLS)
+
+
+def _definite_exit(body: List[ast.stmt]) -> Optional[str]:
+    """``"function"``/``"break"``/``"continue"`` when the statement list
+    unconditionally leaves the enclosing scope (a direct
+    Return/Raise/Break/Continue — nested conditionals don't count: they
+    exit only sometimes). Break and continue are distinct kinds: one arm
+    breaking while the other continues still diverges the trip counts."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return "function"
+        if isinstance(stmt, ast.Break):
+            return "break"
+        if isinstance(stmt, ast.Continue):
+            return "continue"
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, module: Module) -> None:
+        self.module = module
+        self.findings: List[Finding] = []
+        self._cond_stack: List[str] = []  # human reason per open hazard
+        self._symbol: Optional[str] = None
+        # Set once a host-conditioned branch definitely exited on one
+        # side: every host-asymmetry hazard AFTER that point, not just
+        # inside the branch (the early-return form of the bug).
+        self._divergent: Optional[str] = None
+        # Local names bound to a process_index() result — the codebase's
+        # dominant idiom is ``pid = process_index()`` then branching on
+        # ``pid``, so taint flows through simple assignments.
+        self._host_names: set = set()
+
+    # -- scope handling ----------------------------------------------------
+
+    def _visit_function(self, node) -> None:
+        saved = (self._cond_stack, self._symbol, self._divergent,
+                 self._host_names)
+        # A nested def under a host-conditional is only *defined* there;
+        # where it runs is its callers' business — fresh context.
+        self._cond_stack, self._symbol = [], node.name
+        self._divergent, self._host_names = None, set()
+        self.generic_visit(node)
+        (self._cond_stack, self._symbol, self._divergent,
+         self._host_names) = saved
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self._cond_stack, self._divergent, self._host_names
+        self._cond_stack, self._divergent = [], None
+        self._host_names = set()
+        self.generic_visit(node)
+        self._cond_stack, self._divergent, self._host_names = saved
+
+    # -- host-dependence taint ---------------------------------------------
+
+    def _host_dependent(self, expr: ast.AST) -> bool:
+        if _is_host_dependent(expr):
+            return True
+        return any(isinstance(n, ast.Name) and n.id in self._host_names
+                   for n in ast.walk(expr))
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Starred):
+            target = target.value
+        if isinstance(target, ast.Name):
+            if tainted:
+                self._host_names.add(target.id)
+            else:
+                self._host_names.discard(target.id)  # rebound clean
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, tainted)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, (ast.Tuple, ast.List)) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)) \
+                    and len(target.elts) == len(node.value.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in target.elts):
+                # positional unpack: taint each name from ITS value, so
+                # ``pid, n = process_index(), 1`` taints only pid
+                for t, v in zip(target.elts, node.value.elts):
+                    self._bind(t, self._host_dependent(v))
+            else:
+                self._bind(target, self._host_dependent(node.value))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._bind(node.target, self._host_dependent(node.value))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # ``x += process_index()`` adds taint; an already-tainted target
+        # stays tainted (augmented assignment folds the old value in).
+        if isinstance(node.target, ast.Name) \
+                and self._host_dependent(node.value):
+            self._host_names.add(node.target.id)
+        self.generic_visit(node)
+
+    # -- hazard contexts ---------------------------------------------------
+
+    def _visit_conditional(self, node, test_expr, kind: str) -> None:
+        hazardous = self._host_dependent(test_expr)
+        if hazardous:
+            self._cond_stack.append(
+                f"{kind} at line {node.lineno} conditioned on "
+                f"process_index()")
+        self.generic_visit(node)
+        if hazardous:
+            self._cond_stack.pop()
+
+    def visit_If(self, node: ast.If) -> None:
+        # Judge the test ONCE, before the branch bodies run visit_Assign
+        # and mutate the taint set — re-evaluating after generic_visit
+        # would let a clean rebind inside the branch hide the divergence
+        # (or an assignment inside it fake one).
+        hazardous = self._host_dependent(node.test)
+        if hazardous:
+            self._cond_stack.append(
+                f"if at line {node.lineno} conditioned on "
+                f"process_index()")
+        self.generic_visit(node)
+        if hazardous:
+            self._cond_stack.pop()
+        if hazardous and self._divergent is None:
+            # The arms leave DIFFERENT scopes (one falls through, or one
+            # exits the function while the other only exits a loop):
+            # hosts part ways HERE, so every collective after this
+            # statement is asymmetric — the early-return form of the
+            # structural hang. (Both arms exiting the same scope is
+            # symmetric: no host reaches the code after.)
+            body_exit = _definite_exit(node.body)
+            else_exit = _definite_exit(node.orelse)
+            if body_exit != else_exit:
+                # A function-exit on either side out-scopes a loop-exit:
+                # the returning hosts are gone for good, so divergence
+                # survives past the enclosing loop.
+                kind = "function" if "function" in (body_exit, else_exit) \
+                    else "loop"
+                self._divergent = (kind, (
+                    f"early {'return/raise' if kind == 'function' else 'break/continue'}"
+                    f" under the process_index()-conditioned if at line "
+                    f"{node.lineno}"))
+
+    def _visit_loop_body(self, node) -> None:
+        saved = self._divergent
+        self.generic_visit(node)
+        if self._divergent is not None and self._divergent[0] == "loop" \
+                and self._divergent is not saved:
+            # break/continue divergence ends with its loop: hosts rejoin
+            # at the loop exit (a return/raise set inside persists).
+            self._divergent = saved
+
+    def visit_While(self, node: ast.While) -> None:
+        hazardous = self._host_dependent(node.test)
+        if hazardous:
+            self._cond_stack.append(
+                f"while at line {node.lineno} conditioned on "
+                f"process_index()")
+        self._visit_loop_body(node)
+        if hazardous:
+            self._cond_stack.pop()
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._visit_conditional(node, node.test, "conditional expression")
+
+    def visit_For(self, node: ast.For) -> None:
+        hazardous = self._host_dependent(node.iter)
+        if hazardous:
+            self._cond_stack.append(
+                f"for-loop at line {node.lineno} with a "
+                f"process_index()-dependent trip count")
+        self._visit_loop_body(node)
+        if hazardous:
+            self._cond_stack.pop()
+
+    # -- the check ---------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if last_segment(name) in COLLECTIVE_CALLS and (
+                self._cond_stack or self._divergent):
+            if self._cond_stack:
+                where = f"under a host-dependent {self._cond_stack[-1]}"
+            else:
+                where = f"after an {self._divergent[1]}"
+            self.findings.append(Finding(
+                checker=CHECKER_ID,
+                path=self.module.path,
+                line=node.lineno,
+                col=node.col_offset,
+                symbol=self._symbol or "<module>",
+                message=(
+                    f"collective {name}() {where}: hosts that skip this "
+                    f"call strand the hosts blocked in it (collectives "
+                    f"have no timeout)"),
+                hint=(
+                    "run the collective on every host and branch on its "
+                    "RESULT; per-host work belongs inside the branch, "
+                    "the agreement outside it (see "
+                    "runtime/supervision.py)"),
+            ))
+        self.generic_visit(node)
+
+
+def run(modules: List[Module]) -> CheckerResult:
+    findings: List[Finding] = []
+    for module in modules:
+        visitor = _Visitor(module)
+        visitor.visit(module.tree)
+        findings.extend(visitor.findings)
+    return CheckerResult(findings=findings)

@@ -1,0 +1,122 @@
+"""Checker: exception funnels on agreement paths must catch broadly.
+
+The zlib-strand class (ADVICE round 5, fixed in PR 1): a host-local
+failure between a fault point and its agreement collective is REPORTED to
+the peers via that collective — so the ``try`` that converts "this host
+failed" into "this host votes E" must funnel *every* failure. A narrow
+tuple (``except (OSError, ValueError)``) leaks any unanticipated type
+(``zlib.error`` was the historical one: corrupt mid-stream gzip, not an
+OSError subclass) past the funnel, and the host dies alone while its
+peers block forever in the timeout-less collective.
+
+Rule: inside any function whose scope (nested defs included) performs an
+agreement collective, an ``except`` that
+
+- names specific types rather than ``Exception``/``BaseException``/bare,
+- swallows (its handler body never raises), and
+- guards a try body that actually calls something (an attribute-poke
+  ``try`` has nothing to leak)
+
+is flagged. Handlers that re-raise are translators, not funnels — they
+may be as narrow as they like.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyzer._ast_util import (
+    body_contains_any_call,
+    body_contains_raise,
+    call_name,
+    handler_type_names,
+    iter_functions,
+    last_segment,
+)
+from tools.analyzer.core import CheckerResult, Finding, Module
+
+CHECKER_ID = "agreement-except-breadth"
+
+#: A call to any of these makes the enclosing function an agreement scope.
+AGREEMENT_CALLS = {"allgather_records", "agree", "_agree_phase_ok"}
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _subtree_has_agreement(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):  # nested defs included on purpose
+        if isinstance(node, ast.Call) and \
+                last_segment(call_name(node)) in AGREEMENT_CALLS:
+            return True
+    return False
+
+
+def _agreement_scopes(tree: ast.AST):
+    """Outermost functions whose subtree (nested defs included) performs
+    an agreement collective; inner defs are checked as part of the outer
+    scope, not re-yielded."""
+    claimed = set()
+    for fn, qual, _cls in iter_functions(tree):
+        if id(fn) in claimed:
+            continue
+        if _subtree_has_agreement(fn):
+            yield fn, qual
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    claimed.add(id(sub))
+
+
+def run(modules: List[Module]) -> CheckerResult:
+    findings: List[Finding] = []
+    for module in modules:
+        seen = set()
+        for fn, qual in _agreement_scopes(module.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if not body_contains_any_call(node.body):
+                    continue  # nothing fallible enough to leak
+                handler_names = [handler_type_names(h)
+                                 for h in node.handlers]
+                broad_at = [i for i, names in enumerate(handler_names)
+                            if not names  # bare except
+                            or any(last_segment(n) in BROAD for n in names)]
+                for i, handler in enumerate(node.handlers):
+                    names = handler_names[i]
+                    if i in broad_at:
+                        continue  # itself the funnel
+                    if broad_at:
+                        # A broad sibling means nothing leaks this try:
+                        # after the narrow handler it funnels everything
+                        # the narrow one misses (special-case-then-
+                        # funnel); before it, it catches everything
+                        # FIRST (the narrow handler is dead code, a ruff
+                        # problem — not a strand hazard).
+                        continue
+                    if body_contains_raise(handler.body):
+                        continue  # translator, not a swallow
+                    caught = ", ".join(names)
+                    findings.append(Finding(
+                        checker=CHECKER_ID,
+                        path=module.path,
+                        line=handler.lineno,
+                        col=handler.col_offset,
+                        symbol=qual,
+                        message=(
+                            f"narrow swallowing except ({caught}) on an "
+                            f"agreement path: any exception type outside "
+                            f"this tuple bypasses the funnel and this "
+                            f"host dies alone while peers block in the "
+                            f"timeout-less agreement collective (the "
+                            f"zlib.error strand class)"),
+                        hint=(
+                            "catch Exception — the agreement already "
+                            "reports per-host failure with the detail "
+                            "string — or re-raise inside the handler; if "
+                            "the narrowness is load-bearing, baseline it "
+                            "with a justification"),
+                    ))
+        del seen
+    return CheckerResult(findings=findings)

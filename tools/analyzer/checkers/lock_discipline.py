@@ -1,0 +1,290 @@
+"""Checker: no blocking work under a lock; one global acquisition order.
+
+The serving/checkpoint planes are multi-threaded (engine dispatch +
+completion + reload watcher + pool warmers + async checkpoint writer),
+and two rules kept PR 3/4 honest:
+
+1. **No blocking calls while holding a lock.** The engine's
+   ``swap_params`` deliberately runs ``device_put`` OUTSIDE ``_lock``
+   (the slow part), and the pool dispatches outside its lock; a
+   ``block_until_ready``/``device_put``/file-IO/``queue.get``/``join``/
+   collective under a lock serializes the data plane behind the slowest
+   operation — or deadlocks outright (a collective under a lock the
+   watchdog thread also wants is the no-concurrent-collectives rule's
+   worst case).
+
+2. **Consistent acquisition order.** The per-module lock graph (engine
+   ``_lock``/``_staging_lock``, pool ``_lock``, profiling/compile-cache
+   locks) must be acyclic: if one code path takes A then B and another
+   takes B then A, the interleaving deadlocks. The checker reports the
+   graph (nodes + nesting edges) in ``--format json`` so reviews can see
+   the ordering at a glance.
+
+Condition variables are exempt from rule 1 for their own ``wait``/
+``notify`` — ``with cv: cv.wait()`` IS the pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyzer._ast_util import (
+    call_name,
+    dotted_name,
+    iter_functions,
+    last_segment,
+    walk_in_scope,
+)
+from tools.analyzer.core import CheckerResult, Finding, Module
+
+CHECKER_ID = "lock-discipline"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: attribute-call names that block (matched on the last dotted segment).
+_BLOCKING_ATTR_CALLS = {
+    "block_until_ready": "a device sync",
+    "device_put": "a host-to-device transfer",
+    "urlopen": "network IO",
+    "process_allgather": "a cross-host collective",
+    "allgather_records": "a cross-host collective",
+    "agree": "a cross-host collective",
+    "_agree_phase_ok": "a cross-host collective",
+}
+_BLOCKING_BARE_CALLS = {
+    "open": "file IO",
+    "device_put": "a host-to-device transfer",
+    "allgather_records": "a cross-host collective",
+    "agree": "a cross-host collective",
+}
+_QUEUEISH = ("queue", "q")
+
+
+def _lock_key(owner: str, attr: str) -> str:
+    return f"{owner}.{attr}"
+
+
+def _collect_locks(module: Module) -> Set[str]:
+    """Lock objects: ``self.X = threading.Lock()`` (keyed by class) and
+    module-level ``X = threading.Lock()`` (keyed by module)."""
+    locks: Set[str] = set()
+    for fn, _qual, classname in iter_functions(module.tree):
+        for node in walk_in_scope(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and last_segment(call_name(node.value)) in _LOCK_CTORS):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self" and classname:
+                    locks.add(_lock_key(classname, target.attr))
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                last_segment(call_name(node.value)) in _LOCK_CTORS:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    locks.add(_lock_key("<module>", target.id))
+    return locks
+
+
+def _lock_for_expr(expr: ast.AST, classname: Optional[str],
+                   locks: Set[str]) -> Optional[Tuple[str, str]]:
+    """``(lock_key, source_text)`` when ``expr`` names a known lock."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and classname:
+        key = _lock_key(classname, expr.attr)
+        if key in locks:
+            return key, f"self.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        key = _lock_key("<module>", expr.id)
+        if key in locks:
+            return key, expr.id
+    return None
+
+
+def _is_queueish(name: str) -> bool:
+    """Receiver names that plausibly hold a queue.Queue — ``.get``/``.put``
+    are flagged only on these, because dict.get is everywhere."""
+    low = name.lower().lstrip("_")
+    return low in _QUEUEISH or "queue" in low
+
+
+def _blocking_reason(node: ast.Call,
+                     held_exprs: List[str]) -> Optional[str]:
+    name = call_name(node)
+    last = last_segment(name)
+    if isinstance(node.func, ast.Name):
+        # from-imports make every attr-style call a bare name
+        # (``from runtime.supervision import _agree_phase_ok``), so the
+        # bare lookup consults both tables.
+        return _BLOCKING_BARE_CALLS.get(name) \
+            or _BLOCKING_ATTR_CALLS.get(name)
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    receiver = dotted_name(node.func.value)
+    if receiver in held_exprs and last in (
+            "wait", "wait_for", "notify", "notify_all"):
+        return None  # the condition-variable pattern on the held lock
+    if last in _BLOCKING_ATTR_CALLS:
+        return _BLOCKING_ATTR_CALLS[last]
+    if name == "time.sleep":
+        return "a sleep"
+    if last == "join" and receiver is not None:
+        # str.join false-positive guard: thread/process joins take no
+        # positional iterable.
+        if not node.args or "thread" in receiver.lower() \
+                or "proc" in receiver.lower():
+            return "a thread/process join"
+    if last in ("get", "put") and receiver is not None \
+            and _is_queueish(last_segment(receiver)):
+        return "a queue handoff"
+    return None
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """Walk one function, tracking held locks across nested withs."""
+
+    def __init__(self, module, qual, classname, locks, findings, edges):
+        self.module = module
+        self.qual = qual
+        self.classname = classname
+        self.locks = locks
+        self.findings = findings
+        self.edges = edges
+        self.held: List[Tuple[str, str]] = []  # (key, source text)
+
+    def _visit_scope_node(self, node) -> None:
+        pass  # nested defs run later, under whatever locks THEY take
+
+    visit_FunctionDef = _visit_scope_node
+    visit_AsyncFunctionDef = _visit_scope_node
+    visit_Lambda = _visit_scope_node
+    visit_ClassDef = _visit_scope_node
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        # with-items enter left to right: each context expression runs
+        # under only the locks acquired by the items BEFORE it, so visit
+        # the expr first, then (if it names a lock) mark it held.
+        for item in node.items:
+            self.visit(item.context_expr)
+            hit = _lock_for_expr(item.context_expr, self.classname,
+                                 self.locks)
+            if hit:
+                if self.held:
+                    self.edges.append(
+                        (self.held[-1][0], hit[0], self.module.path,
+                         node.lineno, self.qual))
+                self.held.append(hit)
+                acquired.append(hit)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            reason = _blocking_reason(node, [h[1] for h in self.held])
+            if reason:
+                key, text = self.held[-1]
+                self.findings.append(Finding(
+                    checker=CHECKER_ID, path=self.module.path,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=self.qual,
+                    message=(
+                        f"{call_name(node) or 'call'}() — {reason} — "
+                        f"executed while holding {text} ({key}): every "
+                        f"thread contending for the lock now waits on "
+                        f"{reason}, and a collective here can deadlock "
+                        f"against the watchdog (no-concurrent-"
+                        f"collectives rule)"),
+                    hint=("move the blocking work outside the critical "
+                          "section: snapshot state under the lock, "
+                          "operate after release (the engine "
+                          "swap_params idiom)"),
+                ))
+        self.generic_visit(node)
+
+
+def _order_cycles(pairs) -> List[List[str]]:
+    """Elementary cycles in the nesting-order graph, each reported once
+    (deduped on the node set, anchored at its smallest lock). The
+    2-cycle A->B/B->A is the common case, but a 3-lock ring deadlocks
+    just as hard — lock graphs are a handful of nodes, so a plain DFS
+    is plenty."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in pairs:
+        adj.setdefault(a, []).append(b)
+    cycles: List[List[str]] = []
+    seen_sets = set()
+    for start in sorted(adj):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ()), reverse=True):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen_sets and start == min(path):
+                        seen_sets.add(key)
+                        cycles.append(path[:])
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def run(modules: List[Module]) -> CheckerResult:
+    findings: List[Finding] = []
+    report: Dict[str, Dict] = {}
+    for module in modules:
+        locks = _collect_locks(module)
+        if not locks:
+            continue
+        edges: List[Tuple[str, str, str, int, str]] = []
+        # Module-level statements first (init-time ``with _lock:`` in
+        # scripts) — the visitor skips nested defs/classes, which
+        # iter_functions then covers one by one.
+        top = _FnVisitor(module, "<module>", None, locks, findings, edges)
+        for stmt in module.tree.body:
+            top.visit(stmt)
+        for fn, qual, classname in iter_functions(module.tree):
+            visitor = _FnVisitor(module, qual, classname, locks,
+                                 findings, edges)
+            for stmt in fn.body:
+                visitor.visit(stmt)
+        seen_pairs: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for a, b, path, line, qual in edges:
+            seen_pairs.setdefault((a, b), (path, line, qual))
+        for cycle in _order_cycles(seen_pairs):
+            # A 1-node cycle is a nested re-acquisition of the same
+            # lock: the edge list is the single self-edge (A, A).
+            cycle_edges = list(zip(cycle, cycle[1:] + [cycle[0]]))
+            chain = " -> ".join(cycle + [cycle[0]])
+            where = "; ".join(
+                f"{a} -> {b} at "
+                f"{seen_pairs[(a, b)][0]}:{seen_pairs[(a, b)][1]} "
+                f"({seen_pairs[(a, b)][2]})"
+                for a, b in cycle_edges)
+            path, line, qual = seen_pairs[cycle_edges[0]]
+            findings.append(Finding(
+                checker=CHECKER_ID, path=path, line=line, col=0,
+                symbol=qual,
+                message=(
+                    f"inconsistent lock order: acquisition cycle "
+                    f"{chain} ({where}); some interleaving of these "
+                    f"paths deadlocks"),
+                hint="pick one global order and refactor the "
+                     "minority path(s) to match it",
+            ))
+        report[module.path] = {
+            "locks": sorted(locks),
+            "order_edges": [
+                {"outer": a, "inner": b, "at": f"{path}:{line}"}
+                for (a, b), (path, line, _q) in sorted(seen_pairs.items())
+            ],
+        }
+    return CheckerResult(findings=findings, report={"lock_graph": report})

@@ -1,0 +1,109 @@
+"""Checker: every pytest marker used is registered in pyproject.toml.
+
+The tests/test_markers_registered.py logic as an analyzer checker (the
+old test is now a thin wrapper over this module): an unregistered marker
+silently breaks ``-m`` selection — a misspelled ``@pytest.mark.serv``
+test runs in the default profile AND is invisible to the marker-filtered
+profiles, with nothing but a scrolling warning to show for it.
+
+For each analyzed file that uses ``pytest.mark.<name>``, the governing
+``pyproject.toml`` is the nearest one up the directory tree from that
+file; files with no pyproject above them are skipped (fixture snippets
+pass an explicit registry instead, via ``check_usage``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyzer.core import (
+    CheckerResult,
+    Finding,
+    Module,
+    find_repo_root,
+)
+
+CHECKER_ID = "marker-registry"
+
+#: Markers pytest itself defines; everything else must be declared.
+BUILTIN_MARKERS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                   "filterwarnings", "tryfirst", "trylast"}
+
+
+def registered_markers(pyproject_text: str) -> Set[str]:
+    """Parse ``[tool.pytest.ini_options] markers`` without tomllib
+    (python 3.10): quoted "name: description" strings in the list."""
+    section = re.search(r"markers\s*=\s*\[(.*?)\]", pyproject_text, re.S)
+    if not section:
+        return set()
+    # "name: description", "name(args): description", or a bare "name" —
+    # pytest accepts a description-less registration.
+    return set(re.findall(r'"\s*([A-Za-z_]\w*)\s*(?:[:(][^"]*)?"',
+                          section.group(1)))
+
+
+def used_markers(module: Module) -> List[Tuple[str, int, int]]:
+    """``pytest.mark.<name>`` attribute uses: (name, line, col)."""
+    out = []
+    for node in ast.walk(module.tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "mark"
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "pytest"):
+            out.append((node.attr, node.lineno, node.col_offset))
+    return out
+
+
+def _governing_pyproject(path: str,
+                         root_cache: Optional[Dict] = None) -> Optional[str]:
+    root = find_repo_root(path, root_cache)
+    return os.path.join(root, "pyproject.toml") if root else None
+
+
+def check_usage(module: Module, registered: Set[str]) -> List[Finding]:
+    findings = []
+    for name, line, col in used_markers(module):
+        if name in BUILTIN_MARKERS or name in registered:
+            continue
+        findings.append(Finding(
+            checker=CHECKER_ID, path=module.path, line=line, col=col,
+            symbol=name,
+            message=(
+                f"pytest marker {name!r} is not registered in "
+                f"[tool.pytest.ini_options] markers: -m selection "
+                f"silently mismatches and the test drifts between "
+                f"profiles"),
+            hint="register it in pyproject.toml markers "
+                 "(\"name: description\") or fix the spelling",
+        ))
+    return findings
+
+
+def run(modules: List[Module]) -> CheckerResult:
+    findings: List[Finding] = []
+    cache: Dict[str, Set[str]] = {}
+    root_cache: Dict[str, Optional[str]] = {}
+    n_uses = 0
+    for module in modules:
+        uses = used_markers(module)
+        if not uses:
+            continue
+        n_uses += len(uses)
+        if not module.abspath:
+            continue  # in-memory snippet: no governing config
+        pyproject = _governing_pyproject(module.abspath, root_cache)
+        if pyproject is None:
+            continue  # no governing config: fixture context
+        if pyproject not in cache:
+            try:
+                with open(pyproject, encoding="utf-8") as f:
+                    cache[pyproject] = registered_markers(f.read())
+            except OSError:
+                cache[pyproject] = set()
+        findings.extend(check_usage(module, cache[pyproject]))
+    return CheckerResult(findings=findings,
+                         report={"marker_uses": n_uses})

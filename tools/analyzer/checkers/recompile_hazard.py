@@ -1,0 +1,199 @@
+"""Checker: AOT executables get arrays, jit wrappers declare their statics.
+
+Two recompile/arg-mismatch hazards the compile subsystem (PR 1) and the
+serving engine (PR 3/4) turned into asserted invariants:
+
+1. **Raw scalars into compiled executables.** A ``.lower().compile()``
+   / ``precompile(...)`` product is an ``XlaExecutable`` with a FIXED
+   argument spec. Passing a raw Python scalar where the spec holds an
+   array either raises an argument-mismatch at serve time or — through a
+   jit fallback wrapper — silently keys a fresh compile. Call sites of
+   names bound to compiled executables must pass arrays (or variables),
+   never bare numeric literals.
+
+2. **jit without static declarations.** ``jax.jit(fn)`` where ``fn``
+   takes hashable config parameters (bool/str defaults — flags like
+   ``interpret=False``) traces those as array arguments; each distinct
+   value then either fails hashing or recompiles per call. The jit site
+   must declare them via ``static_argnums``/``static_argnames`` (the
+   ops/pallas/adam.py idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.analyzer._ast_util import (
+    call_name,
+    defs_by_name,
+    dotted_name,
+    iter_functions,
+    last_segment,
+    walk_in_scope,
+)
+from tools.analyzer.core import CheckerResult, Finding, Module
+
+CHECKER_ID = "recompile-hazard"
+
+
+def _is_compiled_producer(value: ast.AST) -> bool:
+    """True for ``precompile(...)`` and ``<x>.lower(...).compile()``."""
+    if not isinstance(value, ast.Call):
+        return False
+    if last_segment(call_name(value)) == "precompile":
+        return True
+    if isinstance(value.func, ast.Attribute) and \
+            value.func.attr == "compile":
+        inner = value.func.value
+        if isinstance(inner, ast.Call) and \
+                isinstance(inner.func, ast.Attribute) and \
+                inner.func.attr == "lower":
+            return True
+    return False
+
+
+def _scalar_positions(call: ast.Call) -> List[int]:
+    hits = []
+    for i, arg in enumerate(call.args):
+        node = arg
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, (ast.USub, ast.UAdd)):
+            node = node.operand
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, (int, float)) and \
+                not isinstance(node.value, bool):
+            hits.append(i)
+    return hits
+
+
+def _check_compiled_calls(module: Module, findings: List[Finding]) -> None:
+    """Rule 1, per scope: names (and self-attributes) assigned a compiled
+    executable, then called with numeric literals."""
+    scopes = [(module.tree, "<module>")] + [
+        (fn, qual) for fn, qual, _cls in iter_functions(module.tree)]
+    # self-attribute assignments are visible across a class's methods.
+    attr_names: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and _is_compiled_producer(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    attr_names.add(target.attr)
+    for scope, qual in scopes:
+        local: Set[str] = set()
+        for node in walk_in_scope(scope):
+            if isinstance(node, ast.Assign) and \
+                    _is_compiled_producer(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        local.add(target.id)
+        for node in walk_in_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            is_exec = (
+                isinstance(node.func, ast.Name) and node.func.id in local
+            ) or (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr in attr_names
+            )
+            if not is_exec:
+                continue
+            for pos in _scalar_positions(node):
+                findings.append(Finding(
+                    checker=CHECKER_ID, path=module.path,
+                    line=node.lineno, col=node.col_offset, symbol=qual,
+                    message=(
+                        f"raw Python scalar at argument {pos} of an "
+                        f"AOT-compiled executable call: the compiled "
+                        f"program's spec holds committed arrays, so "
+                        f"this either fails the argument check or "
+                        f"re-keys a compile through a fallback wrapper"),
+                    hint=("wrap the literal (jnp.asarray/np.asarray) "
+                          "with the dtype the spec was lowered with"),
+                ))
+
+
+def _jit_call_static_kwargs(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords)
+
+
+def _config_defaults(fn: ast.AST) -> List[str]:
+    """Parameters whose default is a bool/str constant — hashable config
+    the jit site must declare static."""
+    args = fn.args
+    named = args.posonlyargs + args.args
+    out: List[str] = []
+    for param, default in zip(named[len(named) - len(args.defaults):],
+                              args.defaults):
+        if isinstance(default, ast.Constant) and \
+                isinstance(default.value, (bool, str)):
+            out.append(param.arg)
+    for param, default in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(default, ast.Constant) and \
+                isinstance(default.value, (bool, str)):
+            out.append(param.arg)
+    return out
+
+
+def _check_jit_statics(module: Module, findings: List[Finding]) -> None:
+    defs = defs_by_name(module.tree)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if last_segment(call_name(node)) != "jit":
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue  # partials / attributes: bindings untrackable
+        if _jit_call_static_kwargs(node):
+            continue
+        for fn in defs.get(node.args[0].id, []):
+            config = _config_defaults(fn)
+            if config:
+                findings.append(Finding(
+                    checker=CHECKER_ID, path=module.path,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=node.args[0].id,
+                    message=(
+                        f"jit({node.args[0].id}) without "
+                        f"static_argnums/static_argnames, but "
+                        f"{node.args[0].id}() takes hashable config "
+                        f"parameter(s) {config}: each distinct value "
+                        f"traces as an array arg and recompiles (or "
+                        f"fails hashing) per call"),
+                    hint=("declare them static at the jit site, or bind "
+                          "them with functools.partial before jitting "
+                          "(the train/steps.py idiom)"),
+                ))
+                break
+    # Decorator form: @jit directly on a def with config defaults.
+    for fn, qual, _cls in iter_functions(module.tree):
+        for dec in fn.decorator_list:
+            if not (isinstance(dec, (ast.Name, ast.Attribute))
+                    and last_segment(dotted_name(dec)) == "jit"):
+                continue
+            config = _config_defaults(fn)
+            if config:
+                findings.append(Finding(
+                    checker=CHECKER_ID, path=module.path,
+                    line=dec.lineno, col=dec.col_offset, symbol=qual,
+                    message=(
+                        f"@jit on {fn.name}() which takes hashable "
+                        f"config parameter(s) {config} with no static "
+                        f"declaration: per-value retrace/recompile"),
+                    hint=("use @functools.partial(jax.jit, "
+                          "static_argnames=(...)) — the "
+                          "ops/pallas/adam.py idiom"),
+                ))
+
+
+def run(modules: List[Module]) -> CheckerResult:
+    findings: List[Finding] = []
+    for module in modules:
+        _check_compiled_calls(module, findings)
+        _check_jit_statics(module, findings)
+    return CheckerResult(findings=findings)

@@ -1,0 +1,130 @@
+"""Checker: fault-injection hooks, registry, and docs cannot drift.
+
+``runtime/supervision.py`` keeps the authoritative ``FAULT_POINTS``
+registry; ``maybe_fault("<point>")`` call sites are the hooks;
+``tools/chaos.py --list`` renders the registry verbatim. The invariant
+(previously a point test in tests/test_supervision.py, now a thin
+wrapper over this checker): every hook uses a registered literal, and
+every registered point has a live hook — a registry entry whose hook was
+deleted advertises an injection the chaos harness can no longer perform,
+and an unregistered hook would fail ``maybe_fault``'s runtime assert on
+first fire (i.e. in production, not in review).
+
+The checker is cross-file: it only reports drift when the registry
+module (the one assigning ``FAULT_POINTS``) is part of the analyzed set,
+so analyzing a lone file never yields spurious "unreachable point"
+noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.analyzer._ast_util import call_name, last_segment
+from tools.analyzer.core import CheckerResult, Finding, Module
+
+CHECKER_ID = "registry-drift"
+
+REGISTRY_NAME = "FAULT_POINTS"
+HOOK_NAME = "maybe_fault"
+
+
+def registry_entries(modules: List[Module]) \
+        -> Optional[Tuple[Module, Dict[str, int]]]:
+    """The module assigning ``FAULT_POINTS`` and its ``{key: line}`` map.
+    Public: the chaos-list wrapper test reuses this exact parse."""
+    for module in modules:
+        for node in module.tree.body:
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+                value = node.value
+            else:
+                continue
+            if not (isinstance(target, ast.Name)
+                    and target.id == REGISTRY_NAME
+                    and isinstance(value, ast.Dict)):
+                continue
+            keys: Dict[str, int] = {}
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and \
+                        isinstance(key.value, str):
+                    keys[key.value] = key.lineno
+            return module, keys
+    return None
+
+
+def hook_sites(modules: List[Module]) \
+        -> Tuple[List[Tuple[Module, ast.Call, str]],
+                 List[Tuple[Module, ast.Call]]]:
+    """``maybe_fault`` call sites: (literal sites, non-literal sites).
+    The defining module's internal uses (the ``assert point in ...``
+    body) are naturally excluded — it calls nothing named maybe_fault."""
+    literal, dynamic = [], []
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_segment(call_name(node)) != HOOK_NAME:
+                continue
+            if len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                literal.append((module, node, node.args[0].value))
+            else:
+                dynamic.append((module, node))
+    return literal, dynamic
+
+
+def run(modules: List[Module]) -> CheckerResult:
+    findings: List[Finding] = []
+    registry = registry_entries(modules)
+    literal, dynamic = hook_sites(modules)
+    for module, node in dynamic:
+        findings.append(Finding(
+            checker=CHECKER_ID, path=module.path, line=node.lineno,
+            col=node.col_offset, symbol=HOOK_NAME,
+            message=("maybe_fault() must take a single string literal "
+                     "from FAULT_POINTS: a computed point name defeats "
+                     "the static registry<->hook drift gate"),
+            hint="inline the literal; one hook per fault point",
+        ))
+    if registry is None:
+        return CheckerResult(
+            findings=findings,
+            report={"fault_points": None, "hook_sites": len(literal)})
+    reg_module, keys = registry
+    for module, node, point in literal:
+        if point not in keys:
+            findings.append(Finding(
+                checker=CHECKER_ID, path=module.path, line=node.lineno,
+                col=node.col_offset, symbol=HOOK_NAME,
+                message=(
+                    f"maybe_fault({point!r}) is not in FAULT_POINTS "
+                    f"({reg_module.path}): the hook would fail its "
+                    f"runtime assert on first fire, and chaos --list "
+                    f"cannot advertise it"),
+                hint="register the point (name -> where it fires) in "
+                     "runtime/supervision.py FAULT_POINTS",
+            ))
+    called = {point for _m, _n, point in literal}
+    for point, line in sorted(keys.items()):
+        if point not in called:
+            findings.append(Finding(
+                checker=CHECKER_ID, path=reg_module.path, line=line,
+                col=0, symbol=REGISTRY_NAME,
+                message=(
+                    f"FAULT_POINTS entry {point!r} has no "
+                    f"maybe_fault({point!r}) hook anywhere in the "
+                    f"analyzed tree: chaos --list advertises an "
+                    f"injection that can never fire"),
+                hint="delete the registry entry or restore the hook at "
+                     "the documented site",
+            ))
+    return CheckerResult(
+        findings=findings,
+        report={"fault_points": sorted(keys), "hook_sites": len(literal)})

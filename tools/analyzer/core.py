@@ -1,0 +1,431 @@
+"""tpumnist-lint core: file collection, checker dispatch, baseline, output.
+
+The analyzer is a *codebase-specific* static pass: each checker encodes an
+invariant PRs 1-4 established the hard way (docs/DESIGN.md §8 maps each
+one to the incident it came from). It is pure stdlib (``ast``) — it never
+imports the code under analysis, so it runs in milliseconds with no jax
+backend and can gate tier-1.
+
+Baseline contract: ``baseline.json`` is a list of triaged-accepted
+findings. Every entry MUST carry a non-empty ``justification`` (an entry
+without one is a config error, not a suppression), and every entry must
+suppress at least one current finding — a stale entry (the code it
+excused is gone) fails the run, so the baseline can only shrink or be
+consciously re-justified, never silently rot. Staleness is judged only
+when the entry's file is part of the analyzed set (or is gone from disk
+entirely): linting a single file must not condemn entries for files the
+run never looked at.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+#: Checker ids whose findings a baseline entry may suppress. Parse errors
+#: are never baselinable: an unparseable file means the analyzer saw
+#: nothing, which must stay loud.
+_UNBASELINABLE = {"parse-error", "usage"}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer hit: where, which invariant, what to do about it."""
+
+    checker: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    symbol: str = ""  # enclosing function/class — stable baseline anchor
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        text = f"{self.path}:{self.line}:{self.col}: {self.checker}{sym}: " \
+               f"{self.message}"
+        if self.hint:
+            text += f"\n    hint: {self.hint}"
+        return text
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file handed to every checker."""
+
+    path: str  # repo-relative (posix) when a repo root is found
+    tree: ast.Module
+    source: str
+    abspath: str = ""  # "" for in-memory snippets
+
+
+@dataclasses.dataclass
+class CheckerResult:
+    findings: List[Finding]
+    report: Optional[Dict] = None
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]            # NOT suppressed by the baseline
+    suppressed: List[Tuple[Finding, Dict]]
+    stale_baseline: List[Dict]         # entries that suppressed nothing
+    baseline_problems: List[str]       # malformed entries / unreadable file
+    reports: Dict[str, Dict]           # checker id -> structured report
+    n_files: int = 0
+    checkers: Tuple[str, ...] = ()
+    paths: Tuple[str, ...] = ()
+
+    @property
+    def ok(self) -> bool:
+        return not (self.findings or self.stale_baseline
+                    or self.baseline_problems)
+
+    def to_dict(self) -> Dict:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "paths": list(self.paths),
+            "checkers": list(self.checkers),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "justification": e.get("justification", "")}
+                for f, e in self.suppressed
+            ],
+            "stale_baseline": list(self.stale_baseline),
+            "baseline_problems": list(self.baseline_problems),
+            "reports": self.reports,
+            "summary": {
+                "files": self.n_files,
+                "findings": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "stale_baseline": len(self.stale_baseline),
+                "ok": self.ok,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# File collection
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".xla_cache", "node_modules"}
+#: Repo-relative directories to skip. Matching these by bare name (the way
+#: _SKIP_DIRS works) would silently drop any same-named SOURCE directory
+#: added anywhere in the tree while the gate still reports OK — so they
+#: are rooted, like the ruff exclude.
+_SKIP_ROOTED = {"tools/captured"}
+
+
+def _skip_dir(dirpath: str, name: str, root_cache: Dict) -> bool:
+    if name in _SKIP_DIRS:
+        return True
+    root = find_repo_root(dirpath, root_cache)
+    if root is None:
+        return False
+    rel = os.path.relpath(os.path.join(os.path.abspath(dirpath), name),
+                          root).replace(os.sep, "/")
+    return rel in _SKIP_ROOTED
+
+
+def collect_files(paths: Sequence[str]) -> Tuple[List[str], List[Finding]]:
+    """Expand files/directories into a sorted, de-duplicated .py list."""
+    files: List[str] = []
+    problems: List[Finding] = []
+    root_cache: Dict[str, Optional[str]] = {}
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, names in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if not _skip_dir(dirpath, d, root_cache))
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        files.append(os.path.join(dirpath, name))
+        else:
+            problems.append(Finding(
+                checker="usage", path=p, line=0, col=0,
+                message=f"path does not exist: {p!r}"))
+    seen, unique = set(), []
+    for f in files:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique, problems
+
+
+def find_repo_root(start: str,
+                   _cache: Optional[Dict[str, Optional[str]]] = None,
+                   ) -> Optional[str]:
+    """Nearest ancestor holding a pyproject.toml — the path-normalization
+    anchor (baseline paths stay stable whatever cwd invoked the tool).
+
+    ``_cache`` (a per-RUN dict keyed by start directory) elides the
+    repeated upward isfile walks when many analyzed files share a tree.
+    It is never module-global: a run must see the filesystem as it is,
+    not as a previous test's tmpdir left it.
+    """
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    if _cache is not None and cur in _cache:
+        return _cache[cur]
+    start_dir = cur
+    root: Optional[str] = None
+    while True:
+        if os.path.isfile(os.path.join(cur, "pyproject.toml")):
+            root = cur
+            break
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            break
+        cur = parent
+    if _cache is not None:
+        _cache[start_dir] = root
+    return root
+
+
+def _normalize(path: str,
+               root_cache: Optional[Dict[str, Optional[str]]] = None) -> str:
+    root = find_repo_root(path, root_cache)
+    ap = os.path.abspath(path)
+    if root and (ap == root or ap.startswith(root + os.sep)):
+        return os.path.relpath(ap, root).replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def parse_modules(files: Sequence[str]) \
+        -> Tuple[List[Module], List[Finding]]:
+    modules: List[Module] = []
+    problems: List[Finding] = []
+    root_cache: Dict[str, Optional[str]] = {}
+    for path in files:
+        norm = _normalize(path, root_cache)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError, ValueError) as exc:
+            problems.append(Finding(
+                checker="parse-error", path=norm,
+                line=getattr(exc, "lineno", 0) or 0, col=0,
+                message=f"could not parse: {exc}"))
+            continue
+        modules.append(Module(path=norm, tree=tree, source=source,
+                              abspath=os.path.abspath(path)))
+    return modules, problems
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+_ENTRY_KEYS = {"checker", "path", "contains", "justification"}
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: Optional[str]) -> Tuple[List[Dict], List[str]]:
+    """Read + validate a baseline file; returns ``(entries, problems)``.
+    A missing default baseline is an empty baseline; a missing *explicit*
+    baseline is a problem."""
+    if path is None:
+        return [], []
+    if not os.path.isfile(path):
+        return [], [f"baseline file not found: {path!r}"]
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [], [f"baseline {path!r} unreadable: {exc}"]
+    if not isinstance(raw, list):
+        return [], [f"baseline {path!r} must be a JSON list of entries"]
+    entries, problems = [], []
+    for i, entry in enumerate(raw):
+        if not isinstance(entry, dict) or \
+                not _ENTRY_KEYS.issubset(entry.keys()):
+            problems.append(
+                f"baseline entry #{i} must be an object with keys "
+                f"{sorted(_ENTRY_KEYS)}: got {entry!r}")
+            continue
+        if not str(entry.get("justification", "")).strip():
+            problems.append(
+                f"baseline entry #{i} ({entry.get('checker')} @ "
+                f"{entry.get('path')}) has no justification — every "
+                f"accepted finding must say WHY it is acceptable")
+            continue
+        if entry.get("checker") in _UNBASELINABLE:
+            problems.append(
+                f"baseline entry #{i}: {entry.get('checker')!r} findings "
+                f"cannot be baselined")
+            continue
+        entries.append(entry)
+    return entries, problems
+
+
+def _entry_matches(entry: Dict, finding: Finding) -> bool:
+    if entry["checker"] != finding.checker:
+        return False
+    if entry["path"] != finding.path:
+        return False
+    needle = str(entry["contains"])
+    return needle in finding.message or needle == finding.symbol
+
+
+def apply_baseline(findings: List[Finding], entries: List[Dict],
+                   analyzed_paths: Optional[Sequence[str]] = None,
+                   file_exists=None):
+    """Split findings into (kept, suppressed) and report stale entries.
+
+    An unused entry is stale only when this run actually judged it: its
+    file was part of ``analyzed_paths`` (normalized; ``None`` means
+    everything was), or ``file_exists`` says the file is gone entirely
+    (a deleted file never re-enters the analyzed set, and its entries
+    must not rot silently). Linting a path subset must not condemn
+    entries for files the run never looked at.
+    """
+    kept: List[Finding] = []
+    suppressed: List[Tuple[Finding, Dict]] = []
+    used = [False] * len(entries)
+    for f in findings:
+        hit = None
+        if f.checker not in _UNBASELINABLE:
+            for i, entry in enumerate(entries):
+                if _entry_matches(entry, f):
+                    hit = (i, entry)
+                    break
+        if hit is None:
+            kept.append(f)
+        else:
+            used[hit[0]] = True
+            suppressed.append((f, hit[1]))
+    judged = set(analyzed_paths) if analyzed_paths is not None else None
+    stale = [entry for i, entry in enumerate(entries)
+             if not used[i]
+             and (judged is None or entry.get("path") in judged
+                  or (file_exists is not None
+                      and not file_exists(str(entry.get("path")))))]
+    return kept, suppressed, stale
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+
+
+def checker_registry() -> Dict[str, object]:
+    """Ordered ``{checker_id: module}``; import deferred so ``core`` has
+    no import cycle with the checker package."""
+    from tools.analyzer import checkers
+
+    return checkers.REGISTRY
+
+
+def run_analysis(
+    paths: Sequence[str],
+    checkers: Optional[Sequence[str]] = None,
+    baseline: Optional[str] = "default",
+) -> AnalysisResult:
+    """Analyze ``paths`` with the selected checkers.
+
+    ``baseline``: a file path, ``"default"`` (the checked-in
+    ``tools/analyzer/baseline.json``), or ``None`` (no suppression).
+    """
+    registry = checker_registry()
+    ids = list(checkers) if checkers is not None else list(registry)
+    unknown = [c for c in ids if c not in registry]
+    if unknown:
+        raise ValueError(
+            f"unknown checker(s) {unknown}; available: {list(registry)}")
+
+    files, problems = collect_files(paths)
+    modules, parse_problems = parse_modules(files)
+    findings: List[Finding] = list(problems) + list(parse_problems)
+    reports: Dict[str, Dict] = {}
+    for cid in ids:
+        result: CheckerResult = registry[cid].run(modules)
+        findings.extend(result.findings)
+        if result.report is not None:
+            reports[cid] = result.report
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+
+    if baseline == "default":
+        bl_path: Optional[str] = default_baseline_path()
+        if not os.path.isfile(bl_path):
+            bl_path = None
+    else:
+        bl_path = baseline
+    entries, bl_problems = load_baseline(bl_path)
+
+    def _entry_file_exists(path: str) -> bool:
+        # Entry paths are repo-relative (or whatever the run passed in):
+        # resolve against cwd, then against the baseline file's repo.
+        if os.path.isfile(path):
+            return True
+        root = find_repo_root(bl_path) if bl_path else None
+        return bool(root) and os.path.isfile(os.path.join(root, path))
+
+    kept, suppressed, stale = apply_baseline(
+        findings, entries, analyzed_paths=[m.path for m in modules],
+        file_exists=_entry_file_exists)
+
+    return AnalysisResult(
+        findings=kept, suppressed=suppressed, stale_baseline=stale,
+        baseline_problems=bl_problems, reports=reports,
+        n_files=len(modules), checkers=tuple(ids), paths=tuple(paths),
+    )
+
+
+def analyze_snippet(
+    source: str,
+    checkers: Optional[Sequence[str]] = None,
+    filename: str = "snippet.py",
+) -> List[Finding]:
+    """Run checkers over one in-memory source string (the fixture-test
+    entry point). No baseline, no filesystem."""
+    registry = checker_registry()
+    ids = list(checkers) if checkers is not None else list(registry)
+    unknown = [c for c in ids if c not in registry]
+    if unknown:
+        raise ValueError(
+            f"unknown checker(s) {unknown}; available: {list(registry)}")
+    tree = ast.parse(source, filename=filename)
+    module = Module(path=filename, tree=tree, source=source)
+    findings: List[Finding] = []
+    for cid in ids:
+        findings.extend(registry[cid].run([module]).findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+    return findings
+
+
+def render_text(result: AnalysisResult) -> str:
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f.render())
+    for entry in result.stale_baseline:
+        lines.append(
+            f"stale baseline entry: {entry['checker']} @ {entry['path']} "
+            f"(contains {entry['contains']!r}) no longer matches anything "
+            f"— delete it (the code it excused is gone)")
+    for problem in result.baseline_problems:
+        lines.append(f"baseline problem: {problem}")
+    s = result.to_dict()["summary"]
+    lines.append(
+        f"tpumnist-lint: {s['files']} files, {s['findings']} finding(s), "
+        f"{s['suppressed']} baselined, {s['stale_baseline']} stale "
+        f"baseline entr{'y' if s['stale_baseline'] == 1 else 'ies'} -> "
+        f"{'OK' if result.ok else 'FAIL'}")
+    return "\n".join(lines)
