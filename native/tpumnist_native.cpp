@@ -110,20 +110,25 @@ void tm_free(void* p) { free(p); }
 struct NormCtx {
   const uint8_t* in;
   float* out;
-  float scale;   // 1 / (255 * std)
-  float offset;  // -mean / std
+  float mean;
+  float stddev;
 };
 
-// out[i] = (in[i]/255 - mean) / std, multithreaded.
+// out[i] = (in[i]/255 - mean) / std, multithreaded. The div/sub/div
+// sequence is deliberately the NumPy fallback's float32 op sequence
+// (``x/255.0 - MEAN) / STD`` in data/mnist.py) rather than a fused
+// scale*x+offset: identical rounding at every step makes the native
+// path BITWISE-equal to the fallback, so which engine ran can never
+// show up in a trajectory (pinned by tests/test_native.py).
 int tm_normalize(const uint8_t* in, float* out, int64_t n, float mean,
                  float stddev, int workers) {
-  NormCtx ctx{in, out, 1.0f / (255.0f * stddev), -mean / stddev};
+  NormCtx ctx{in, out, mean, stddev};
   parallel_for(
       n, workers,
       [](int64_t start, int64_t end, void* p) {
         auto* c = static_cast<NormCtx*>(p);
         for (int64_t i = start; i < end; ++i)
-          c->out[i] = float(c->in[i]) * c->scale + c->offset;
+          c->out[i] = (float(c->in[i]) / 255.0f - c->mean) / c->stddev;
       },
       &ctx);
   return 0;
@@ -173,6 +178,60 @@ int tm_gather(const float* images, const int32_t* labels, const int64_t* indices
   return ctx.oob.load(std::memory_order_relaxed) ? -1 : 0;
 }
 
-int tm_version() { return 2; }
+struct PadCtx {
+  const float* src;     // (rows, row) contiguous
+  float* dst;           // (bucket_rows, row) contiguous
+  int64_t rows;         // real rows to copy
+  int64_t row;          // elements per row
+};
+
+// Serve-dispatch staging: dst[0:rows] = src, dst[rows:bucket_rows] = 0,
+// multithreaded over the BUCKET rows. This is the pad-into-staging-buffer
+// copy the inference engine runs per dispatched batch (serve/engine.py);
+// the zero-fill of the tail matches the NumPy fallback bit-for-bit (both
+// are all-zero float32 rows).
+int tm_pad_copy(const float* src, int64_t rows, int64_t row, float* dst,
+                int64_t bucket_rows, int workers) {
+  if (rows < 0 || rows > bucket_rows || row < 0) return -1;
+  PadCtx ctx{src, dst, rows, row};
+  parallel_for(
+      bucket_rows, workers,
+      [](int64_t start, int64_t end, void* p) {
+        auto* c = static_cast<PadCtx*>(p);
+        for (int64_t j = start; j < end; ++j) {
+          if (j < c->rows) {
+            memcpy(c->dst + j * c->row, c->src + j * c->row,
+                   c->row * sizeof(float));
+          } else {
+            memset(c->dst + j * c->row, 0, c->row * sizeof(float));
+          }
+        }
+      },
+      &ctx);
+  return 0;
+}
+
+struct CastCtx {
+  const double* in;
+  float* out;
+};
+
+// float64 -> float32, multithreaded. A C double->float conversion rounds
+// to nearest even, exactly what NumPy's astype(float32) does, so the
+// fallback equivalence is bitwise.
+int tm_cast_f32(const double* in, float* out, int64_t n, int workers) {
+  CastCtx ctx{in, out};
+  parallel_for(
+      n, workers,
+      [](int64_t start, int64_t end, void* p) {
+        auto* c = static_cast<CastCtx*>(p);
+        for (int64_t i = start; i < end; ++i)
+          c->out[i] = static_cast<float>(c->in[i]);
+      },
+      &ctx);
+  return 0;
+}
+
+int tm_version() { return 3; }
 
 }  // extern "C"

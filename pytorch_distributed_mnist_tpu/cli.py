@@ -81,6 +81,7 @@ from pytorch_distributed_mnist_tpu.utils.profiling import (
     failure_events,
     phase,
     profile_trace,
+    staging_log,
 )
 
 
@@ -250,6 +251,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "memory)")
     p.add_argument("--trainer-mode", type=str, default="scan",
                    choices=["scan", "stepwise", "explicit"])
+    p.add_argument("--feed-window", type=int, default=2,
+                   help="per-batch input-plane depth for stepwise/explicit "
+                        "modes: W counts the batch the step consumes "
+                        "plus at most W-1 staged (host gather + sharded "
+                        "device_put) beyond it. 2 (default) is classic "
+                        "double buffering — batch N+1 stages on a feeder "
+                        "thread while the jitted step for batch N "
+                        "executes; 1 disables the feeder (staging inline "
+                        "on the main thread, the strict alternation the "
+                        "per-batch modes always had, bit-identical "
+                        "trajectories). Multi-host worlds always run the "
+                        "inline path (no cross-host array assembly off "
+                        "the main thread). Scan mode ignores this: its "
+                        "epoch prefetch already carries host gather + H2D")
     p.add_argument("--epoch-gather", type=str, default="host",
                    choices=["host", "device"],
                    help="scan-mode batch staging: 'host' gathers each "
@@ -1303,12 +1318,15 @@ def _run_body(args, epoch_callback=None) -> dict:
     trainer = Trainer(state, train_loader, test_loader, mesh=mesh,
                       mode=args.trainer_mode, state_sharding=state_sharding,
                       grad_accum=grad_accum, epoch_gather=epoch_gather,
-                      aux_weight=aux_weight)
+                      aux_weight=aux_weight,
+                      feed_window=getattr(args, "feed_window", 2),
+                      staging_log=staging_log)
     lr_of = step_decay_schedule(args.lr)
 
-    # Per-run compile accounting (surfaced in the summary/logs below);
-    # reset here so a re-entrant run() reports its own compiles only.
+    # Per-run compile/staging accounting (surfaced in the summary/logs
+    # below); reset here so a re-entrant run() reports its own run only.
     compile_log.reset()
+    staging_log.reset()
     if not args.evaluate and not getattr(args, "no_precompile", False):
         # AOT-compile every program this run will execute on background
         # threads, overlapping the first epoch's host staging below —
@@ -1338,15 +1356,21 @@ def _run_body(args, epoch_callback=None) -> dict:
         )
 
         saver = AsyncCheckpointer()
-    from contextlib import nullcontext
+    from contextlib import closing, nullcontext
 
     # The saver as context manager: a clean exit waits for the last write
     # (and surfaces any stashed write error); an exception still joins the
     # in-flight thread so an already-snapshotted checkpoint lands on disk
     # instead of dying with the daemon thread at interpreter exit.
+    # closing(trainer) joins the in-flight epoch prefetch on EVERY exit
+    # path — early break, eval/checkpoint exception, KeyboardInterrupt —
+    # not just the clean one: that stage now carries a full-epoch
+    # device_put, and a daemon thread mid-device_put racing interpreter
+    # teardown is a crash. Listed last so it exits FIRST (before the
+    # saver drains its write).
     with profile_trace(args.profile_dir), (
         saver if saver is not None else nullcontext()
-    ):
+    ), closing(trainer):
         for epoch in range(start_epoch, args.epochs):
             train_loader.set_sample_epoch(epoch)  # per-epoch reshuffle (:231)
             # No epoch follows the last one: don't stage a gather nothing
@@ -1410,6 +1434,16 @@ def _run_body(args, epoch_callback=None) -> dict:
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
          f"({timer.images_per_sec_per_chip:,.0f}/chip), best acc: {best_acc * 100:.2f}%")
+    staging = staging_log.summary()
+    if staging["stages"]:
+        # The input-plane story in one line: what feeding the chip cost
+        # and how much of it the pipeline hid behind compute.
+        log0(f"input plane: {staging['feed_images_per_sec']:,.0f} "
+             f"feed images/sec (host {staging['host_ms']:.0f} ms + H2D "
+             f"{staging['h2d_ms']:.0f} ms over {staging['stages']} "
+             f"stages, {staging['pipelined_stages']} pipelined), "
+             f"consumer blocked {staging['consumer_wait_ms']:.0f} ms, "
+             f"overlap {staging['overlap_fraction']:.0%}")
     compile_stats = compile_log.stats()
     for prog, rec in compile_stats["programs"].items():
         hit = rec["persistent_cache_hit"]
@@ -1425,6 +1459,7 @@ def _run_body(args, epoch_callback=None) -> dict:
         log0(f"supervision[{ev['kind']}]: {ev['detail']}")
     return {"best_acc": best_acc, "history": history,
             "compile_stats": compile_stats,
+            "input_pipeline": staging,
             "failure_events": events,
             "images_per_sec": ips,
             "images_per_sec_per_chip": timer.images_per_sec_per_chip,

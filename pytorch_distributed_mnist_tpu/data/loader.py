@@ -108,10 +108,16 @@ class MNISTDataLoader:
         shape = (steps, self.local_batch_size)
         return idx[:need].reshape(shape), mask.reshape(shape)
 
+    def host_batch(self, row: np.ndarray, mrow: np.ndarray) -> Dict[str, np.ndarray]:
+        """One batch's host-side rows for an ``epoch_ticks`` row — THE
+        gather both ``__iter__`` and the pipelined feeder
+        (``data/staging.py``) run, so the two paths cannot drift."""
+        return {"image": self.images[row], "label": self.labels[row], "mask": mrow}
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         m, mask = self.epoch_ticks()
         for row, mrow in zip(m, mask):
-            yield {"image": self.images[row], "label": self.labels[row], "mask": mrow}
+            yield self.host_batch(row, mrow)
 
     def __len__(self) -> int:
         return self.steps_per_epoch

@@ -22,7 +22,14 @@ _LIB_NAME = "libtpumnist_native.so"
 
 
 def _find_library() -> Optional[str]:
-    override = os.environ.get("TPU_MNIST_NATIVE_LIB")
+    if os.environ.get("TPUMNIST_NATIVE", "") == "0":
+        # Explicit fallback switch: equivalence tests and the input bench
+        # time the pure-NumPy path in a process that HAS the library.
+        return None
+    # TPUMNIST_ is the house env prefix (compile cache, faults,
+    # timeouts); the historical TPU_MNIST_ spelling keeps working.
+    override = (os.environ.get("TPUMNIST_NATIVE_LIB")
+                or os.environ.get("TPU_MNIST_NATIVE_LIB"))
     candidates = [override] if override else []
     here = os.path.dirname(os.path.abspath(__file__))
     repo_root = os.path.dirname(os.path.dirname(here))
@@ -37,18 +44,26 @@ def _find_library() -> Optional[str]:
 
 
 _lib = None
+#: Negative-cache sentinel: pad_into/cast_f32 run PER DISPATCHED BATCH
+#: on the serve hot path, so a fallback environment must not re-walk
+#: the filesystem probe (env reads + two stat()s) on every batch.
+#: ``_lib = None`` stays the one reset switch (tests and the input
+#: bench's in-process A/B flip rely on it) — it clears this cache too.
+_MISSING = object()
 
 
 def _load():
     global _lib
     if _lib is not None:
-        return _lib
+        return None if _lib is _MISSING else _lib
     path = _find_library()
     if path is None:
+        _lib = _MISSING
         return None
     try:
         lib = ctypes.CDLL(path)
     except OSError:
+        _lib = _MISSING
         return None
     lib.tm_idx_load.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.tm_idx_load.argtypes = [
@@ -71,6 +86,29 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
     ]
     lib.tm_version.restype = ctypes.c_int
+    if lib.tm_version() < 3:
+        # A stale pre-v3 library is rejected WHOLE, not just its missing
+        # symbols: v3 rewrote tm_normalize to the fallback's exact f32 op
+        # sequence, so the old fused kernel is ~1ulp off the bits every
+        # trajectory/equivalence pin now asserts. Stale (pre-v3) ->
+        # fallback, per DESIGN.md 4b's matrix.
+        _lib = _MISSING
+        return None
+    # v3 entry points (serve dispatch path) — guaranteed present past
+    # the version gate above. void-pointer argtypes on purpose: these
+    # two run PER DISPATCHED BATCH on the serve hot path, and
+    # ``ndarray.ctypes.data_as`` costs ~5us per cast while the raw
+    # ``.ctypes.data`` integer is sub-microsecond — at bucket sizes the
+    # cast overhead alone exceeded the copy.
+    lib.tm_pad_copy.restype = ctypes.c_int
+    lib.tm_pad_copy.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+    ]
+    lib.tm_cast_f32.restype = ctypes.c_int
+    lib.tm_cast_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+    ]
     _lib = lib
     return lib
 
@@ -144,3 +182,45 @@ def gather_epoch(
         return None
     shape = index_matrix.shape + images.shape[1:]
     return out_images.reshape(shape), out_labels.reshape(index_matrix.shape)
+
+
+def pad_into(dst: np.ndarray, src: np.ndarray, workers: int = 4) -> bool:
+    """Native serve-dispatch staging fill: ``dst[:len(src)] = src;
+    dst[len(src):] = 0`` in multithreaded C++. Returns False (caller runs
+    the bitwise-identical NumPy fallback) when the library is absent/old
+    or either array is not float32 C-contiguous with matching rows."""
+    lib = _load()
+    if lib is None:  # absent, unloadable, or pre-v3 (rejected whole)
+        return False
+    if dst.dtype != np.float32 or src.dtype != np.float32:
+        return False
+    if not (dst.flags["C_CONTIGUOUS"] and src.flags["C_CONTIGUOUS"]):
+        return False
+    if not dst.flags["WRITEABLE"]:
+        # The C kernel writes through the raw pointer; a frozen dst must
+        # fall back so NumPy's slice-assign raises like it always did.
+        return False
+    if dst.ndim < 1 or src.shape[1:] != dst.shape[1:] \
+            or src.shape[0] > dst.shape[0]:
+        return False
+    row = 1
+    for d in dst.shape[1:]:
+        row *= d
+    rc = lib.tm_pad_copy(src.ctypes.data, src.shape[0], row,
+                         dst.ctypes.data, dst.shape[0], workers)
+    return rc == 0
+
+
+def cast_f32(arr: np.ndarray, workers: int = 4) -> Optional[np.ndarray]:
+    """Native float64 -> float32 (round-to-nearest-even, the same C
+    conversion NumPy's ``astype`` performs — bitwise-identical); None for
+    any other dtype/layout or when the library is absent/old."""
+    lib = _load()
+    if lib is None:  # absent, unloadable, or pre-v3 (rejected whole)
+        return None
+    if arr.dtype != np.float64 or not arr.flags["C_CONTIGUOUS"]:
+        return None
+    out = np.empty(arr.shape, np.float32)
+    rc = lib.tm_cast_f32(arr.ctypes.data, out.ctypes.data,
+                         arr.size, workers)
+    return out if rc == 0 else None

@@ -58,6 +58,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from pytorch_distributed_mnist_tpu.data import native
 from pytorch_distributed_mnist_tpu.data.mnist import normalize_images
 from pytorch_distributed_mnist_tpu.train.steps import (
     abstract_spec,
@@ -119,6 +120,7 @@ class InferenceEngine:
         params_epoch: Optional[int] = None,
         device=None,
         name: Optional[str] = None,
+        workers: int = 4,
     ) -> None:
         buckets = sorted({int(b) for b in buckets})
         if not buckets or buckets[0] < 1:
@@ -126,6 +128,11 @@ class InferenceEngine:
         self.buckets = tuple(buckets)
         self.input_shape = tuple(input_shape)
         self.serve_log = serve_log
+        # Host-side preprocessing thread count (the serve analog of the
+        # training loaders' -j/--workers): normalize, f64->f32 cast, and
+        # the pad-into-staging copy run in multithreaded C++ when the
+        # native library is built, over this many threads.
+        self.workers = workers
         self.device = device
         self.name = name
         self._forward = make_forward_program(apply_fn)
@@ -237,7 +244,12 @@ class InferenceEngine:
         uses. Accepts uint8 ``(N, 28, 28)`` raw images (normalized with
         the SAME ``normalize_images`` the training loaders apply) or
         already-normalized float32 ``(N,) + input_shape`` arrays; a single
-        example may drop its leading axis either way."""
+        example may drop its leading axis either way.
+
+        Zero Python-side array math on the dispatch path when the native
+        library is built: normalize and the f64->f32 cast run in
+        multithreaded C++ over ``self.workers`` threads, with the NumPy
+        expressions as the mandatory bitwise-identical fallback."""
         arr = np.asarray(images)
         if arr.size == 0:
             raise ValueError("at least one image required")
@@ -246,9 +258,12 @@ class InferenceEngine:
             if arr.shape == raw_shape:
                 arr = arr[None]
             if arr.ndim == len(raw_shape) + 1 and arr.shape[1:] == raw_shape:
-                return normalize_images(arr)
+                return normalize_images(arr, workers=self.workers)
         elif np.issubdtype(arr.dtype, np.floating):
-            arr = arr.astype(np.float32, copy=False)
+            cast = native.cast_f32(arr, workers=self.workers) \
+                if arr.dtype == np.float64 else None
+            arr = cast if cast is not None \
+                else arr.astype(np.float32, copy=False)
             if arr.shape == self.input_shape:
                 arr = arr[None]
             if arr.ndim == len(self.input_shape) + 1 \
@@ -301,9 +316,21 @@ class InferenceEngine:
             staged = images
         else:
             buf = self._acquire_staging(bucket)
-            buf[:n] = images
-            if n < bucket:
-                buf[n:] = 0.0  # padded rows are zeros, as they always were
+            # The staging fill (copy + zero the padded tail) runs in
+            # multithreaded C++ when built; the NumPy fallback writes
+            # the identical bytes (padded rows are zeros, as they
+            # always were). Anything not already f32 C-contiguous goes
+            # straight to the fallback's one converting copy — a
+            # pre-conversion just to feed the native kernel would cost
+            # a second full-batch copy.
+            filled = (images.dtype == np.float32
+                      and images.flags["C_CONTIGUOUS"]
+                      and native.pad_into(buf, images,
+                                          workers=self.workers))
+            if not filled:
+                buf[:n] = images
+                if n < bucket:
+                    buf[n:] = 0.0
             staged = buf
             buffers.append((bucket, buf))
         compiled = self._compiled.get(bucket)

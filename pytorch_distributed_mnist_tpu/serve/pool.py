@@ -94,6 +94,7 @@ class EnginePool:
         input_shape: Tuple[int, ...] = (28, 28, 1),
         serve_log=None,
         params_epoch: Optional[int] = None,
+        workers: int = 4,
     ) -> None:
         devices = list(devices) if devices is not None \
             else list(jax.local_devices())
@@ -107,7 +108,7 @@ class EnginePool:
             engine = InferenceEngine(
                 apply_fn, params, buckets=buckets, input_shape=input_shape,
                 serve_log=serve_log, params_epoch=params_epoch,
-                device=device, name=name)
+                device=device, name=name, workers=workers)
             self.replicas.append(EngineReplica(i, device, engine))
         if serve_log is not None:
             serve_log.set_replicas_probe(self.snapshot)

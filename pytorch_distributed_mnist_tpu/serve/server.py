@@ -133,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "--metrics-file (0 disables periodic writes)")
     p.add_argument("--seed", type=int, default=0,
                    help="fresh-init param seed when no checkpoint exists")
+    p.add_argument("-j", "--workers", type=int, default=4,
+                   help="host-side preprocessing threads per engine "
+                        "(same flag as training's data loaders): "
+                        "normalize, f64->f32 cast, and the pad-into-"
+                        "staging copy run in multithreaded C++ when the "
+                        "native library is built; no-op on the NumPy "
+                        "fallback. Default 4")
     return p
 
 
@@ -405,7 +412,7 @@ def create_server(args) -> ThreadingHTTPServer:
         pool = EnginePool(
             model.apply, params, devices=devices[:n_devices],
             buckets=_parse_buckets(args.buckets), serve_log=serve_log,
-            params_epoch=epoch,
+            params_epoch=epoch, workers=getattr(args, "workers", 4),
         )
         engine = pool
         pool.warmup()
@@ -421,6 +428,7 @@ def create_server(args) -> ThreadingHTTPServer:
         engine = InferenceEngine(
             model.apply, params, buckets=_parse_buckets(args.buckets),
             serve_log=serve_log, params_epoch=epoch,
+            workers=getattr(args, "workers", 4),
         )
         engine.warmup()
 

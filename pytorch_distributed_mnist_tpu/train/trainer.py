@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -42,6 +43,7 @@ from pytorch_distributed_mnist_tpu.data.loader import (
     make_global_batch,
     make_replicated,
 )
+from pytorch_distributed_mnist_tpu.data.staging import BatchFeeder
 from pytorch_distributed_mnist_tpu.ops.metrics import Accuracy, Average, MetricState
 from pytorch_distributed_mnist_tpu.parallel.collectives import make_explicit_dp_train_step
 from pytorch_distributed_mnist_tpu.train.state import TrainState
@@ -84,9 +86,13 @@ class Trainer:
         grad_accum: int = 1,
         epoch_gather: str = "host",
         aux_weight: float = 0.0,
+        feed_window: int = 2,
+        staging_log=None,
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
+        if feed_window < 1:
+            raise ValueError(f"feed_window must be >= 1, got {feed_window}")
         if epoch_gather not in ("host", "device"):
             raise ValueError(f"unknown epoch_gather {epoch_gather!r}")
         if epoch_gather == "device" and mode != "scan":
@@ -156,6 +162,23 @@ class Trainer:
             make_eval_epoch(mesh, state_sharding=state_sharding)
             if mode == "scan" else None
         )
+        self.staging_log = staging_log
+        self.feed_window = feed_window
+        # Per-batch input plane (stepwise/explicit): the double-buffered
+        # feeder stages batch N+1 (host gather + sharded device_put) on a
+        # background thread while the jitted step for batch N executes;
+        # window 1 is the inline strict-alternation path, bit-for-bit
+        # (data/staging.py; pinned by tests/test_staging.py).
+        self._feeder = (
+            BatchFeeder(train_loader, mesh, window=feed_window,
+                        staging_log=staging_log)
+            if mode != "scan" else None
+        )
+        # Per-batch eval staging cache: the eval sampler never
+        # reshuffles, so the staged global batches are identical every
+        # pass — gather + device_put them exactly once (the per-batch
+        # twin of the scan path's _eval_staged).
+        self._eval_staged_batches = None
         # Device-resident train dataset for the device-gather path
         # (uploaded lazily, once per run).
         self._train_data = None
@@ -186,16 +209,58 @@ class Trainer:
         the sampler's epoch at consumption time, so a caller that jumps
         epochs (resume) just invalidates the stage — correctness never
         depends on the prediction being right.
+
+        Single-process worlds carry the H2D transfer too: the one big
+        ``make_global_batch`` (sharded ``device_put`` of the whole
+        stacked epoch) used to run synchronously at the epoch boundary
+        even though the host-side stacking was prefetched; now the whole
+        stage overlaps the previous epoch's compute and eval. Multi-host
+        assembly stays on the main thread — no cross-host-visible array
+        work off it (supervision's no-concurrent-collectives rule).
         """
         epoch = self.train_loader.sampler.epoch + 1
         holder = {}
 
         def work():
-            holder["batches"] = self.train_loader.stacked_epoch(epoch)
+            t0 = time.perf_counter()
+            staged = self.train_loader.stacked_epoch(epoch)
+            t1 = time.perf_counter()
+            holder["batches"] = staged
+            # Timings only; the staging log is written at CONSUMPTION
+            # (train() below), so a prefetch that is discarded — epoch
+            # jump, or the run's final fire-and-forget stage — never
+            # skews the input-plane story with an epoch nobody used.
+            holder["host_ms"] = (t1 - t0) * 1e3
+            if jax.process_count() == 1:
+                holder["device_batches"] = make_global_batch(
+                    staged, self.mesh, leading_replicated=True)
+                holder["h2d_ms"] = (time.perf_counter() - t1) * 1e3
 
-        t = threading.Thread(target=work, daemon=True)
+        t = threading.Thread(target=work, daemon=True,
+                             name="epoch-prefetch")
         t.start()
         self._prefetch = (epoch, t, holder)
+
+    def close(self) -> None:
+        """Join and discard any in-flight input-plane thread
+        (idempotent): the scan prefetch AND the per-batch feeder.
+
+        The last ``train()`` of a run launches a prefetch nobody will
+        consume — and since the stage now carries the full-epoch H2D
+        transfer, letting that daemon thread race process teardown means
+        a ``device_put`` against a shutting-down runtime and a
+        full-epoch device copy held through post-training eval. The
+        per-batch feeder has the same hazard when an exception abandons
+        ``train()`` mid-epoch: the traceback keeps the generator (and
+        its ``finally``) alive until GC, so the feeder must be joined
+        explicitly. Callers that finish training (cli.run) close the
+        trainer; the staged arrays drop with the holder."""
+        if self._prefetch is not None:
+            _epoch, t, _holder = self._prefetch
+            self._prefetch = None
+            t.join()
+        if self._feeder is not None:
+            self._feeder.close()
 
     # -- AOT precompile ---------------------------------------------------
 
@@ -341,17 +406,59 @@ class Trainer:
                 self.state, self._train_data, ticks)
         elif self.mode == "scan":
             staged = None
+            batches = None
+            prefetched_host_ms = None
             if self._prefetch is not None:
                 epoch, t, holder = self._prefetch
                 self._prefetch = None
+                t_wait = time.perf_counter()
                 t.join()
+                if self.staging_log is not None:
+                    self.staging_log.record_wait(
+                        (time.perf_counter() - t_wait) * 1e3)
                 if epoch == self.train_loader.sampler.epoch:
                     staged = holder.get("batches")
-            if staged is None:
-                staged = self.train_loader.stacked_epoch()
-            batches = make_global_batch(
-                staged, self.mesh, leading_replicated=True
-            )
+                    if staged is not None:
+                        prefetched_host_ms = holder.get("host_ms")
+                    batches = holder.get("device_batches")
+                    if batches is not None and self.staging_log is not None:
+                        self.staging_log.record_stage(
+                            host_ms=holder["host_ms"],
+                            h2d_ms=holder["h2d_ms"],
+                            images=int(staged["label"].size),
+                            pipelined=True)
+            if batches is None:
+                # No (valid) prefetched device stage: do whatever is
+                # left on the consumer thread — the whole gather on a
+                # cold first epoch, just the H2D in a multi-host world
+                # where the thread staged host-side only.
+                t0 = time.perf_counter()
+                if staged is None:
+                    staged = self.train_loader.stacked_epoch()
+                t1 = time.perf_counter()
+                batches = make_global_batch(
+                    staged, self.mesh, leading_replicated=True
+                )
+                if self.staging_log is not None:
+                    t2 = time.perf_counter()
+                    if prefetched_host_ms is not None:
+                        # Multi-host: the gather DID run on the prefetch
+                        # thread (its real wall, not the ~0 ms of the
+                        # skipped re-gather above); only the H2D was
+                        # inline — the wait below carries exactly that
+                        # un-overlapped part, so the overlap fraction
+                        # credits the hidden host half and nothing else.
+                        self.staging_log.record_stage(
+                            host_ms=prefetched_host_ms,
+                            h2d_ms=(t2 - t1) * 1e3,
+                            images=int(staged["label"].size),
+                            pipelined=True)
+                    else:
+                        self.staging_log.record_stage(
+                            host_ms=(t1 - t0) * 1e3, h2d_ms=(t2 - t1) * 1e3,
+                            images=int(staged["label"].size),
+                            pipelined=False)
+                    self.staging_log.record_wait((t2 - t0) * 1e3)
             self.state, ms = self._run_program(
                 "train_epoch", self._train_epoch, self.state, batches)
             if self.prefetch_enabled:
@@ -360,8 +467,7 @@ class Trainer:
             ms = None
             name = ("train_step_explicit" if self.mode == "explicit"
                     else "train_step")
-            for batch in self.train_loader:
-                gbatch = make_global_batch(batch, self.mesh)
+            for gbatch in self._feeder.epoch():
                 self.state, m = self._run_program(
                     name, self._train_step, self.state, gbatch)
                 ms = m if ms is None else MetricState(
@@ -397,8 +503,16 @@ class Trainer:
             ms = None
             name = ("eval_step_explicit" if self.mode == "explicit"
                     else "eval_step")
-            for batch in self.test_loader:
-                gbatch = make_global_batch(batch, self.mesh)
+            if self._eval_staged_batches is None:
+                # The eval sampler never reshuffles: every pass gathers
+                # and device_puts the IDENTICAL batches, so stage them
+                # exactly once (the per-batch twin of _eval_staged;
+                # only-once staging pinned by tests/test_staging.py).
+                self._eval_staged_batches = [
+                    make_global_batch(batch, self.mesh)
+                    for batch in self.test_loader
+                ]
+            for gbatch in self._eval_staged_batches:
                 m = self._run_program(
                     name, self._eval_step, self.state, gbatch)
                 ms = m if ms is None else MetricState(

@@ -82,6 +82,98 @@ class StepTimer:
         return self.steps / max(self.elapsed, 1e-9)
 
 
+class StagingLog:
+    """Input data-plane observability: where does feeding the chip spend
+    its time, and how much of it is hidden behind compute?
+
+    The staging pipeline (``data/staging.py`` for the per-batch modes,
+    the scan trainer's epoch prefetch) records one ``record_stage`` per
+    staged batch/epoch — host-gather ms (the permutation copy) and H2D
+    ms (``make_global_batch``'s sharded ``device_put``), tagged with
+    whether it ran on a feeder thread — and the CONSUMER records how
+    long it actually blocked waiting for staged data
+    (``record_wait``). The difference is the overlap evidence:
+
+    - ``overlap_fraction`` = 1 - blocked_ms / staging_ms: 0 on the
+      synchronous path (every staging millisecond stalls the consumer,
+      and the inline path records its own wall as wait so the figure is
+      honest by construction), approaching 1 when the feeder fully
+      hides staging behind compute;
+    - ``feed_images_per_sec`` = images / staging wall: the feed-only
+      throughput the input pipeline could sustain — the number a fast
+      chip starves on when it exceeds the step rate. Stage walls time
+      the ``device_put`` DISPATCH (JAX async dispatch returns before
+      the transfer lands), so this figure is an upper bound here;
+      ``bench.py --mode input`` re-derives its headline rate from a
+      completion-blocked wall.
+
+    Thread-safe: the feeder thread records stages while the consumer
+    records waits. A process singleton (``staging_log``) follows the
+    ``compile_log`` pattern: cli/bench attach it per run and reset it
+    at entry.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages = 0
+            self._pipelined_stages = 0
+            self._host_ms = 0.0
+            self._h2d_ms = 0.0
+            self._images = 0
+            self._waits = 0
+            self._wait_ms = 0.0
+
+    def record_stage(self, host_ms: float, h2d_ms: float, images: int,
+                     pipelined: bool) -> None:
+        """One staged batch (or stacked epoch): host-gather wall, H2D
+        wall, the images it carried, and whether a feeder thread (not
+        the consumer) ran it."""
+        with self._lock:
+            self._stages += 1
+            if pipelined:
+                self._pipelined_stages += 1
+            self._host_ms += host_ms
+            self._h2d_ms += h2d_ms
+            self._images += images
+
+    def record_wait(self, wait_ms: float) -> None:
+        """Consumer-side blocked time for one batch handoff."""
+        with self._lock:
+            self._waits += 1
+            self._wait_ms += wait_ms
+
+    def summary(self) -> Dict:
+        """Snapshot for cli summaries and the bench ``input_pipeline``
+        block; all-zero (with ``overlap_fraction`` 0.0) when nothing
+        was recorded."""
+        with self._lock:
+            staging_ms = self._host_ms + self._h2d_ms
+            overlap = 0.0
+            if staging_ms > 0:
+                overlap = max(0.0, min(1.0, 1.0 - self._wait_ms / staging_ms))
+            return {
+                "stages": self._stages,
+                "pipelined_stages": self._pipelined_stages,
+                "host_ms": round(self._host_ms, 1),
+                "h2d_ms": round(self._h2d_ms, 1),
+                "consumer_wait_ms": round(self._wait_ms, 1),
+                "overlap_fraction": round(overlap, 4),
+                "images": self._images,
+                "feed_images_per_sec": round(
+                    self._images / max(staging_ms / 1e3, 1e-9), 1)
+                if self._images else 0.0,
+            }
+
+
+# Singleton for the same reason as compile_log: one run, one input-plane
+# story. cli.run and bench reset() it at entry.
+staging_log = StagingLog()
+
+
 class CompileLog:
     """Per-program compile observability: wall ms, XLA backend compiles,
     and persistent-cache hit/miss, attributed to named programs.

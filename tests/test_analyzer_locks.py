@@ -310,6 +310,116 @@ class Pool:
     assert _findings(src) == []
 
 
+# -- the input staging plane (ISSUE 6) ---------------------------------------
+
+
+def test_fires_on_staging_under_feeder_cv():
+    """The exact mistake data/staging.py avoids: running the H2D stage
+    (device_put) INSIDE the conduit's condition variable serializes the
+    consumer behind the transfer — the feeder must stage outside and
+    only append under the lock."""
+    src = """
+import threading, jax
+
+class EpochRun:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def feed(self, rows):
+        for row in rows:
+            with self._cv:
+                while len(self._staged) >= self.window:
+                    self._cv.wait()
+                self._staged.append(jax.device_put(row))
+                self._cv.notify_all()
+"""
+    (f,) = _findings(src)
+    assert "device_put" in f.message and "EpochRun._cv" in f.message
+
+
+def test_fires_on_collective_on_feeder_under_cv():
+    """A cross-host collective under the feeder's cv is the
+    no-concurrent-collectives worst case: the main thread (which owns
+    collectives) can be inside its own agreement while the feeder
+    blocks peers."""
+    src = """
+import threading
+from pytorch_distributed_mnist_tpu.runtime.supervision import allgather_records
+
+class Feeder:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def feed(self, batch):
+        with self._cv:
+            allgather_records("stage", batch)
+"""
+    (f,) = _findings(src)
+    assert "collective" in f.message and "Feeder._cv" in f.message
+
+
+def test_silent_on_stage_outside_append_under_cv():
+    """The real feeder shape (data/staging.py::_EpochRun._feed): gather
+    and device_put OUTSIDE the lock, bounded-append under it with the
+    cv wait/notify exemption."""
+    src = """
+import threading, jax
+
+class EpochRun:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def feed(self, rows):
+        for row in rows:
+            staged = jax.device_put(self.gather(row))
+            with self._cv:
+                while len(self._staged) >= self.window:
+                    self._cv.wait()
+                self._staged.append(staged)
+                self._cv.notify_all()
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_consumer_pop_under_cv():
+    """The consumer side (next_batch): wait for a staged batch, pop,
+    notify — nothing blocking beyond the cv protocol itself."""
+    src = """
+import threading
+
+class EpochRun:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def next_batch(self):
+        with self._cv:
+            while not self._staged and not self._done:
+                self._cv.wait()
+            batch = self._staged.popleft() if self._staged else None
+            self._cv.notify_all()
+        return batch
+"""
+    assert _findings(src) == []
+
+
+def test_staging_module_clean_and_in_lock_graph():
+    """ISSUE 6 acceptance: the staging module's cv is a lock-graph node,
+    and the module is clean under lock-discipline AND the thread-facing
+    checkers (trace-purity sees the feeder's code; collective-symmetry
+    sees no process_index-conditioned work on it)."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "data",
+                      "staging.py")],
+        checkers=["lock-discipline", "trace-purity", "collective-symmetry"],
+        baseline=None)
+    assert result.findings == []
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    staging = graph["pytorch_distributed_mnist_tpu/data/staging.py"]
+    assert staging["locks"] == ["_EpochRun._cv"]
+    # The conduit cv never nests with another lock — that IS the rule.
+    assert staging["order_edges"] == []
+
+
 # -- the real lock graph -----------------------------------------------------
 
 
