@@ -236,6 +236,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "ReduceScatter + AllGather. zero3 = shard params "
                         "too (FSDP-style: each host stores 1/N of the "
                         "model between steps, AllGather on use)")
+    p.add_argument("--zero-overlap", action="store_true",
+                   help="explicit overlapped ZeRO data plane "
+                        "(parallel/zero_overlap.py): bucketized gradient "
+                        "reduce-scatter fenced so each bucket's "
+                        "communication can overlap the remaining "
+                        "backward, owner-shard optimizer update, and "
+                        "the updated-shard allgather carried across the "
+                        "step boundary into the next forward. Same "
+                        "state layout and numerics as the default "
+                        "propagation-scheduled path (equivalence "
+                        "pinned); requires --optimizer-sharding "
+                        "zero1|zero3 and pure data parallelism; "
+                        "composes with --grad-accum")
+    p.add_argument("--zero-bucket-mb", type=float, default=4.0,
+                   metavar="MB",
+                   help="gradient bucket budget for --zero-overlap: "
+                        "size-ordered leaves pack into buckets of at "
+                        "most this many MiB; each bucket is one fenced "
+                        "communication-issue group (smaller = earlier "
+                        "first reduce-scatter, larger = fewer, "
+                        "better-utilized collectives)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans: every jitted step re-runs "
                         "un-jitted on a NaN/Inf result and raises at the "
@@ -935,6 +956,52 @@ def _run_body(args, epoch_callback=None) -> dict:
                 "--moe-aux-weight does not compose with --trainer-mode "
                 "explicit; use scan or stepwise"
             )
+    zero_overlap = getattr(args, "zero_overlap", False)
+    zero_bucket_mb = getattr(args, "zero_bucket_mb", 4.0)
+    if zero_overlap:
+        # The overlapped plane is the pure-DP explicit schedule; every
+        # unsupported composition is rejected with flag language here
+        # (and again as ValueError in the Trainer for library callers).
+        if getattr(args, "optimizer_sharding", "none") == "none":
+            raise SystemExit(
+                "--zero-overlap schedules the ZeRO weight update "
+                "explicitly; pass --optimizer-sharding zero1 or zero3 "
+                "with it"
+            )
+        if args.trainer_mode == "explicit":
+            raise SystemExit(
+                "--zero-overlap does not compose with --trainer-mode "
+                "explicit (both own the whole mesh as one shard_map "
+                "data axis); use scan or stepwise"
+            )
+        if tp > 1 or sp > 1 or ep > 1 or pp > 1:
+            raise SystemExit(
+                "--zero-overlap composes with data parallelism only; "
+                "TP/SP/EP/PP layouts stay on the default "
+                "propagation-scheduled path (drop --zero-overlap)"
+            )
+        if aux_weight:
+            raise SystemExit(
+                "--zero-overlap does not compose with --moe-aux-weight "
+                "(the sown aux statistic is a global-batch quantity; "
+                "the overlapped body sees local shards)"
+            )
+        if getattr(args, "loss", "xla") == "fused":
+            raise SystemExit(
+                "--zero-overlap does not compose with --loss fused "
+                "(the fused kernel's shard_map cannot nest inside the "
+                "overlapped step's shard_map over the same data axis)"
+            )
+        if epoch_gather == "device":
+            raise SystemExit(
+                "--zero-overlap requires --epoch-gather host (the "
+                "overlapped step is not embedded in the device-gather "
+                "epoch program)"
+            )
+        if zero_bucket_mb <= 0:
+            raise SystemExit(
+                f"--zero-bucket-mb must be > 0, got {zero_bucket_mb:g}"
+            )
     if pp > 1 and sp > 1:
         raise SystemExit(
             "--pipeline-stages does not compose with --sequence-parallel: "
@@ -1320,7 +1387,10 @@ def _run_body(args, epoch_callback=None) -> dict:
                       grad_accum=grad_accum, epoch_gather=epoch_gather,
                       aux_weight=aux_weight,
                       feed_window=getattr(args, "feed_window", 2),
-                      staging_log=staging_log)
+                      staging_log=staging_log,
+                      zero_overlap=zero_overlap,
+                      zero_level=3 if zero == "zero3" else 1,
+                      zero_bucket_mb=zero_bucket_mb)
     lr_of = step_decay_schedule(args.lr)
 
     # Per-run compile/staging accounting (surfaced in the summary/logs

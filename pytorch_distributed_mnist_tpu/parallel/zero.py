@@ -38,7 +38,15 @@ def _is_moment_path(path) -> bool:
 
 def _zero_spec(shape: Tuple[int, ...], axis_size: int, axis: str, base: P) -> P:
     """Shard the largest dimension divisible by ``axis_size`` that ``base``
-    leaves unsharded; return ``base`` unchanged if none qualifies."""
+    leaves unsharded; return ``base`` unchanged if none qualifies.
+
+    Equal-size ties break to the LOWEST dim index, explicitly: the dim
+    choice decides shard layout (and the overlapped path's bucket
+    contents, parallel/zero_overlap.py), so it must be stable across
+    runs, hosts, and interpreter versions — never an accident of which
+    maximal candidate an iteration order surfaced first. Pinned by
+    ``tests/test_zero1.py::test_zero_spec_tie_breaks_to_lowest_dim``.
+    """
     entries = list(base) + [None] * (len(shape) - len(base))
     candidates = [
         d for d in range(len(shape))
@@ -46,7 +54,7 @@ def _zero_spec(shape: Tuple[int, ...], axis_size: int, axis: str, base: P) -> P:
     ]
     if not candidates:
         return base
-    best = max(candidates, key=lambda d: shape[d])
+    best = min(candidates, key=lambda d: (-shape[d], d))
     entries[best] = axis
     return P(*entries)
 

@@ -247,12 +247,18 @@ def _take_batch(data, tick):
     }
 
 
-def _accumulate(acc, m):
+def accumulate_metrics(acc, m):
+    """Fold one step's MetricState into a running accumulator — the scan
+    bodies' shared reduction, public so the overlapped-ZeRO epoch
+    (``parallel/zero_overlap.py``) accumulates with the identical op."""
     return MetricState(
         acc.loss_sum + m.loss_sum,
         acc.correct + m.correct,
         acc.count + m.count,
     )
+
+
+_accumulate = accumulate_metrics
 
 
 def _make_epoch(mesh, axis, state_sharding, step_fn, train, indexed):

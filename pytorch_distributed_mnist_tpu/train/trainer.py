@@ -49,6 +49,7 @@ from pytorch_distributed_mnist_tpu.parallel.collectives import make_explicit_dp_
 from pytorch_distributed_mnist_tpu.train.state import TrainState
 from pytorch_distributed_mnist_tpu.train.steps import (
     abstract_spec,
+    accumulate_metrics,
     make_eval_epoch,
     make_eval_step,
     make_train_epoch,
@@ -88,6 +89,9 @@ class Trainer:
         aux_weight: float = 0.0,
         feed_window: int = 2,
         staging_log=None,
+        zero_overlap: bool = False,
+        zero_level: int = 1,
+        zero_bucket_mb: float = 4.0,
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
@@ -102,6 +106,36 @@ class Trainer:
             )
         if state_sharding is not None and mesh is None:
             raise ValueError("state_sharding requires a mesh")
+        if zero_overlap:
+            # The explicit overlapped-ZeRO data plane
+            # (parallel/zero_overlap.py): pure data parallelism with the
+            # propagation path's state layout. Host-side composition
+            # limits are rejected here (and with flag language in
+            # cli.py) rather than discovered as trace errors.
+            if mesh is None:
+                raise ValueError("zero_overlap requires a mesh")
+            if state_sharding is None:
+                raise ValueError(
+                    "zero_overlap requires the ZeRO state sharding "
+                    "(parallel/zero.py shard_state_zero)")
+            if mode == "explicit":
+                raise ValueError(
+                    "zero_overlap does not compose with mode='explicit' "
+                    "(both own the mesh as one shard_map data axis)")
+            if epoch_gather == "device":
+                raise ValueError(
+                    "zero_overlap requires epoch_gather='host' (the "
+                    "overlapped step is not embedded in the indexed "
+                    "device-gather epoch program)")
+            if aux_weight:
+                raise ValueError(
+                    "zero_overlap does not support aux_weight (the sown "
+                    "aux statistic is a global-batch quantity; the "
+                    "overlapped body sees local shards)")
+        self._zero_overlap = zero_overlap
+        self._zero_level = zero_level
+        self._zero_gather = None
+        self._zero_gathered = None
         self.state = state
         self.train_loader = train_loader
         self.test_loader = test_loader
@@ -136,6 +170,25 @@ class Trainer:
             )
 
             self._eval_step = make_explicit_dp_eval_step(mesh)
+        elif zero_overlap:
+            from pytorch_distributed_mnist_tpu.parallel.zero_overlap import (
+                make_overlap_train_step,
+                make_param_gather,
+            )
+
+            # Only the programs this mode executes are traced: the scan
+            # path never calls the per-batch step. Eval stays on the
+            # propagation path — it shares the state layout, and the
+            # forward-only program has no weight update to overlap.
+            self._train_step = (
+                make_overlap_train_step(
+                    state, mesh, level=zero_level,
+                    bucket_mb=zero_bucket_mb, grad_accum=grad_accum)
+                if mode != "scan" else None
+            )
+            if zero_level == 3:
+                self._zero_gather = make_param_gather(mesh)
+            self._eval_step = make_eval_step(mesh, state_sharding=state_sharding)
         else:
             self._train_step = make_train_step(
                 mesh, state_sharding=state_sharding, grad_accum=grad_accum,
@@ -147,6 +200,14 @@ class Trainer:
             self._train_epoch = make_train_epoch_indexed(
                 mesh, state_sharding=state_sharding, grad_accum=grad_accum,
                 aux_weight=aux_weight)
+        elif mode == "scan" and zero_overlap:
+            from pytorch_distributed_mnist_tpu.parallel.zero_overlap import (
+                make_overlap_train_epoch,
+            )
+
+            self._train_epoch = make_overlap_train_epoch(
+                state, mesh, level=zero_level, bucket_mb=zero_bucket_mb,
+                grad_accum=grad_accum)
         else:
             self._train_epoch = (
                 make_train_epoch(mesh, state_sharding=state_sharding,
@@ -197,6 +258,23 @@ class Trainer:
         self._precompile_threads = {}
         self._precompile_errors = {}
         self._precompile_started = False
+
+    @property
+    def state(self):
+        return self._state
+
+    @state.setter
+    def state(self, value) -> None:
+        # Installing a state from outside (resume, per-epoch LR update,
+        # tests) invalidates the ZeRO-3 gathered-param carry: the carry
+        # is DERIVED state (gathered == allgather(state.params), always)
+        # and a stale copy would silently run every forward pass on old
+        # weights while the optimizer updates the new shards. The train
+        # loops re-derive it lazily (one allgather, off the per-step
+        # path) and assign ``_state`` directly when installing a step's
+        # own output next to its matching carry.
+        self._state = value
+        self._zero_gathered = None
 
     def _start_prefetch(self) -> None:
         """Stage the NEXT epoch's gather while the device runs this one.
@@ -270,6 +348,10 @@ class Trainer:
         loaders (``data/loader.py batch_spec/epoch_spec/ticks_spec``) so
         they cannot drift from what staging really produces."""
         state_spec = abstract_spec(self.state)
+        # Overlapped ZeRO-3 carries the gathered (replicated) params as
+        # an explicit argument through the step/epoch boundary.
+        carry = ((abstract_spec(self.state.params),)
+                 if self._zero_overlap and self._zero_level == 3 else ())
         if self.mode == "scan":
             jobs = [("eval_epoch", self._eval_epoch,
                      (state_spec, self.test_loader.epoch_spec()))]
@@ -282,10 +364,23 @@ class Trainer:
                     "train_epoch_indexed", self._train_epoch,
                     (state_spec, data_spec, self.train_loader.ticks_spec()),
                 ))
+            elif self._zero_overlap:
+                jobs.insert(0, (
+                    "train_epoch_zero_overlap", self._train_epoch,
+                    (state_spec,) + carry
+                    + (self.train_loader.epoch_spec(),),
+                ))
             else:
                 jobs.insert(0, ("train_epoch", self._train_epoch,
                                 (state_spec, self.train_loader.epoch_spec())))
             return jobs
+        if self._zero_overlap:
+            return [
+                ("train_step_zero_overlap", self._train_step,
+                 (state_spec,) + carry + (self.train_loader.batch_spec(),)),
+                ("eval_step", self._eval_step,
+                 (state_spec, self.test_loader.batch_spec())),
+            ]
         suffix = "_explicit" if self.mode == "explicit" else ""
         return [
             ("train_step" + suffix, self._train_step,
@@ -459,20 +554,48 @@ class Trainer:
                             images=int(staged["label"].size),
                             pipelined=False)
                     self.staging_log.record_wait((t2 - t0) * 1e3)
-            self.state, ms = self._run_program(
-                "train_epoch", self._train_epoch, self.state, batches)
+            if self._zero_overlap and self._zero_level == 3:
+                # The carried gathered-param copy: step N's tail
+                # allgather rides the scan carry into step N+1's
+                # forward. Derived state (== allgather(state.params)),
+                # rebuilt whenever absent — first epoch, or any outside
+                # state install (the state setter invalidates it).
+                if self._zero_gathered is None:
+                    self._zero_gathered = self._zero_gather(
+                        self.state.params)
+                new_state, gathered, ms = self._run_program(
+                    "train_epoch_zero_overlap", self._train_epoch,
+                    self.state, self._zero_gathered, batches)
+                self._state = new_state  # direct: keep the matching carry
+                self._zero_gathered = gathered
+            elif self._zero_overlap:
+                self.state, ms = self._run_program(
+                    "train_epoch_zero_overlap", self._train_epoch,
+                    self.state, batches)
+            else:
+                self.state, ms = self._run_program(
+                    "train_epoch", self._train_epoch, self.state, batches)
             if self.prefetch_enabled:
                 self._start_prefetch()
         else:
             ms = None
+            carried = self._zero_overlap and self._zero_level == 3
+            if carried and self._zero_gathered is None:
+                self._zero_gathered = self._zero_gather(self.state.params)
             name = ("train_step_explicit" if self.mode == "explicit"
+                    else "train_step_zero_overlap" if self._zero_overlap
                     else "train_step")
             for gbatch in self._feeder.epoch():
-                self.state, m = self._run_program(
-                    name, self._train_step, self.state, gbatch)
-                ms = m if ms is None else MetricState(
-                    ms.loss_sum + m.loss_sum, ms.correct + m.correct, ms.count + m.count
-                )
+                if carried:
+                    new_state, gathered, m = self._run_program(
+                        name, self._train_step,
+                        self.state, self._zero_gathered, gbatch)
+                    self._state = new_state  # direct: keep matching carry
+                    self._zero_gathered = gathered
+                else:
+                    self.state, m = self._run_program(
+                        name, self._train_step, self.state, gbatch)
+                ms = m if ms is None else accumulate_metrics(ms, m)
         return _meters(ms)
 
     def evaluate(self) -> Tuple[Average, Accuracy]:
@@ -515,7 +638,5 @@ class Trainer:
             for gbatch in self._eval_staged_batches:
                 m = self._run_program(
                     name, self._eval_step, self.state, gbatch)
-                ms = m if ms is None else MetricState(
-                    ms.loss_sum + m.loss_sum, ms.correct + m.correct, ms.count + m.count
-                )
+                ms = m if ms is None else accumulate_metrics(ms, m)
         return _meters(ms)

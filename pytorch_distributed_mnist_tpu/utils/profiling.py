@@ -174,6 +174,30 @@ class StagingLog:
 staging_log = StagingLog()
 
 
+def comm_overlap_fraction(step_ms: float, compute_ms: float,
+                          comm_ms: float) -> Optional[float]:
+    """How much of a step's measured communication cost is hidden behind
+    its compute: ``1 - exposed/comm`` where ``exposed = max(step -
+    compute, 0)`` — the three walls measured independently (the full
+    step, a communication-free compute twin, a compute-free
+    communication twin). 1.0 means the step costs no more than its
+    compute (communication fully overlapped); 0.0 means every
+    communication millisecond extends the step (fully serialized).
+    Clamped to [0, 1] — the twins are separate measurements, so noise
+    can push the raw ratio past either edge. ``None`` when there is no
+    measurable communication (``comm_ms <= 0``) — a single-device world
+    has nothing to overlap, and 0/0 must not report as overlap.
+
+    Used by ``bench.py --mode zero``; unit-pinned in
+    ``tests/test_bench_zero.py``.
+    """
+    if comm_ms is None or comm_ms <= 0 or step_ms is None \
+            or compute_ms is None:
+        return None
+    exposed = max(float(step_ms) - float(compute_ms), 0.0)
+    return round(max(0.0, min(1.0, 1.0 - exposed / float(comm_ms))), 4)
+
+
 class CompileLog:
     """Per-program compile observability: wall ms, XLA backend compiles,
     and persistent-cache hit/miss, attributed to named programs.

@@ -284,3 +284,94 @@ def vote(ok):
     return records
 """
     assert _findings(src) == []
+
+
+# -- the shard_map-reduce-scatter shape (ISSUE 7, parallel/zero_overlap.py) --
+
+
+def test_fires_on_host_collective_beside_shard_map_reduce_scatter():
+    """The overlapped-ZeRO shape gone wrong: a driver that builds the
+    shard_map'd reduce-scatter body AND runs a host agreement under a
+    process_index() guard. The device collective is SPMD (every device
+    participates by construction); the HOST collective under the guard
+    is still the structural hang, and the checker must see it through
+    the surrounding shard_map machinery."""
+    src = """
+import jax
+from jax import lax
+
+def make_zero_step(mesh, state):
+    def body(st, batch):
+        g = compute_grads(st, batch)
+        return lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+
+    step = jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+    if process_index() == 0:
+        allgather_records("zero_step_ready", True)
+    return step
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "make_zero_step"
+    assert "allgather_records" in f.message
+
+
+def test_fires_on_early_return_before_agreement_in_rs_driver():
+    """Early-return form: one host leaves the reduce-scatter driver
+    before the shard-layout agreement its peers block in."""
+    src = """
+from jax import lax
+
+def place_and_agree(state, mesh):
+    if process_index() != 0:
+        return state
+    sharded = reduce_scatter_all_buckets(state)
+    agree("zero_layout", None)
+    return sharded
+"""
+    (f,) = _findings(src)
+    assert "early" in f.message
+
+
+def test_silent_on_clean_shard_map_reduce_scatter_body():
+    """The sanctioned zero_overlap shape: device collectives inside the
+    shard_map body (psum_scatter / all_gather fenced by
+    optimization_barrier), host agreement outside any host-conditioned
+    branch, process_count() fast path exempt."""
+    src = """
+import jax
+from jax import lax
+
+def make_zero_step(mesh, plan):
+    def body(st, batch):
+        grads = compute_grads(st, batch)
+        token = zero_token()
+        for bucket in plan:
+            fenced = lax.optimization_barrier(tuple(grads[i] for i in bucket) + (token,))
+            token = fenced[-1]
+            grads = [lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+                     for g in fenced[:-1]]
+        return [lax.all_gather(g, "data", axis=0, tiled=True) for g in grads]
+
+    if process_count() <= 1:
+        return jax.jit(body)
+    records = allgather_records("zero_plan", True)
+    raise_if_poisoned(records, "the bucket-plan agreement")
+    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_branching_on_rs_agreement_result():
+    """Branch-on-the-result beside the device collective: the agreement
+    runs on every host; only the follow-up work is host-local."""
+    src = """
+from jax import lax
+
+def publish_zero_shards(state):
+    shard = lax.psum_scatter(state, "data", scatter_dimension=0, tiled=True)
+    records = allgather_records("zero_publish", True)
+    if process_index() == 0 and all(r.ok for r in records):
+        write_manifest(shard)
+    return shard
+"""
+    assert _findings(src) == []

@@ -132,3 +132,77 @@ def step(state, batch, aux_weight=0.0):
 prog = jax.jit(step)
 """
     assert _findings(src) == []
+
+
+# -- the shard_map-reduce-scatter shape (ISSUE 7, parallel/zero_overlap.py) --
+
+
+def test_fires_on_jit_of_rs_step_with_config_default():
+    """An overlapped-ZeRO step whose body takes a bool config flag
+    (interpret/debug toggles) jitted without statics: each distinct
+    value re-traces the whole bucket chain — the recompile class the
+    zero bench's steady-state verdict exists to catch."""
+    src = """
+import jax
+from jax import lax
+
+def zero_step(state, batch, debug_buckets=False):
+    g = compute_grads(state, batch)
+    return lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+
+prog = jax.jit(zero_step, donate_argnums=(0,))
+"""
+    (f,) = _findings(src)
+    assert "debug_buckets" in f.message
+
+
+def test_fires_on_scalar_into_compiled_zero_step():
+    """The AOT-compiled overlapped step's spec holds committed arrays;
+    a raw literal where the batch belongs either fails the argument
+    check or silently re-keys a compile through a fallback wrapper."""
+    src = """
+import jax
+
+def bench(step_jit, state, batch):
+    compiled = step_jit.lower(state, batch).compile()
+    return compiled(state, 128)
+"""
+    (f,) = _findings(src)
+    assert "scalar" in f.message
+
+
+def test_silent_on_clean_zero_step_factory():
+    """The sanctioned zero_overlap factory: plan/level/bucket budget are
+    closure-bound at build time (no config params on the traced body),
+    and the compiled executable is called with arrays only."""
+    src = """
+import jax
+from jax import lax
+
+def make_zero_step(mesh, plan):
+    def body(state, batch):
+        g = compute_grads(state, batch)
+        return lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=None,
+                                 out_specs=None), donate_argnums=(0,))
+
+def drive(step_jit, state, batch):
+    compiled = step_jit.lower(state, batch).compile()
+    return compiled(state, batch)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_static_declared_rs_config():
+    src = """
+import jax
+from jax import lax
+
+def zero_step(state, batch, debug_buckets=False):
+    g = compute_grads(state, batch)
+    return lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+
+prog = jax.jit(zero_step, static_argnames=("debug_buckets",))
+"""
+    assert _findings(src) == []

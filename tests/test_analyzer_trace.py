@@ -160,3 +160,100 @@ def step(state, batch):
 step = jax.jit(step)
 """
     assert _findings(src) == []
+
+
+# -- the shard_map-reduce-scatter shape (ISSUE 7, parallel/zero_overlap.py) --
+
+
+def test_fires_on_print_in_shard_map_reduce_scatter_body():
+    """A debug print inside the overlapped-ZeRO body (discovered through
+    the shard_map factory-call idiom zero_overlap.py uses) runs once at
+    trace time — and would break the zero-steady-state-recompiles
+    contract if ever replaced with a callback."""
+    src = """
+import jax
+from jax import lax
+
+def make_zero_body(mesh, plan):
+    def body(state, batch):
+        grads = compute_grads(state, batch)
+        print("reduce-scattering", len(plan), "buckets")
+        return [lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+                for g in grads]
+
+    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "body" and "trace time" in f.message
+
+
+def test_fires_on_host_timing_in_bucket_chain_helper():
+    """An impure helper called from the shard_map'd body is caught by
+    the module-local call-graph walk even though only the body is the
+    traced root — timing a bucket's reduce-scatter belongs on the host
+    around the compiled call, never under trace."""
+    src = """
+import jax, time
+from jax import lax
+
+def _timed_scatter(g):
+    t0 = time.perf_counter()
+    out = lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+    record_ms(time.perf_counter() - t0)
+    return out
+
+def body(state, grads):
+    return [_timed_scatter(g) for g in grads]
+
+step = jax.shard_map(body, mesh=None, in_specs=None, out_specs=None)
+"""
+    messages = " | ".join(f.message for f in _findings(src))
+    assert "perf_counter" in messages
+
+
+def test_silent_on_clean_barrier_chained_reduce_scatter_body():
+    """The sanctioned zero_overlap body: optimization_barrier fences,
+    psum_scatter/all_gather collectives, jnp reductions for the chain
+    anchors — pure throughout."""
+    src = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def make_zero_body(mesh, plan, dims):
+    def body(state, grads):
+        token = jnp.zeros((), jnp.float32)
+        shards = list(grads)
+        for bucket in plan:
+            fenced = lax.optimization_barrier(
+                tuple(shards[i] for i in bucket) + (token,))
+            token = fenced[-1]
+            for leaf, i in zip(fenced[:-1], bucket):
+                shards[i] = lax.psum_scatter(
+                    leaf, "data", scatter_dimension=dims[i], tiled=True)
+            token = lax.optimization_barrier((token, jnp.sum(shards[bucket[0]])))[0]
+        return shards
+
+    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_static_bucket_plan_iteration():
+    """Iterating a Python-level bucket plan (trace-time unrolling) and
+    raising on a static shape mismatch are both sanctioned — the
+    zero_overlap build-time validation idiom."""
+    src = """
+import jax
+from jax import lax
+
+def body(state, grads, axis_size=8):
+    for g in grads:
+        if g.shape[0] % axis_size:
+            raise ValueError(f"leaf {g.shape} not divisible by {axis_size}")
+    return [lax.psum_scatter(g, "data", scatter_dimension=0, tiled=True)
+            for g in grads]
+
+step = jax.shard_map(body, mesh=None, in_specs=None, out_specs=None)
+"""
+    assert _findings(src) == []

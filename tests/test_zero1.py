@@ -41,6 +41,17 @@ def test_zero_spec_picks_largest_divisible_dim():
     assert _zero_spec((64, 128), 8, "data", P(None, "model")) == P("data", "model")
 
 
+def test_zero_spec_tie_breaks_to_lowest_dim():
+    """Equal largest dims resolve to the LOWEST index, deterministically:
+    the dim choice fixes the shard layout (and the overlapped path's
+    bucket shapes), so it must be stable across runs and hosts rather
+    than an accident of iteration order."""
+    assert _zero_spec((64, 64), 8, "data", P()) == P("data", None)
+    assert _zero_spec((8, 32, 32), 8, "data", P()) == P(None, "data", None)
+    # A tie where the lowest dim is base-claimed falls to the next one.
+    assert _zero_spec((64, 64), 8, "data", P("model")) == P("model", "data")
+
+
 def test_moments_are_sharded_params_replicated(mesh8):
     state = create_train_state(get_model("cnn"), jax.random.key(0))
     sharding = zero1_state_sharding(state, mesh8)

@@ -1,0 +1,399 @@
+"""Overlapped ZeRO (parallel/zero_overlap.py) on the 8-device mesh.
+
+The contract: the explicit bucketized reduce-scatter/allgather schedule
+changes WHEN communication happens, never WHAT the training computes.
+Overlapped and propagation paths share one state layout and must agree
+numerically — ZeRO-1 and ZeRO-3, per-step and scan epoch, with and
+without gradient accumulation — the carried gathered params always equal
+``allgather(state.params)``, checkpoints written under the overlapped
+path resume bit-compatibly, the default (no ``--zero-overlap``) path is
+untouched, and the module itself is clean under the analyzer's
+collective-symmetry / trace-purity / recompile-hazard checkers.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
+from pytorch_distributed_mnist_tpu.parallel.zero_overlap import (
+    bucket_plan,
+    make_comm_only_program,
+    make_overlap_train_epoch,
+    make_overlap_train_step,
+    make_param_gather,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import (
+    make_train_epoch,
+    make_train_step,
+)
+
+
+def _batch(seed, n=64):
+    r = np.random.default_rng(seed)
+    return {
+        "image": jnp.asarray(r.normal(size=(n, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(r.integers(0, 10, size=(n,)), jnp.int32),
+    }
+
+
+def _assert_trees_close(a, b, rtol=2e-4, atol=2e-5):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+# -- bucket plan -------------------------------------------------------------
+
+
+class _Leaf:
+    def __init__(self, shape, dtype=np.float32):
+        self.shape = shape
+        self.dtype = np.dtype(dtype)
+
+
+def test_bucket_plan_size_ordered_and_budgeted():
+    leaves = [_Leaf((10,)), _Leaf((1024, 256)), _Leaf((1024,)),
+              _Leaf((512, 512))]
+    plan = bucket_plan(leaves, bucket_mb=1.0)  # 1 MiB: each big leaf = 1 MiB
+    # Largest leaves first (1 and 3 are both exactly 1 MiB: flat-index
+    # tie-break), each filling its own bucket; the small leaves share.
+    assert plan == [[1], [3], [2, 0]]
+    # Every leaf appears exactly once.
+    assert sorted(i for b in plan for i in b) == [0, 1, 2, 3]
+
+
+def test_bucket_plan_oversize_leaf_gets_own_bucket():
+    leaves = [_Leaf((4096, 1024)), _Leaf((4,))]
+    plan = bucket_plan(leaves, bucket_mb=1.0)
+    assert plan[0] == [0]  # 16 MiB leaf alone, budget notwithstanding
+
+
+def test_bucket_plan_deterministic_and_validates():
+    leaves = [_Leaf((64, 64)) for _ in range(6)]
+    assert bucket_plan(leaves, 0.02) == bucket_plan(leaves, 0.02)
+    with pytest.raises(ValueError, match="bucket_mb"):
+        bucket_plan(leaves, 0.0)
+
+
+# -- numerical equivalence vs the propagation path ---------------------------
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_overlap_step_matches_propagation(mesh8, level):
+    """3 overlapped steps == 3 propagation-scheduled steps on the same
+    state layout — same params, moments, and metrics (fp-order tol)."""
+    model = get_model("linear", compute_dtype=jnp.float32)
+    ref = create_train_state(model, jax.random.key(0))
+    ref, ref_sh = shard_state_zero(ref, mesh8, level=level)
+    ref_step = make_train_step(mesh8, state_sharding=ref_sh)
+
+    z = create_train_state(model, jax.random.key(0))
+    z, _ = shard_state_zero(z, mesh8, level=level)
+    step = make_overlap_train_step(z, mesh8, level=level, bucket_mb=0.5)
+    gathered = make_param_gather(mesh8)(z.params) if level == 3 else None
+
+    for i in range(3):
+        b = _batch(seed=i)
+        ref, rm = ref_step(ref, b)
+        if level == 3:
+            z, gathered, zm = step(z, gathered, b)
+        else:
+            z, zm = step(z, b)
+    np.testing.assert_allclose(float(rm.loss_sum), float(zm.loss_sum),
+                               rtol=1e-5)
+    assert float(rm.count) == float(zm.count)
+    _assert_trees_close(ref.params, z.params)
+    _assert_trees_close(ref.opt_state, z.opt_state)
+    # The layout really is shared: both paths' params carry identical
+    # shardings leaf for leaf.
+    def _trim(spec):  # P('data') and P('data', None) are the same layout
+        entries = tuple(spec)
+        while entries and entries[-1] is None:
+            entries = entries[:-1]
+        return entries
+
+    for a, c in zip(jax.tree_util.tree_leaves(ref.params),
+                    jax.tree_util.tree_leaves(z.params)):
+        assert _trim(a.sharding.spec) == _trim(c.sharding.spec)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("level", [1, 3])
+def test_overlap_step_matches_propagation_cnn(mesh8, level):
+    """The conv model exercises multi-bucket plans (4 weight leaves of
+    very different sizes) and the dim-0-vs-dim-3 shard choices."""
+    model = get_model("cnn", compute_dtype=jnp.float32)
+    ref = create_train_state(model, jax.random.key(0))
+    ref, ref_sh = shard_state_zero(ref, mesh8, level=level)
+    ref_step = make_train_step(mesh8, state_sharding=ref_sh)
+
+    z = create_train_state(model, jax.random.key(0))
+    z, _ = shard_state_zero(z, mesh8, level=level)
+    step = make_overlap_train_step(z, mesh8, level=level, bucket_mb=1.0)
+    gathered = make_param_gather(mesh8)(z.params) if level == 3 else None
+
+    for i in range(3):
+        b = _batch(seed=i)
+        ref, rm = ref_step(ref, b)
+        if level == 3:
+            z, gathered, zm = step(z, gathered, b)
+        else:
+            z, zm = step(z, b)
+    np.testing.assert_allclose(float(rm.loss_sum), float(zm.loss_sum),
+                               rtol=1e-5)
+    _assert_trees_close(ref.params, z.params)
+    _assert_trees_close(ref.opt_state, z.opt_state)
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_overlap_scan_epoch_matches_propagation(mesh8, level):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    ref = create_train_state(model, jax.random.key(1))
+    ref, ref_sh = shard_state_zero(ref, mesh8, level=level)
+    z = create_train_state(model, jax.random.key(1))
+    z, _ = shard_state_zero(z, mesh8, level=level)
+
+    r = np.random.default_rng(7)
+    batches = {
+        "image": jnp.asarray(r.normal(size=(4, 64, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(r.integers(0, 10, size=(4, 64)), jnp.int32),
+    }
+    ref_epoch = make_train_epoch(mesh8, state_sharding=ref_sh)
+    z_epoch = make_overlap_train_epoch(z, mesh8, level=level, bucket_mb=0.5)
+    ref, rm = ref_epoch(ref, batches)
+    copies = jax.tree_util.tree_map(jnp.copy, batches)
+    if level == 3:
+        gathered = make_param_gather(mesh8)(z.params)
+        z, gathered, zm = z_epoch(z, gathered, copies)
+        # Carry invariant: the gathered copy leaving the epoch IS the
+        # allgather of the updated shards.
+        full = make_param_gather(mesh8)(z.params)
+        for a, c in zip(jax.tree_util.tree_leaves(full),
+                        jax.tree_util.tree_leaves(gathered)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    else:
+        z, zm = z_epoch(z, copies)
+    assert float(rm.count) == float(zm.count)
+    np.testing.assert_allclose(float(rm.loss_sum), float(zm.loss_sum),
+                               rtol=1e-5)
+    _assert_trees_close(ref.params, z.params)
+
+
+def test_overlap_grad_accum_composition(mesh8):
+    """--grad-accum > 1 under the overlapped plane: the accum scan's
+    per-example-sum gradients feed the bucketized reduce-scatter and the
+    result still equals the propagation path's accumulated step."""
+    model = get_model("linear", compute_dtype=jnp.float32)
+    ref = create_train_state(model, jax.random.key(2))
+    ref, ref_sh = shard_state_zero(ref, mesh8, level=1)
+    ref_step = make_train_step(mesh8, state_sharding=ref_sh, grad_accum=2)
+
+    z = create_train_state(model, jax.random.key(2))
+    z, _ = shard_state_zero(z, mesh8, level=1)
+    step = make_overlap_train_step(z, mesh8, level=1, bucket_mb=0.5,
+                                   grad_accum=2)
+    for i in range(2):
+        b = _batch(seed=10 + i)
+        ref, rm = ref_step(ref, b)
+        z, zm = step(z, b)
+    np.testing.assert_allclose(float(rm.loss_sum), float(zm.loss_sum),
+                               rtol=1e-5)
+    assert float(rm.count) == float(zm.count)
+    _assert_trees_close(ref.params, z.params)
+    _assert_trees_close(ref.opt_state, z.opt_state)
+
+
+def test_comm_only_program_runs_collective_sequence(mesh8):
+    """The bench's comm twin compiles and returns a finite scalar (the
+    DCE anchor folding every reduce-scatter/allgather result)."""
+    model = get_model("linear", compute_dtype=jnp.float32)
+    z = create_train_state(model, jax.random.key(0))
+    z, _ = shard_state_zero(z, mesh8, level=3)
+    full = make_param_gather(mesh8)(z.params)
+    comm = make_comm_only_program(z, mesh8, bucket_mb=0.5)
+    assert np.isfinite(float(comm(full)))
+
+
+# -- CLI wiring --------------------------------------------------------------
+
+
+def _cli_args(tmp_path, extra):
+    from pytorch_distributed_mnist_tpu.cli import build_parser
+
+    return build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear", "--epochs", "2",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ] + extra)
+
+
+def test_cli_zero_overlap_matches_propagation(tmp_path):
+    """--zero-overlap end to end (scan): the full driver's history equals
+    the propagation run's, and the default path compiles its usual
+    program names (no overlap program leaks into a run that never asked
+    for one)."""
+    from pytorch_distributed_mnist_tpu.cli import run
+
+    base = run(_cli_args(tmp_path / "a",
+                         ["--optimizer-sharding", "zero1"]))
+    assert "train_epoch" in base["compile_stats"]["programs"]
+    assert "train_epoch_zero_overlap" not in base["compile_stats"]["programs"]
+
+    ov = run(_cli_args(tmp_path / "b",
+                       ["--optimizer-sharding", "zero1", "--zero-overlap"]))
+    assert "train_epoch_zero_overlap" in ov["compile_stats"]["programs"]
+    for h_base, h_ov in zip(base["history"], ov["history"]):
+        np.testing.assert_allclose(h_base["train_loss"], h_ov["train_loss"],
+                                   rtol=1e-4)
+        np.testing.assert_allclose(h_base["test_acc"], h_ov["test_acc"],
+                                   rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_cli_zero_overlap_zero3_stepwise(tmp_path):
+    """ZeRO-3 overlapped through the stepwise path: the Trainer's
+    explicit gathered-param carry across step boundaries, equal to the
+    scan run's trajectory."""
+    from pytorch_distributed_mnist_tpu.cli import run
+
+    scan = run(_cli_args(tmp_path / "a",
+                         ["--optimizer-sharding", "zero3",
+                          "--zero-overlap"]))
+    stepw = run(_cli_args(tmp_path / "b",
+                          ["--optimizer-sharding", "zero3", "--zero-overlap",
+                           "--trainer-mode", "stepwise"]))
+    assert "train_step_zero_overlap" in stepw["compile_stats"]["programs"]
+    for h_a, h_b in zip(scan["history"], stepw["history"]):
+        np.testing.assert_allclose(h_a["train_loss"], h_b["train_loss"],
+                                   rtol=1e-4)
+
+
+@pytest.mark.parametrize("extra, match", [
+    ([], "zero1 or zero3"),
+    (["--optimizer-sharding", "zero1", "--trainer-mode", "explicit"],
+     "explicit"),
+    (["--optimizer-sharding", "zero1", "--loss", "fused"], "fused"),
+    (["--optimizer-sharding", "zero1", "--epoch-gather", "device"],
+     "epoch-gather host"),
+    (["--optimizer-sharding", "zero1", "--zero-bucket-mb", "0"],
+     "zero-bucket-mb"),
+])
+def test_cli_zero_overlap_rejects_bad_compositions(tmp_path, extra, match):
+    from pytorch_distributed_mnist_tpu.cli import run
+
+    with pytest.raises(SystemExit, match=match):
+        run(_cli_args(tmp_path, ["--zero-overlap"] + extra))
+
+
+def test_trainer_rejects_overlap_without_zero_sharding(mesh8, tiny_data):
+    from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+    from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+
+    images, labels = tiny_data
+    loader = MNISTDataLoader(images, labels, batch_size=64, train=True)
+    state = create_train_state(get_model("linear"), jax.random.key(0))
+    with pytest.raises(ValueError, match="ZeRO state sharding"):
+        Trainer(state, loader, loader, mesh=mesh8, zero_overlap=True)
+
+
+def test_external_state_install_invalidates_gathered_carry(mesh8, tiny_data):
+    """The ZeRO-3 gathered-param carry is DERIVED state: any outside
+    ``trainer.state = ...`` install (resume, LR update, tests) must drop
+    it, or every later forward silently runs on the old weights. The
+    internal step loop keeps its own matching carry."""
+    from pytorch_distributed_mnist_tpu.data.loader import MNISTDataLoader
+    from pytorch_distributed_mnist_tpu.train.trainer import Trainer
+
+    images, labels = tiny_data
+    loader = MNISTDataLoader(images, labels, batch_size=64, train=True,
+                             seed=0)
+    state = create_train_state(get_model("linear", compute_dtype=jnp.float32),
+                               jax.random.key(0))
+    state, sharding = shard_state_zero(state, mesh8, level=3)
+    trainer = Trainer(state, loader, loader, mesh=mesh8, mode="stepwise",
+                      state_sharding=sharding, zero_overlap=True,
+                      zero_level=3)
+    trainer.train()
+    assert trainer._zero_gathered is not None  # carry survives the epoch
+
+    # Same treedef (the compiled program pins pytree statics, tx
+    # included): an outside install is a same-shape state with other
+    # values — the resume shape.
+    fresh = trainer.state.replace(params=jax.tree_util.tree_map(
+        lambda p: p * 0.5, trainer.state.params))
+    trainer.state = fresh
+    assert trainer._zero_gathered is None  # setter dropped the stale copy
+    trainer.train()  # re-derives from the INSTALLED params and trains
+    gathered = trainer._zero_gathered
+    full = make_param_gather(mesh8)(trainer.state.params)
+    for a, c in zip(jax.tree_util.tree_leaves(full),
+                    jax.tree_util.tree_leaves(gathered)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    trainer.close()
+
+
+# -- checkpoint round-trip under overlapped ZeRO-3 ---------------------------
+
+
+def test_checkpoint_roundtrip_overlapped_zero3(tmp_path):
+    """Save mid-run under the overlapped ZeRO-3 plane (async writer, so
+    the host snapshot races the next epoch's donated buffers — the
+    hazard train/checkpoint.py:190 documents), `--resume auto`, and the
+    resumed epochs' metrics equal an uninterrupted run's exactly."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    def args(ckpt, epochs):
+        return build_parser().parse_args([
+            "--dataset", "synthetic", "--model", "linear",
+            "--batch-size", "64", "--synthetic-train-size", "256",
+            "--synthetic-test-size", "128", "--seed", "0",
+            "--optimizer-sharding", "zero3", "--zero-overlap",
+            "--async-checkpoint", "--resume", "auto",
+            "--checkpoint-dir", str(ckpt), "--epochs", str(epochs),
+            "--root", str(tmp_path / "data"),
+        ])
+
+    full = run(args(tmp_path / "full", 3))
+    run(args(tmp_path / "cut", 2))                 # interrupted at epoch 2
+    resumed = run(args(tmp_path / "cut", 3))       # picks up checkpoint_1
+    assert resumed["start_epoch"] == 2 and resumed["epochs_run"] == 1
+    row_full = full["history"][2]
+    row_res = resumed["history"][0]
+    assert row_res["epoch"] == 2
+    for key in ("train_loss", "train_acc", "test_loss", "test_acc"):
+        np.testing.assert_allclose(row_res[key], row_full[key], rtol=1e-6,
+                                   err_msg=key)
+
+
+# -- analyzer cleanliness ----------------------------------------------------
+
+
+@pytest.mark.lint
+def test_zero_overlap_module_clean_under_analyzer():
+    """The satellite contract: the new data plane passes the three
+    checkers whose invariants it most plausibly violates — host-symmetry
+    of collectives, purity of the traced bodies, and AOT shape
+    stability."""
+    from tools.analyzer import run_analysis
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = run_analysis(
+        [os.path.join(repo, "pytorch_distributed_mnist_tpu", "parallel",
+                      "zero_overlap.py")],
+        checkers=["collective-symmetry", "trace-purity",
+                  "recompile-hazard"],
+    )
+    assert not result.findings, [
+        f"{f.path}:{f.line} [{f.checker}] {f.message}"
+        for f in result.findings
+    ]
