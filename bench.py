@@ -953,6 +953,16 @@ def main_serve() -> None:
       median of interleaved paired drives, so CPU-share drift on a
       shared CI box cancels instead of deciding the sign.
 
+    The SHARDED plane (``serve/programs.py``) gets its own ``sharded``
+    block: for each registered mode (tensor x vit, expert x moe_mlp),
+    the ABBA-paired sharded-vs-replicated throughput ratio at the SAME
+    chip count, a mesh-scaling curve at fixed chips (mesh 1 = the
+    replicated fleet, up to one all-chip mesh group), and per
+    bucket x mode zero-recompile verdicts that fail the bench loudly.
+    On a CPU world the block carries the BENCH_r05-style fallback
+    caveat: host-thread collectives say nothing about ICI, so only the
+    schema and the recompile verdicts are meaningful there.
+
     In CI this runs on CPU with
     ``XLA_FLAGS=--xla_force_host_platform_device_count=4``.
     """
@@ -1176,6 +1186,137 @@ def main_serve() -> None:
                 "zero_steady_state_recompiles": not delta,
             })
 
+        # -- sharded serving (serve/programs.py): per-mode paired
+        # comparison vs replicated on the SAME chip count, and the
+        # mesh-scaling curve. Fixed-shape 8-row drives throughout (the
+        # pipeline block's reasoning: pin batch formation so the ratio
+        # measures the data plane, not packing).
+        sharded_requests = int(os.environ.get(
+            "BENCH_SERVE_SHARDED_REQUESTS", pool_requests))
+        sharded_block: dict = {}
+        sharded_recompiles: list = []
+        if n_devices < 2:
+            sharded_block["skipped"] = (
+                "single-device world: a serving mesh needs >= 2 chips")
+        else:
+            from pytorch_distributed_mnist_tpu.serve.programs import (
+                registered_mode_models,
+                validate_serve_mode,
+            )
+
+            # The LIVE registry, not a hardcoded list: a mode added via
+            # register_serve_mode joins the comparison and the recompile
+            # verdict automatically (the server's extension contract).
+            for mode, model_name in registered_mode_models():
+                shard_model = get_model(
+                    model_name, **({} if device.platform == "tpu"
+                                   else {"compute_dtype": jnp.float32}))
+                shard_state = create_train_state(shard_model,
+                                                 jax.random.key(0))
+                # Mesh-scaling curve at FIXED chip count: mesh 1 is the
+                # replicated plane (n_devices one-chip replicas), the
+                # largest VALID point one spanning mesh group. A mesh a
+                # sharded weight dim doesn't divide (e.g. more chips
+                # than the MoE has experts) is dropped point-by-point;
+                # a mode with no valid sharded point becomes a labeled
+                # skip, not a traceback that loses the whole bench line.
+                mesh_points, skip_reason = [1], None
+                for mesh in sorted({2, n_devices}):
+                    if n_devices % mesh:
+                        continue
+                    try:
+                        validate_serve_mode(mode, model_name, mesh,
+                                            shard_state.params)
+                        mesh_points.append(mesh)
+                    except ValueError as exc:
+                        skip_reason = str(exc)
+                if len(mesh_points) == 1:
+                    sharded_block[mode] = {"model": model_name,
+                                           "skipped": skip_reason}
+                    continue
+                full_mesh = mesh_points[-1]
+                pools = {}
+                for mesh in mesh_points:
+                    if mesh == 1:
+                        pools[mesh] = EnginePool(
+                            shard_model.apply, shard_state.params,
+                            devices=jax.local_devices()[:n_devices],
+                            buckets=(1, 8))
+                    else:
+                        pools[mesh] = EnginePool(
+                            shard_model.apply, shard_state.params,
+                            devices=jax.local_devices()[:n_devices],
+                            buckets=(1, 8), serve_mode=mode,
+                            mesh_size=mesh, model_name=model_name)
+                    pools[mesh].warmup()
+                # Snapshot EVERY serve program (not just @{mode} names):
+                # the replicated baseline leg drives @r{i} programs, and
+                # a recompile stalling THAT side would silently skew
+                # vs_replicated in the sharded mode's favor.
+                before_mode = _serve_program_compiles()
+                mesh_scaling = []
+                for mesh in mesh_points:
+                    groups = n_devices // mesh
+                    wall_m = drive_pool(pools[mesh], window=groups + 1,
+                                        requests_n=sharded_requests,
+                                        reps=1, fixed_shape=True)
+                    mesh_scaling.append({
+                        "mesh_devices": mesh,
+                        "mesh_groups": groups,
+                        "requests_per_sec": round(
+                            sharded_requests / wall_m, 1),
+                    })
+                # ABBA-paired sharded (full mesh, 1 group) vs replicated
+                # (mesh 1, n one-chip replicas), each at its natural
+                # window; adjacent pairs see the same neighbor load, so
+                # the ratio survives CPU-share drift (PR 4 methodology).
+                walls = {"sharded": [], "replicated": []}
+                for rep in range(4):
+                    order = (("sharded", "replicated") if rep % 2 == 0
+                             else ("replicated", "sharded"))
+                    for leg in order:
+                        pool_leg = (pools[full_mesh] if leg == "sharded"
+                                    else pools[1])
+                        window = (n_devices // full_mesh + 1
+                                  if leg == "sharded"
+                                  else n_devices + 1)
+                        walls[leg].append(drive_pool(
+                            pool_leg, window=window,
+                            requests_n=sharded_requests, reps=1,
+                            fixed_shape=True))
+                pairs = [round(r / s, 3) for s, r in
+                         zip(walls["sharded"], walls["replicated"])]
+                vs_replicated = sorted(pairs)[len(pairs) // 2]
+                # Per-bucket x mode recompile verdict: every serve
+                # program alive in this block — the @{mode}[.g{i}] mesh
+                # programs AND the replicated baseline's @r{i} ones —
+                # must show zero compiles across every drive above; a
+                # violation fails the whole bench line (exit 1), same
+                # as the replicated planes.
+                delta_mode = _recompile_delta(
+                    before_mode, _serve_program_compiles())
+                if delta_mode:
+                    sharded_recompiles.append({mode: delta_mode})
+                full_rps = next(
+                    pt["requests_per_sec"] for pt in mesh_scaling
+                    if pt["mesh_devices"] == full_mesh)
+                sharded_block[mode] = {
+                    "model": model_name,
+                    "mesh_devices": full_mesh,
+                    "requests_per_sec": full_rps,
+                    "vs_replicated": vs_replicated,
+                    "pairs": pairs,
+                    "mesh_scaling": mesh_scaling,
+                    "zero_steady_state_recompiles": not delta_mode,
+                }
+            sharded_block["requests"] = sharded_requests
+            if device.platform != "tpu":
+                sharded_block["caveat"] = (
+                    "CPU fallback (the BENCH_r05 convention): mesh "
+                    "collectives run over host threads, not ICI, so the "
+                    "sharded-vs-replicated sign is not meaningful here — "
+                    "only the schema and the zero-recompile verdicts are")
+
         value = requests / wall
         out.update({
             "value": round(value, 1),
@@ -1191,6 +1332,7 @@ def main_serve() -> None:
             "warmup_compile_s": round(warmup_s, 3),
             "zero_steady_state_recompiles": zero_recompiles,
             "replica_scaling": replica_scaling,
+            "sharded": sharded_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
             "pool_requests": pool_requests,
@@ -1207,13 +1349,16 @@ def main_serve() -> None:
         # completions would inflate the headline), and nothing failed.
         served_all = snap["requests"] == 2 * requests  # best-of-2 drives
         ok = (zero_recompiles and not drive_errors and served_all
-              and not recompiled_replicas)
+              and not recompiled_replicas and not sharded_recompiles)
         if not zero_recompiles:
             out["error"] = ("steady-state serving recompiled: "
                             f"{totals_after_warmup} -> {totals_after_load}")
         elif recompiled_replicas:
             out["error"] = ("steady-state pool serving recompiled: "
                             f"{recompiled_replicas}")
+        elif sharded_recompiles:
+            out["error"] = ("steady-state SHARDED serving recompiled "
+                            f"(per bucket x mode): {sharded_recompiles}")
         elif drive_errors:
             out["error"] = (f"{len(drive_errors)} requests failed during "
                             f"the drive: {drive_errors[:3]}")

@@ -362,28 +362,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _model_field_default(model_cls, name: str):
-    """A model dataclass field's default — the single source for the
-    divisibility flag checks (head/expert counts)."""
-    import dataclasses
-
-    return next(
-        f.default for f in dataclasses.fields(model_cls) if f.name == name
-    )
-
-
 def _vit_num_heads() -> int:
-    from pytorch_distributed_mnist_tpu.models.attention import (
-        VisionTransformer,
+    from pytorch_distributed_mnist_tpu.models.registry import (
+        model_field_default,
     )
 
-    return _model_field_default(VisionTransformer, "num_heads")
+    return model_field_default("vit", "num_heads")
 
 
 def _moe_num_experts() -> int:
-    from pytorch_distributed_mnist_tpu.models.moe import MoEClassifier
+    from pytorch_distributed_mnist_tpu.models.registry import (
+        model_field_default,
+    )
 
-    return _model_field_default(MoEClassifier, "num_experts")
+    return model_field_default("moe_mlp", "num_experts")
 
 
 def _build_loaders(args, seed: int, mesh):
@@ -1474,6 +1466,12 @@ def _run_body(args, epoch_callback=None) -> dict:
                 epoch=epoch, best_acc=best_acc, is_best=is_best,
                 directory=args.checkpoint_dir,
                 keep_last=getattr(args, "keep_last", 0),
+                # Provenance stamp for the serve-side layout gate
+                # (serve/programs.py::check_checkpoint_layout): a
+                # tensor/expert-trained checkpoint must be served with
+                # the matching --serve-mode, not silently replicated.
+                parallel_layout={"tensor": tp, "sequence": sp,
+                                 "expert": ep, "pipeline": pp},
             )
             if saver is not None:
                 # The annotated span is the drain of the PREVIOUS epoch's

@@ -41,6 +41,35 @@ def list_models():
     return sorted(_REGISTRY)
 
 
+def model_field_default(name: str, field: str):
+    """A registered model's constructor default for ``field`` — the one
+    source for flag-level divisibility checks (head/expert counts in the
+    training CLI, mesh-size validation messages in serving). Raises
+    ``ValueError`` for an unknown model or field, so a typo fails loudly
+    instead of reading as "no default"."""
+    import dataclasses
+    import inspect
+
+    ctor = _lookup(name)
+    if dataclasses.is_dataclass(ctor):
+        for f in dataclasses.fields(ctor):
+            if f.name == field:
+                if f.default is not dataclasses.MISSING:
+                    return f.default
+                if f.default_factory is not dataclasses.MISSING:
+                    return f.default_factory()
+                break  # required field: no default to report
+    else:
+        try:
+            param = inspect.signature(ctor).parameters[field]
+        except (KeyError, TypeError, ValueError):
+            pass
+        else:
+            if param.default is not inspect.Parameter.empty:
+                return param.default
+    raise ValueError(f"model {name!r} has no field {field!r} with a default")
+
+
 def model_accepts(name: str, field: str) -> bool:
     """True if the registered model's constructor takes ``field``.
 

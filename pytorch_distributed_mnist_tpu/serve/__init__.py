@@ -14,7 +14,14 @@ REQUESTS — the north-star's "serves heavy traffic" capability. Pieces:
   engine replica per local device (per-device params + AOT programs)
   behind a least-loaded dispatcher, driven through the batcher's
   pipelined dispatch/complete stages (``--serve-devices`` /
-  ``--max-inflight``);
+  ``--max-inflight``); with a sharded ``--serve-mode`` the chips
+  partition into ``--serve-mesh``-sized mesh groups instead;
+- ``programs.py``: the forward-program registry — given a model name
+  and a ``--serve-mode`` (replicated / tensor / expert, extensible),
+  builds the serving mesh, derives param/input/output shardings from
+  the training rule tables, and hands the engine a
+  :class:`MeshPlacement` its bucket programs AOT-lower against, plus
+  the checkpoint parallel-layout gate (``check_checkpoint_layout``);
 - ``reload.py``: :class:`CheckpointWatcher` — polls a published
   checkpoint directory (``train/checkpoint.py`` conventions) and swaps
   params atomically between batches (fanned out per replica on a pool);
@@ -28,13 +35,27 @@ Drive it with ``tools/loadgen.py``; measure it with
 from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
 from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
 from pytorch_distributed_mnist_tpu.serve.pool import EnginePool, EngineReplica
+from pytorch_distributed_mnist_tpu.serve.programs import (
+    SERVE_MODES,
+    MeshPlacement,
+    build_group_placements,
+    build_placement,
+    check_checkpoint_layout,
+    servable_modes,
+)
 from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
 
 __all__ = [
+    "SERVE_MODES",
     "CheckpointWatcher",
     "EnginePool",
     "EngineReplica",
     "InferenceEngine",
+    "MeshPlacement",
     "MicroBatcher",
     "Overloaded",
+    "build_group_placements",
+    "build_placement",
+    "check_checkpoint_layout",
+    "servable_modes",
 ]

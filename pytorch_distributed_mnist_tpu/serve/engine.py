@@ -108,6 +108,18 @@ class InferenceEngine:
     shipped with. ``name`` suffixes the per-bucket ``CompileLog``
     program names (``serve_forward_b8@r2``) so a pool's compile stats
     and the zero-recompile check stay attributable per replica.
+
+    ``placement``: a :class:`~pytorch_distributed_mnist_tpu.serve.
+    programs.MeshPlacement` — the SHARDED plane. The engine then spans
+    the placement's mesh: params commit with the mode's ``NamedSharding``
+    tree (derived from the training rule tables by the program
+    registry), each bucket program pjit-lowers with those in/out
+    shardings (``serve_forward_b{b}@{mode}`` in ``CompileLog``), inputs
+    replicate over the mesh, and outputs come back replicated so
+    ``complete`` reads them exactly as it reads single-device results.
+    Everything else — buckets, staging free-lists, the dispatch/complete
+    split, the swap-ordering rule — is mode-agnostic and unchanged.
+    Mutually exclusive with ``device``.
     """
 
     def __init__(
@@ -121,6 +133,7 @@ class InferenceEngine:
         device=None,
         name: Optional[str] = None,
         workers: int = 4,
+        placement=None,
     ) -> None:
         buckets = sorted({int(b) for b in buckets})
         if not buckets or buckets[0] < 1:
@@ -134,9 +147,20 @@ class InferenceEngine:
         # native library is built, over this many threads.
         self.workers = workers
         self.device = device
+        self.placement = placement
         self.name = name
         self._forward = make_forward_program(apply_fn)
-        if device is not None:
+        if placement is not None:
+            if device is not None:
+                raise ValueError(
+                    "pass device= (single-chip pinning) or placement= "
+                    "(sharded mesh), not both")
+            # Sharded plane: the placement owns commit + lowering —
+            # params with the mode's NamedSharding tree, inputs/outputs
+            # replicated over the mesh (serve/programs.py).
+            self._sharding = None
+            self._jit = placement.jit_forward(self._forward)
+        elif device is not None:
             # Pin params, inputs, and outputs to THIS device so the AOT
             # executables land there (default lowering would compile for
             # devices()[0] and reject arguments committed elsewhere).
@@ -157,11 +181,25 @@ class InferenceEngine:
         self._staging_allocated = {b: 0 for b in self.buckets}
 
     def _place(self, tree):
-        """Commit ``tree`` to this engine's device (default placement
-        when unpinned)."""
+        """Commit a PARAMS tree to this engine's device(s): the mesh
+        placement's sharding tree on the sharded plane, the pinned
+        device's ``SingleDeviceSharding`` on the pooled one, default
+        placement when unpinned."""
+        if self.placement is not None:
+            return self.placement.place_params(tree)
         if self._sharding is not None:
             return jax.device_put(tree, self._sharding)
         return jax.device_put(tree)
+
+    def _place_input(self, staged):
+        """Commit one staged input batch: replicated over the mesh on
+        the sharded plane; otherwise exactly the pre-sharding behavior
+        (committed to the pinned device, or left to jax's default)."""
+        if self.placement is not None:
+            return self.placement.place_input(staged)
+        if self._sharding is not None:
+            return jax.device_put(staged, self._sharding)
+        return jax.numpy.asarray(staged)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -334,8 +372,7 @@ class InferenceEngine:
             staged = buf
             buffers.append((bucket, buf))
         compiled = self._compiled.get(bucket)
-        x = self._place(staged) if self._sharding is not None \
-            else jax.numpy.asarray(staged)
+        x = self._place_input(staged)
         if compiled is not None:
             out = compiled(params, x)
         else:

@@ -27,6 +27,19 @@ swap-ordering rule — epochs compared under the replica's lock, an older
 checkpoint never installs over a newer one — and each batch still
 reports the epoch of the params that ACTUALLY computed it, captured
 under the owning replica's lock.
+
+**Sharded plane** (``serve_mode`` != replicated): a sharded engine
+SPANS a mesh, so the pool partitions its chips into ``mesh_size``-chip
+mesh GROUPS instead of one-replica-per-device — 8 chips at mesh 2 = 4
+two-chip tensor/expert-parallel engines, each built from a
+:class:`~pytorch_distributed_mnist_tpu.serve.programs.MeshPlacement`
+(``serve/programs.py`` derives the shardings from the training rule
+tables). Everything above the engine is group-agnostic: least-loaded
+dispatch picks among groups, the hot-reload fan-out installs the ONE
+host-side load per group with that group's ``NamedSharding`` tree, and
+per-group ``CompileLog`` names (``serve_forward_b{b}@{mode}.g{i}``;
+just ``@{mode}`` when one group spans the whole pool) keep the
+zero-recompile verdict attributable.
 """
 
 from __future__ import annotations
@@ -45,20 +58,25 @@ from pytorch_distributed_mnist_tpu.serve.engine import (
 
 
 class EngineReplica:
-    """One pinned engine + the pool's dispatch bookkeeping for it.
+    """One pinned (or mesh-group) engine + the pool's dispatch
+    bookkeeping for it.
 
     ``pending`` (batches dispatched, not yet completed) is owned by the
     POOL's lock, not the replica: dispatch-time placement decisions need
-    a consistent view across all replicas.
+    a consistent view across all replicas. ``device`` is the one pinned
+    device on the replicated plane; ``devices`` is the full span (a
+    1-tuple there, the mesh group on the sharded plane).
     """
 
-    __slots__ = ("index", "name", "device", "engine", "pending",
+    __slots__ = ("index", "name", "device", "devices", "engine", "pending",
                  "dispatched")
 
-    def __init__(self, index: int, device, engine: InferenceEngine) -> None:
+    def __init__(self, index: int, device, engine: InferenceEngine,
+                 name: Optional[str] = None, devices=None) -> None:
         self.index = index
-        self.name = f"r{index}"
+        self.name = name if name is not None else f"r{index}"
         self.device = device
+        self.devices = tuple(devices) if devices is not None else (device,)
         self.engine = engine
         self.pending = 0  # in-flight batches (pool lock)
         self.dispatched = 0  # lifetime batches assigned (pool lock)
@@ -95,21 +113,56 @@ class EnginePool:
         serve_log=None,
         params_epoch: Optional[int] = None,
         workers: int = 4,
+        serve_mode: str = "replicated",
+        mesh_size: int = 1,
+        model_name: Optional[str] = None,
     ) -> None:
         devices = list(devices) if devices is not None \
             else list(jax.local_devices())
         if not devices:
             raise ValueError("EnginePool needs at least one device")
         self.serve_log = serve_log
+        self.serve_mode = serve_mode
+        self.mesh_size = mesh_size
+        self.n_devices = len(devices)
         self._lock = threading.Lock()
         self.replicas: List[EngineReplica] = []
-        for i, device in enumerate(devices):
-            name = f"r{i}"
-            engine = InferenceEngine(
-                apply_fn, params, buckets=buckets, input_shape=input_shape,
-                serve_log=serve_log, params_epoch=params_epoch,
-                device=device, name=name, workers=workers)
-            self.replicas.append(EngineReplica(i, device, engine))
+        if serve_mode != "replicated":
+            # Sharded plane: partition chips into mesh groups, one
+            # spanning engine per group (serve/programs.py owns the
+            # mesh/sharding derivation and every validity check).
+            from pytorch_distributed_mnist_tpu.serve.programs import (
+                build_group_placements,
+            )
+
+            if model_name is None:
+                raise ValueError(
+                    f"serve_mode {serve_mode!r} needs model_name= (the "
+                    f"mode's rule table is per model family)")
+            placements = build_group_placements(
+                serve_mode, model_name, devices, mesh_size, params)
+            for i, placement in enumerate(placements):
+                engine = InferenceEngine(
+                    apply_fn, params, buckets=buckets,
+                    input_shape=input_shape, serve_log=serve_log,
+                    params_epoch=params_epoch, placement=placement,
+                    name=placement.name, workers=workers)
+                self.replicas.append(EngineReplica(
+                    i, placement.devices[0], engine, name=placement.name,
+                    devices=placement.devices))
+        else:
+            if mesh_size != 1:
+                raise ValueError(
+                    "replicated serving runs one engine per chip; a "
+                    f"{mesh_size}-device mesh needs a sharded serve_mode")
+            for i, device in enumerate(devices):
+                name = f"r{i}"
+                engine = InferenceEngine(
+                    apply_fn, params, buckets=buckets,
+                    input_shape=input_shape, serve_log=serve_log,
+                    params_epoch=params_epoch, device=device, name=name,
+                    workers=workers)
+                self.replicas.append(EngineReplica(i, device, engine))
         if serve_log is not None:
             serve_log.set_replicas_probe(self.snapshot)
 
@@ -214,12 +267,21 @@ class EnginePool:
 
     def snapshot(self) -> dict:
         """Per-replica rows for ``/stats`` and the JSONL sink: device,
-        serving epoch, in-flight and lifetime dispatch counts."""
+        serving epoch, in-flight and lifetime dispatch counts. Sharded
+        (mesh-group) rows additionally carry the group's full device
+        span and the serve mode; replicated rows keep the exact pre-mesh
+        schema."""
+        sharded = self.serve_mode != "replicated"
         with self._lock:
-            rows = {r.name: {"device": str(r.device),
-                             "pending": r.pending,
-                             "dispatched": r.dispatched}
-                    for r in self.replicas}
+            rows = {}
+            for r in self.replicas:
+                row = {"device": str(r.device),
+                       "pending": r.pending,
+                       "dispatched": r.dispatched}
+                if sharded:
+                    row["mode"] = self.serve_mode
+                    row["devices"] = [str(d) for d in r.devices]
+                rows[r.name] = row
         for replica in self.replicas:
             rows[replica.name]["params_epoch"] = replica.engine.params_epoch
         return rows

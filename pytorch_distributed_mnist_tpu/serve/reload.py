@@ -51,12 +51,21 @@ class CheckpointWatcher:
         poll_interval_s: float = 2.0,
         serve_log=None,
         current_path: Optional[str] = None,
+        validate_fn: Optional[Callable] = None,
     ) -> None:
         self.directory = directory
         self.poll_interval_s = float(poll_interval_s)
         self.serve_log = serve_log
         self._template = template_state
         self._on_params = on_params
+        # Pre-load gate (``validate_fn(path)`` raising rejects the
+        # file): the server passes the serve-mode/parallel-layout check
+        # here, so a checkpoint published with a mismatched training
+        # layout is SKIPPED — permanently for that file, a ValueError —
+        # instead of being installed under the wrong serving mode. A
+        # mesh-committed (sharded) pool especially must never receive
+        # params whose training layout contradicts its serve mode.
+        self._validate = validate_fn
         self._current = current_path
         # Last path that failed to load: retried only once the listing
         # moves past it, so one corrupt file can't hot-loop the log.
@@ -79,6 +88,8 @@ class CheckpointWatcher:
         )
 
         try:
+            if self._validate is not None:
+                self._validate(path)  # ValueError routes to "permanent"
             params, epoch = load_params_for_serving(path, self._template)
         except Exception as exc:  # noqa: BLE001 - serving must survive
             # Serving always survives a failed reload — but retry policy

@@ -49,6 +49,7 @@ from pytorch_distributed_mnist_tpu.serve.engine import (
     InferenceEngine,
     load_params_for_serving,
 )
+from pytorch_distributed_mnist_tpu.serve.programs import serve_modes
 from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
 from pytorch_distributed_mnist_tpu.utils.profiling import (
     JsonlSink,
@@ -84,11 +85,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "at startup; batches pad up to the nearest bucket "
                         "so steady-state serving never recompiles")
     p.add_argument("--serve-devices", type=int, default=1,
-                   help="engine replicas, one per local device (0 = every "
-                        "local device): params are committed and bucket "
-                        "programs AOT-compiled per device, and formed "
-                        "batches go to the least-loaded replica. Default "
-                        "1 is the single-device data plane")
+                   help="chips the data plane spans (0 = every local "
+                        "device). Replicated mode: one engine replica per "
+                        "device behind the least-loaded dispatcher. "
+                        "Sharded modes: the chips partition into "
+                        "--serve-mesh-sized groups. Default 1 is the "
+                        "single-device data plane")
+    # choices read the LIVE registry at parser-build time, so a mode
+    # added through register_serve_mode (the documented extension seam)
+    # is accepted without editing this file.
+    p.add_argument("--serve-mode", type=str, default="replicated",
+                   choices=serve_modes(),
+                   help="how one forward spans chips: 'replicated' runs "
+                        "the whole model per chip (default, every model); "
+                        "'tensor' Megatron-shards the ViT weights over a "
+                        "mesh (parallel/tensor.py rules); 'expert' shards "
+                        "moe_mlp experts (parallel/expert.py). Sharded "
+                        "modes lower one pjit program per bucket over the "
+                        "mesh — same AOT/zero-recompile/hot-reload "
+                        "contract (serve/programs.py)")
+    p.add_argument("--serve-mesh", type=int, default=0,
+                   help="devices per serving mesh for sharded modes (0 = "
+                        "all --serve-devices chips in ONE mesh). Must "
+                        "divide --serve-devices; the pool then runs one "
+                        "spanning engine per mesh group. Ignored (must be "
+                        "left 0) in replicated mode")
     p.add_argument("--max-inflight", type=int, default=0,
                    help="pipelined dispatch window: batches dispatched "
                         "but not yet completed (0 = auto: replicas+1 on "
@@ -161,8 +182,10 @@ class ServeContext:
     def __init__(self, engine, batcher, watcher, serve_log, sink,
                  model_name: str, boot_path: Optional[str] = None,
                  max_request_images: int = 1024, pool=None,
-                 max_inflight: int = 1) -> None:
+                 max_inflight: int = 1,
+                 serve_mode: str = "replicated") -> None:
         self.max_request_images = max_request_images
+        self.serve_mode = serve_mode
         self.engine = engine
         self.pool = pool
         self.max_inflight = max_inflight
@@ -237,9 +260,16 @@ class _Handler(BaseHTTPRequestHandler):
             }
             stats["buckets"] = list(ctx.engine.buckets)
             stats["model_epoch"] = ctx.engine.params_epoch
+            stats["serve_mode"] = ctx.serve_mode
             if ctx.pool is not None:
-                stats["serve_devices"] = ctx.pool.n_replicas
+                stats["serve_devices"] = ctx.pool.n_devices
                 stats["max_inflight"] = ctx.max_inflight
+                if ctx.serve_mode != "replicated":
+                    # The mesh shape the sharded plane is running:
+                    # loadgen's report and --expect-mode smoke read
+                    # these.
+                    stats["mesh_devices"] = ctx.pool.mesh_size
+                    stats["mesh_groups"] = ctx.pool.n_replicas
             self._reply(200, stats)
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
@@ -342,13 +372,82 @@ def create_server(args) -> ThreadingHTTPServer:
     model = get_model(args.model, **model_kwargs)
     template = create_train_state(model, jax.random.key(args.seed))
 
+    # Data-plane shape: --serve-devices chips (0 = all local devices),
+    # --serve-mode deciding how a forward spans them (replicated per
+    # chip, or tensor/expert-sharded over --serve-mesh-chip groups),
+    # with a --max-inflight pipelined dispatch window (0 = auto). The
+    # default (replicated, 1 device, window 1) is the single-device
+    # plane, built exactly as it always was. Resolved BEFORE the boot
+    # restore so the checkpoint walk can apply the layout gate per
+    # candidate.
+    from pytorch_distributed_mnist_tpu.serve.programs import (
+        check_checkpoint_layout,
+        validate_serve_mode,
+    )
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        checkpoint_parallel_layout,
+    )
+
+    devices = jax.local_devices()
+    n_devices = getattr(args, "serve_devices", 1)
+    if n_devices == 0:
+        n_devices = len(devices)
+    if n_devices < 0 or n_devices > len(devices):
+        raise SystemExit(
+            f"--serve-devices {n_devices}: this host has "
+            f"{len(devices)} local device(s)")
+    serve_mode = getattr(args, "serve_mode", "replicated")
+    serve_mesh = getattr(args, "serve_mesh", 0)
+    sharded = serve_mode != "replicated"
+    mesh_size = 1
+    if sharded:
+        mesh_size = serve_mesh or n_devices
+        if n_devices % mesh_size:
+            raise SystemExit(
+                f"--serve-mesh {mesh_size} must divide --serve-devices "
+                f"{n_devices} (the pool runs one spanning engine per "
+                f"mesh group)")
+    elif serve_mesh not in (0, 1):
+        mesh_size = serve_mesh  # rejected by the validation below
+    try:
+        # ONE rule source (programs.validate_serve_mode): a mesh on the
+        # replicated plane, a mode without a rule table for the model,
+        # and a sharded weight dim that doesn't divide the mesh (the
+        # template's shapes are every loadable checkpoint's shapes) all
+        # fail HERE with flag language, before any mesh or program is
+        # built.
+        validate_serve_mode(serve_mode, args.model, mesh_size,
+                            template.params if sharded else None)
+    except ValueError as exc:
+        raise SystemExit(str(exc)) from None
+
     # Boot restore walks newest -> oldest: one corrupt latest file must
     # not turn a server RESTART (the natural operator response to any
     # incident) into a total outage — the same availability stance the
     # hot-reload watcher takes, and the serving analog of --resume auto's
     # fall-back-to-next-older (quarantining stays the trainer's job).
+    # The parallel-layout gate applies PER CANDIDATE, on the meta-only
+    # read and before the expensive template load: a layout-mismatched
+    # newest file (a retrain under new parallelism flags sharing the
+    # directory) is skipped in favor of an older compatible epoch, and
+    # only when mismatches are the SOLE reason nothing is servable does
+    # boot fail — loudly, naming the valid --serve-mode choices, never
+    # by silently serving fresh-init params instead of the trained model.
     boot_path, params, epoch = None, None, None
+    layout_rejection = None  # newest layout-mismatch (path, message)
     for _, candidate in reversed(_epoch_checkpoints(args.checkpoint_dir)):
+        try:
+            try:
+                layout = checkpoint_parallel_layout(candidate)
+            except Exception:  # noqa: BLE001 - unreadable meta: let the
+                layout = None  # load attempt below classify the damage
+            check_checkpoint_layout(layout, serve_mode, args.model)
+        except ValueError as exc:
+            if layout_rejection is None:
+                layout_rejection = (candidate, str(exc))
+            print(f"WARNING: cannot serve checkpoint {candidate!r} "
+                  f"({exc}); trying the next-older epoch", flush=True)
+            continue
         try:
             params, epoch = load_params_for_serving(candidate, template)
             boot_path = candidate
@@ -359,6 +458,9 @@ def create_server(args) -> ThreadingHTTPServer:
     if boot_path is not None:
         print(f"serving checkpoint {boot_path!r} (epoch {epoch})",
               flush=True)
+    elif layout_rejection is not None:
+        raise SystemExit(
+            f"{layout_rejection[0]!r}: {layout_rejection[1]}")
     elif getattr(args, "require_checkpoint", False):
         raise SystemExit(
             f"--require-checkpoint: no loadable published checkpoint in "
@@ -376,24 +478,19 @@ def create_server(args) -> ThreadingHTTPServer:
         sink = JsonlSink(metrics_file)
         serve_log.set_sink(sink, source="serve")
 
-    # Data-plane shape: --serve-devices replicas (0 = all local devices)
-    # with a --max-inflight pipelined dispatch window (0 = auto). The
-    # default (1 replica, window 1) is the single-device plane, built
-    # exactly as it always was.
-    devices = jax.local_devices()
-    n_devices = getattr(args, "serve_devices", 1)
-    if n_devices == 0:
-        n_devices = len(devices)
-    if n_devices < 0 or n_devices > len(devices):
-        raise SystemExit(
-            f"--serve-devices {n_devices}: this host has "
-            f"{len(devices)} local device(s)")
     max_inflight = getattr(args, "max_inflight", 0)
     if max_inflight < 0:
         raise SystemExit(f"--max-inflight {max_inflight}: must be >= 0")
+    n_groups = n_devices // mesh_size
     if max_inflight == 0:
-        max_inflight = n_devices + 1 if n_devices > 1 else 1
-    pooled = n_devices > 1 or max_inflight > 1
+        # Auto window: one in-flight batch per engine plus one forming.
+        # A single sharded group still defaults to 2 — host staging of
+        # batch N+1 overlaps the mesh executing batch N.
+        if sharded:
+            max_inflight = n_groups + 1
+        else:
+            max_inflight = n_devices + 1 if n_devices > 1 else 1
+    pooled = n_devices > 1 or max_inflight > 1 or sharded
 
     def _tag(labels, epoch):
         # Row-tagged outputs (label, epoch): the epoch is captured WITH
@@ -413,6 +510,8 @@ def create_server(args) -> ThreadingHTTPServer:
             model.apply, params, devices=devices[:n_devices],
             buckets=_parse_buckets(args.buckets), serve_log=serve_log,
             params_epoch=epoch, workers=getattr(args, "workers", 4),
+            serve_mode=serve_mode, mesh_size=mesh_size,
+            model_name=args.model,
         )
         engine = pool
         pool.warmup()
@@ -443,9 +542,15 @@ def create_server(args) -> ThreadingHTTPServer:
     stats = compile_log.stats()["programs"]
     compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
                       if name.startswith("serve_forward_"))
-    plane = (f"{n_devices} replica(s) x {len(engine.buckets)} buckets, "
-             f"in-flight window {max_inflight}" if pooled
-             else f"{len(engine.buckets)} bucket programs")
+    if sharded:
+        plane = (f"{serve_mode}-sharded: {n_groups} mesh group(s) x "
+                 f"{mesh_size} chips x {len(engine.buckets)} buckets, "
+                 f"in-flight window {max_inflight}")
+    elif pooled:
+        plane = (f"{n_devices} replica(s) x {len(engine.buckets)} "
+                 f"buckets, in-flight window {max_inflight}")
+    else:
+        plane = f"{len(engine.buckets)} bucket programs"
     print(f"AOT-compiled {plane} "
           f"{list(engine.buckets)} in {time.perf_counter() - t0:.1f}s "
           f"(compile wall {compiled_ms:.0f} ms); steady-state serving "
@@ -456,10 +561,18 @@ def create_server(args) -> ThreadingHTTPServer:
         # engine is the pool in the pooled case: ONE host-side checkpoint
         # load fans out to an atomic (and stale-rejecting) per-replica
         # swap.
+        def _validate_reload(path: str) -> None:
+            # The boot-time layout gate, re-applied per reload: a
+            # checkpoint published with a mismatched training parallel
+            # layout is skipped (permanent for that file) instead of
+            # silently served under the wrong mode.
+            check_checkpoint_layout(
+                checkpoint_parallel_layout(path), serve_mode, args.model)
+
         watcher = CheckpointWatcher(
             args.checkpoint_dir, template, engine.swap_params,
             poll_interval_s=args.poll_interval, serve_log=serve_log,
-            current_path=boot_path,
+            current_path=boot_path, validate_fn=_validate_reload,
         ).start()
 
     httpd = ThreadingHTTPServer((args.host, args.port), _Handler)
@@ -468,7 +581,7 @@ def create_server(args) -> ThreadingHTTPServer:
         engine, batcher, watcher, serve_log, sink, args.model,
         boot_path=boot_path,
         max_request_images=getattr(args, "max_request_images", 1024),
-        pool=pool, max_inflight=max_inflight)
+        pool=pool, max_inflight=max_inflight, serve_mode=serve_mode)
     return httpd
 
 

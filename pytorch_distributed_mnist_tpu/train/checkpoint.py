@@ -93,6 +93,7 @@ def save_checkpoint(
     process_index: Optional[int] = None,
     layout: Optional[str] = None,
     keep_last: int = 0,
+    parallel_layout: Optional[Dict[str, Any]] = None,
 ) -> Optional[str]:
     """Write ``checkpoint_{epoch}.npz`` (+ best copy); returns the path.
 
@@ -102,6 +103,15 @@ def save_checkpoint(
     when a leaf spans non-addressable devices (multi-host sharded state),
     where every process contributes its own shards to a ``.ckpt``
     directory instead.
+
+    ``parallel_layout`` stamps the run's training parallelism into the
+    checkpoint meta (``{"tensor": w, "expert": w, "sequence": w,
+    "pipeline": w}`` widths; the CLI passes its flag values) — the
+    provenance the serve boot/reload layout gate
+    (``serve/programs.py::check_checkpoint_layout``) reads so an
+    expert/tensor-trained checkpoint cannot be silently served under a
+    mismatched ``--serve-mode``. ``None`` (library callers, old files)
+    writes no field and the gate passes everything.
     """
     if layout not in (None, "npz", "sharded"):
         raise ValueError(f"unknown checkpoint layout {layout!r}")
@@ -113,6 +123,7 @@ def save_checkpoint(
         return _save_sharded(
             named, epoch=epoch, best_acc=best_acc, is_best=is_best,
             directory=directory, pid=pid, keep_last=keep_last,
+            parallel_layout=parallel_layout,
         )
     if pid != 0:
         return None
@@ -124,6 +135,8 @@ def save_checkpoint(
         "leaf_names": [k for k, _ in named],
         "format_version": 1,
     }
+    if parallel_layout is not None:
+        meta["parallel_layout"] = dict(parallel_layout)
     buf = io.BytesIO()
     np.savez(buf, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8), **payload)
     path = os.path.join(directory, f"checkpoint_{epoch}.npz")
@@ -214,8 +227,10 @@ def _sharded_collect(named, pid: int) -> Tuple[Dict[str, np.ndarray], list]:
     return payload, index
 
 
-def _sharded_meta(named, epoch: int, best_acc: float) -> Dict[str, Any]:
-    return {
+def _sharded_meta(named, epoch: int, best_acc: float,
+                  parallel_layout: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    meta = {
         "epoch": epoch + 1,
         "best_acc": float(best_acc),
         "leaf_names": [k for k, _ in named],
@@ -224,6 +239,9 @@ def _sharded_meta(named, epoch: int, best_acc: float) -> Dict[str, Any]:
                    for _, v in named],
         "format_version": 2,
     }
+    if parallel_layout is not None:
+        meta["parallel_layout"] = dict(parallel_layout)
+    return meta
 
 
 def _sharded_write_files(tmp: str, pid: int, payload, index,
@@ -392,7 +410,8 @@ def _agree_phase_ok(error: Optional[BaseException], epoch: int,
 
 
 def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
-                  directory: str, pid: int, keep_last: int = 0) -> str:
+                  directory: str, pid: int, keep_last: int = 0,
+                  parallel_layout: Optional[Dict[str, Any]] = None) -> str:
     """Every process writes its owned shards; process 0 publishes the dir.
 
     Synchronous composition of the four phases; the AsyncCheckpointer
@@ -409,7 +428,8 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
         # propagate immediately, not be held hostage by an allgather.
         os.makedirs(tmp, exist_ok=True)  # this host's view of the dir
         payload, index = _sharded_collect(named, pid)
-        meta = _sharded_meta(named, epoch, best_acc) if pid == 0 else None
+        meta = (_sharded_meta(named, epoch, best_acc, parallel_layout)
+                if pid == 0 else None)
         _sharded_write_files(tmp, pid, payload, index, meta)
     except Exception as exc:
         err = exc
@@ -522,6 +542,22 @@ def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
         saved = [z[f"leaf_{i}"] for i in range(len(meta["leaf_names"]))]
     new_state = _restore_onto_template(path, meta["leaf_names"], saved, state)
     return new_state, int(meta["epoch"]), float(meta["best_acc"])
+
+
+def checkpoint_parallel_layout(path: str) -> Optional[Dict[str, Any]]:
+    """Read just the ``parallel_layout`` provenance stamp from a
+    checkpoint's meta — no array bytes touched, so the serve boot/reload
+    layout gate can run before (and far cheaper than) the template load.
+    Returns ``None`` for checkpoints saved without the stamp (library
+    callers, pre-stamp files): no provenance, nothing to contradict."""
+    if os.path.isdir(path):
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    else:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+    layout = meta.get("parallel_layout")
+    return dict(layout) if layout is not None else None
 
 
 def is_corrupt_checkpoint_error(exc: BaseException) -> bool:
@@ -746,7 +782,8 @@ class AsyncCheckpointer:
         try:
             os.makedirs(tmp, exist_ok=True)  # this host's view of the dir
             payload, index = _sharded_collect(named, pid)
-            meta = (_sharded_meta(named, epoch, kwargs["best_acc"])
+            meta = (_sharded_meta(named, epoch, kwargs["best_acc"],
+                                  kwargs.get("parallel_layout"))
                     if pid == 0 else None)
         except Exception as exc:
             self._error = exc

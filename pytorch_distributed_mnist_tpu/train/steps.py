@@ -160,6 +160,12 @@ def make_forward_program(apply_fn):
     their logits equal. Params are an argument rather than a closure
     capture so the serve engine can hot-swap checkpoints without
     invalidating its compiled executables (the no-recompile invariant).
+
+    How it spans devices is NOT decided here: the serve-side program
+    registry (``serve/programs.py``) lowers this same function per
+    model x serve-mode — single-device, or pjit over a tensor/expert
+    serving mesh with shardings derived from the training rule tables —
+    which is what keeps every serving plane's math pinned to eval's.
     """
 
     def forward(params, images):

@@ -375,3 +375,72 @@ def publish_zero_shards(state):
     return shard
 """
     assert _findings(src) == []
+
+
+# -- the serving-mesh lowering shape (ISSUE 8, serve/programs.py) ------------
+
+
+def test_fires_on_layout_agreement_under_process_index_in_mesh_boot():
+    """A multi-host serve boot gone wrong: only host 0 runs the
+    checkpoint-layout agreement after building the mesh groups — peers
+    block in the allgather forever."""
+    src = """
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def boot_sharded_plane(devices, mesh_size, layout):
+    groups = build_group_placements(devices, mesh_size)
+    if process_index() == 0:
+        allgather_records("serve_layout", layout)
+    return groups
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "boot_sharded_plane"
+    assert "allgather_records" in f.message
+
+
+def test_fires_on_early_return_before_mesh_ready_agreement():
+    """Early-return form: a non-zero host leaves the mesh-group builder
+    before the readiness agreement its peers wait in."""
+    src = """
+def build_and_agree(devices, mesh_size):
+    if process_index() != 0:
+        return None
+    groups = build_group_placements(devices, mesh_size)
+    agree("mesh_groups_ready", len(groups))
+    return groups
+"""
+    (f,) = _findings(src)
+    assert "early" in f.message
+
+
+def test_silent_on_single_process_mesh_group_build():
+    """The sanctioned programs.py shape: mesh building and pjit
+    lowering run identically on every process; the only host collective
+    sits outside any process_index-conditioned branch."""
+    src = """
+import jax
+from jax.sharding import Mesh
+
+def build_groups(devices, mesh_size, axis):
+    groups = [Mesh(devices[i:i + mesh_size], (axis,))
+              for i in range(0, len(devices), mesh_size)]
+    allgather_records("mesh_groups_ready", len(groups))
+    return groups
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_log_only_process_index_branch_before_agreement():
+    """A process_index() branch that only logs (both arms fall through)
+    does not make the later agreement asymmetric."""
+    src = """
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def boot_sharded_plane(devices, mesh_size):
+    groups = build_group_placements(devices, mesh_size)
+    if process_index() == 0:
+        print(f"sharded plane: {len(groups)} mesh groups")
+    allgather_records("serve_layout", True)
+    return groups
+"""
+    assert _findings(src) == []

@@ -439,3 +439,93 @@ def test_reports_engine_and_pool_lock_graph():
     assert pool["locks"] == ["EnginePool._lock"]
     batcher = graph["pytorch_distributed_mnist_tpu/serve/batcher.py"]
     assert batcher["locks"] == ["MicroBatcher._cv"]
+
+
+# -- the serving-mesh placement shape (ISSUE 8, serve/programs.py) -----------
+
+
+def test_fires_on_mesh_place_params_under_engine_lock():
+    """The sharded swap gone wrong: committing the checkpoint to the
+    mesh (device_put with the NamedSharding tree — the slow part)
+    while holding the engine lock stalls every dispatch for the full
+    H2D wall."""
+    src = """
+import threading, jax
+
+class ShardedEngine:
+    def __init__(self, placement):
+        self._lock = threading.Lock()
+        self.placement = placement
+
+    def swap_params(self, params, epoch):
+        with self._lock:
+            self._params = jax.device_put(params,
+                                          self.placement.param_shardings)
+            self._params_epoch = epoch
+"""
+    (f,) = _findings(src)
+    assert "device_put" in f.message and "ShardedEngine._lock" in f.message
+
+
+def test_fires_on_group_fanout_device_put_under_pool_lock():
+    """A pool fan-out that walks mesh groups UNDER the pool lock while
+    re-placing params per group serializes the whole fleet behind N
+    device_puts."""
+    src = """
+import threading, jax
+
+class Pool:
+    def __init__(self, groups):
+        self._lock = threading.Lock()
+        self.groups = groups
+
+    def swap_params(self, params):
+        with self._lock:
+            for group in self.groups:
+                group.params = jax.device_put(params, group.shardings)
+"""
+    (f,) = _findings(src)
+    assert "device_put" in f.message and "Pool._lock" in f.message
+
+
+def test_silent_on_place_outside_install_under_lock():
+    """The sanctioned sharded swap (the engine's rule, unchanged by the
+    mesh plane): the NamedSharding device_put runs OUTSIDE the lock;
+    only the reference install + epoch compare happen under it."""
+    src = """
+import threading, jax
+
+class ShardedEngine:
+    def __init__(self, placement):
+        self._lock = threading.Lock()
+        self.placement = placement
+
+    def swap_params(self, params, epoch):
+        placed = jax.device_put(params, self.placement.param_shardings)
+        with self._lock:
+            if self._params_epoch is not None and epoch < self._params_epoch:
+                return False
+            self._params = placed
+            self._params_epoch = epoch
+            return True
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_lock_free_mesh_group_build():
+    """Building mesh groups (mesh construction, sharding derivation,
+    pjit lowering) is lock-free by design — nothing here may ever need
+    a lock-graph node."""
+    src = """
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+def build_groups(devices, mesh_size, axis, params):
+    groups = []
+    for i in range(0, len(devices), mesh_size):
+        mesh = Mesh(devices[i:i + mesh_size], (axis,))
+        groups.append(jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), params))
+    return groups
+"""
+    assert _findings(src) == []

@@ -206,3 +206,78 @@ def zero_step(state, batch, debug_buckets=False):
 prog = jax.jit(zero_step, static_argnames=("debug_buckets",))
 """
     assert _findings(src) == []
+
+
+# -- the serving-mesh lowering shape (ISSUE 8, serve/programs.py) ------------
+
+
+def test_fires_on_literal_into_compiled_mesh_bucket():
+    """The sharded engine's bucket executables take (params, staged
+    batch); a raw literal where the batch belongs re-keys a compile
+    through the jit fallback — the steady-state violation the per
+    bucket x mode bench verdict fails loudly on."""
+    src = """
+import jax
+
+def warm_and_drive(pjit_forward, params_spec, image_spec, params):
+    compiled = pjit_forward.lower(params_spec, image_spec).compile()
+    return compiled(params, 128)
+"""
+    (f,) = _findings(src)
+    assert "scalar" in f.message
+
+
+def test_fires_on_mode_config_default_on_mesh_forward():
+    """A debug/interpret toggle with a default on the pjit-lowered
+    serve forward, jitted without statics: each distinct value
+    re-traces every bucket program of the mesh group."""
+    src = """
+import jax
+
+def make_serve_forward(apply_fn):
+    def forward(params, images, interpret=False):
+        return apply_fn(params, images, train=False)
+
+    return jax.jit(forward, in_shardings=None, out_shardings=None)
+"""
+    (f,) = _findings(src)
+    assert "interpret" in f.message
+
+
+def test_silent_on_clean_bucket_lowering_loop():
+    """The sanctioned programs/engine shape: one lower().compile() per
+    bucket against ShapeDtypeStruct specs, the compiled product called
+    with arrays only; serve mode and rules are closure-bound at build
+    time."""
+    src = """
+import jax
+import numpy as np
+
+def warm_buckets(pjit_forward, params_spec, buckets, input_shape):
+    compiled = {}
+    for bucket in buckets:
+        spec = jax.ShapeDtypeStruct((bucket,) + input_shape, np.float32)
+        compiled[bucket] = pjit_forward.lower(params_spec, spec).compile()
+    return compiled
+
+def drive(compiled, params, staged):
+    return compiled[staged.shape[0]](params, staged)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_closure_bound_mode_rules():
+    """Mode/axis/rule-table configuration bound in the factory closure
+    (never a parameter of the traced forward) cannot re-key a compile."""
+    src = """
+import jax
+
+def make_serve_forward(apply_fn, mode, rules, shardings):
+    axis = rules[mode]
+
+    def forward(params, images):
+        return apply_fn(params, images, train=False)
+
+    return jax.jit(forward, in_shardings=shardings, out_shardings=None)
+"""
+    assert _findings(src) == []

@@ -257,3 +257,94 @@ def body(state, grads, axis_size=8):
 step = jax.shard_map(body, mesh=None, in_specs=None, out_specs=None)
 """
     assert _findings(src) == []
+
+
+# -- the serving-mesh lowering shape (ISSUE 8, serve/programs.py) ------------
+
+
+def test_fires_on_print_in_mesh_lowered_serve_forward():
+    """A debug print inside the registry-built forward (discovered
+    through the pjit-with-shardings factory idiom programs.py uses) runs
+    at trace time — once per bucket lowering, never per request — so
+    it is a lie the moment it ships."""
+    src = """
+import jax
+
+def build_serve_program(apply_fn, param_shardings, io_sharding):
+    def forward(params, images):
+        print("serving", images.shape[0], "rows")
+        return apply_fn(params, images, train=False)
+
+    return jax.jit(forward, in_shardings=(param_shardings, io_sharding),
+                   out_shardings=io_sharding)
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "forward" and "trace time" in f.message
+
+
+def test_fires_on_host_timing_in_mesh_forward_helper():
+    """Timing a mesh group's forward belongs on the host around the
+    compiled bucket executable; a helper under the traced root is caught
+    by the call-graph walk."""
+    src = """
+import jax, time
+
+def _traced_span(apply_fn, params, images):
+    t0 = time.perf_counter()
+    out = apply_fn(params, images, train=False)
+    record_ms(time.perf_counter() - t0)
+    return out
+
+def build_serve_program(apply_fn, shardings):
+    def forward(params, images):
+        return _traced_span(apply_fn, params, images)
+
+    return jax.jit(forward, in_shardings=shardings, out_shardings=None)
+"""
+    messages = " | ".join(f.message for f in _findings(src))
+    assert "perf_counter" in messages
+
+
+def test_silent_on_clean_mesh_placement_forward():
+    """The sanctioned programs.py shape: the traced forward is pure; the
+    mesh build, sharding derivation, and divisibility validation all run
+    at build time on the host, outside any traced root."""
+    src = """
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+def build_placement(apply_fn, devices, axis, rules, params):
+    mesh = Mesh(devices, (axis,))
+    shardings = jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, rules.get(path, P())), params)
+
+    def forward(params_, images):
+        return apply_fn(params_, images, train=False)
+
+    return jax.jit(forward, in_shardings=(shardings, NamedSharding(mesh, P())),
+                   out_shardings=NamedSharding(mesh, P()))
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_build_time_mesh_validation_raise():
+    """Build-time rejection of non-dividing weight dims (host Python
+    over static shapes, raising with flag language) is sanctioned — it
+    never runs under trace."""
+    src = """
+import jax
+
+def validate_mode(params, mesh_devices):
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if leaf.shape[0] % mesh_devices:
+            raise ValueError(f"{path} dim 0 does not divide {mesh_devices}")
+
+def build(apply_fn, params, mesh_devices, shardings):
+    validate_mode(params, mesh_devices)
+
+    def forward(params_, images):
+        return apply_fn(params_, images, train=False)
+
+    return jax.jit(forward, in_shardings=shardings, out_shardings=None)
+"""
+    assert _findings(src) == []

@@ -62,3 +62,27 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
     programs = report["compile_stats"]["programs"]
     assert any(name.endswith("@r3") for name in programs)
     assert any(name.endswith("@r0") for name in programs)
+
+    # The sharded block: one entry per mode (tensor x vit, expert x
+    # moe_mlp) with the ABBA-paired vs-replicated ratio, the
+    # mesh-scaling curve at fixed chip count, and the per bucket x mode
+    # zero-recompile verdict. This CPU run is a forced-multi-device
+    # world with the Eigen isolation, so it must carry the
+    # BENCH_r05-style fallback caveat.
+    sharded = report["sharded"]
+    assert report["cpu_serve_devices_isolated"] is True
+    assert "CPU fallback" in sharded["caveat"]
+    for mode, model_name in (("tensor", "vit"), ("expert", "moe_mlp")):
+        block = sharded[mode]
+        assert block["model"] == model_name
+        assert block["requests_per_sec"] > 0
+        assert block["vs_replicated"] > 0
+        assert len(block["pairs"]) == 4
+        curve = block["mesh_scaling"]
+        assert [pt["mesh_devices"] for pt in curve] == [1, 2, 4]
+        assert [pt["mesh_groups"] for pt in curve] == [4, 2, 1]
+        assert all(pt["requests_per_sec"] > 0 for pt in curve)
+        assert block["zero_steady_state_recompiles"] is True
+    # Per bucket x mode compile rows landed under the @{mode} names.
+    assert any("@tensor" in name for name in programs)
+    assert any("@expert" in name for name in programs)

@@ -1,0 +1,435 @@
+"""Sharded serving (serve/programs.py): the forward-program registry,
+mesh-group placement, exactness pins against the single-device forward
+(including under live hot-reload and exact-bucket padding), per
+bucket x mode zero-recompile invariants, the checkpoint parallel-layout
+gate at boot and reload, and the analyzer cleanliness of the new
+module."""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    normalize_images,
+    synthetic_dataset,
+)
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.models.registry import model_field_default
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher
+from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
+from pytorch_distributed_mnist_tpu.serve.programs import (
+    SERVE_MODES,
+    build_group_placements,
+    build_placement,
+    check_checkpoint_layout,
+    register_serve_mode,
+    servable_modes,
+    validate_serve_mode,
+)
+from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
+from pytorch_distributed_mnist_tpu.train.checkpoint import (
+    checkpoint_parallel_layout,
+    save_checkpoint,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.utils.profiling import ServeLog, compile_log
+
+pytestmark = pytest.mark.serve
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def vit_setup():
+    model = get_model("vit", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    images, _ = synthetic_dataset(32, seed=3)
+    return model, state, images
+
+
+@pytest.fixture(scope="module")
+def moe_setup():
+    model = get_model("moe_mlp", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(1))
+    images, _ = synthetic_dataset(32, seed=4)
+    return model, state, images
+
+
+def _direct_labels(model, state, raw_images):
+    logits = model.apply(state.params, jnp.asarray(
+        normalize_images(raw_images)), train=False)
+    return np.argmax(np.asarray(logits), axis=-1)
+
+
+# -- the registry ------------------------------------------------------------
+
+
+def test_servable_modes_per_model():
+    assert servable_modes("vit") == ["replicated", "tensor"]
+    assert servable_modes("moe_mlp") == ["replicated", "expert"]
+    assert servable_modes("cnn") == ["replicated"]
+    assert SERVE_MODES == ["replicated", "expert", "tensor"]
+
+
+def test_unservable_model_rejected_with_modes_named(vit_setup):
+    with pytest.raises(ValueError, match=r"no sharding rule table.*cnn"):
+        validate_serve_mode("tensor", "cnn", 2)
+    with pytest.raises(ValueError, match=r"\['replicated', 'tensor'\]"):
+        validate_serve_mode("expert", "vit", 2)
+    with pytest.raises(ValueError, match="unknown serve mode"):
+        validate_serve_mode("ring", "vit", 2)
+
+
+def test_non_dividing_weight_dim_rejected(vit_setup):
+    _, state, _ = vit_setup
+    # The ViT's sharded dims are 64/192/256-sized: 7 divides none; the
+    # rejection names the leaf, the dim, and the fix.
+    with pytest.raises(ValueError, match=r"param .* dim .* does not"):
+        validate_serve_mode("tensor", "vit", 7, state.params)
+    # A dividing mesh passes.
+    validate_serve_mode("tensor", "vit", 2, state.params)
+
+
+def test_replicated_needs_no_mesh():
+    validate_serve_mode("replicated", "cnn", 1)
+    with pytest.raises(ValueError, match="sharded mode"):
+        validate_serve_mode("replicated", "cnn", 2)
+
+
+def test_register_serve_mode_rejects_duplicates():
+    with pytest.raises(ValueError, match="already registered"):
+        register_serve_mode("tensor", "model", {})
+    with pytest.raises(ValueError, match="already registered"):
+        register_serve_mode("replicated", "x", {})
+
+
+def test_model_field_default_registry_helper():
+    assert model_field_default("vit", "num_heads") == 4
+    assert model_field_default("moe_mlp", "num_experts") == 8
+    with pytest.raises(ValueError, match="no field"):
+        model_field_default("vit", "not_a_field")
+
+
+def test_group_partition_names_and_spans(moe_setup):
+    _, state, _ = moe_setup
+    devices = jax.local_devices()
+    groups = build_group_placements("expert", "moe_mlp", devices[:8], 4,
+                                    state.params)
+    assert [g.name for g in groups] == ["expert.g0", "expert.g1"]
+    spans = [set(map(str, g.devices)) for g in groups]
+    assert len(spans[0]) == 4 and len(spans[1]) == 4
+    assert spans[0].isdisjoint(spans[1])
+    # One group spanning everything gets the bare @{mode} name.
+    (single,) = build_group_placements("expert", "moe_mlp", devices[:8],
+                                       8, state.params)
+    assert single.name == "expert" and len(single.devices) == 8
+    with pytest.raises(ValueError, match="partition"):
+        build_group_placements("expert", "moe_mlp", devices[:3], 2,
+                               state.params)
+
+
+# -- exactness: sharded logits == single-device forward ----------------------
+
+
+@pytest.mark.parametrize("model_name,mode,mesh", [
+    ("vit", "tensor", 2),
+    ("moe_mlp", "expert", 4),
+])
+def test_sharded_logits_match_single_device(model_name, mode, mesh,
+                                            vit_setup, moe_setup):
+    model, state, images = vit_setup if model_name == "vit" else moe_setup
+    base = InferenceEngine(model.apply, state.params, buckets=(8,))
+    base.warmup()
+    placement = build_placement(mode, model_name,
+                                jax.local_devices()[:mesh], state.params)
+    eng = InferenceEngine(model.apply, state.params, buckets=(8,),
+                          placement=placement, name=placement.name)
+    eng.warmup()
+    ref, _ = base.logits_with_epoch(images[:8])
+    got, _ = eng.logits_with_epoch(images[:8])
+    # The mesh program reassociates the partial-sum reductions, so the
+    # cross-plane pin is allclose (tight), with argmax identical.
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.argmax(got, -1), np.argmax(ref, -1))
+    # Padded (5 -> bucket 8) rows match the single-device forward too.
+    ref5, _ = base.logits_with_epoch(images[:5])
+    got5, _ = eng.logits_with_epoch(images[:5])
+    np.testing.assert_allclose(got5, ref5, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("model_name,mode", [("vit", "tensor"),
+                                             ("moe_mlp", "expert")])
+def test_exact_bucket_vs_staged_path_bitwise_on_mesh(model_name, mode,
+                                                     vit_setup, moe_setup):
+    """On the SHARDED plane, the exact-fit no-copy fast path and the
+    padded staging path feed the device identical bytes: an 8-row f32
+    C-contiguous batch (no copy) and a non-contiguous view of the same
+    rows (forced through the staging buffer) produce BITWISE-equal
+    logits."""
+    model, state, images = vit_setup if model_name == "vit" else moe_setup
+    placement = build_placement(mode, model_name, jax.local_devices()[:2],
+                                state.params)
+    eng = InferenceEngine(model.apply, state.params, buckets=(8,),
+                          placement=placement, name=placement.name)
+    eng.warmup()
+    exact = normalize_images(images[:8])
+    assert exact.dtype == np.float32 and exact.flags["C_CONTIGUOUS"]
+    staged_src = np.asfortranarray(exact)  # same values, staging path
+    assert not staged_src.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(eng.logits(exact),
+                                  eng.logits(staged_src))
+
+
+@pytest.mark.parametrize("model_name,mode", [("vit", "tensor"),
+                                             ("moe_mlp", "expert")])
+def test_zero_steady_state_recompiles_per_bucket_and_mode(
+        model_name, mode, vit_setup, moe_setup):
+    model, state, images = vit_setup if model_name == "vit" else moe_setup
+    placement = build_placement(mode, model_name, jax.local_devices()[:2],
+                                state.params)
+    eng = InferenceEngine(model.apply, state.params, buckets=(1, 8),
+                          placement=placement, name=placement.name)
+    eng.warmup()
+    programs = compile_log.stats()["programs"]
+    expected = {f"serve_forward_b{b}@{mode}" for b in (1, 8)}
+    assert expected <= set(programs)
+    before = {n: programs[n]["backend_compiles"] for n in expected}
+    eng.logits(images[:1])
+    eng.logits(images[:8])
+    eng.logits(images[:5])  # padded
+    eng.logits(images[:20])  # chunked through the top bucket
+    after = compile_log.stats()["programs"]
+    assert {n: after[n]["backend_compiles"] for n in expected} == before
+
+
+# -- the pool's mesh groups --------------------------------------------------
+
+
+def _drive_pool(pool, request_stacks, max_inflight):
+    def complete(handle):
+        labels, epoch = pool.predict_complete(handle)
+        tag = np.full_like(labels, -1 if epoch is None else epoch)
+        return np.stack([labels, tag], axis=1)
+
+    results = []
+    with MicroBatcher(None, max_batch=pool.max_batch, max_wait_s=0.002,
+                      dispatch_fn=pool.dispatch, complete_fn=complete,
+                      max_inflight=max_inflight) as batcher:
+        pendings = [batcher.submit(pool.preprocess(stack))
+                    for stack in request_stacks]
+        for p in pendings:
+            out = batcher.result(p, timeout=60.0)
+            results.append((out[:, 0].tolist(), sorted(set(out[:, 1]))))
+    return results
+
+
+def test_sharded_pool_matches_replicated_pool(moe_setup):
+    """The mesh-group plane is invisible to clients: the same requests
+    through a replicated 4-replica pool and a 2-group expert-sharded
+    pool (same 4 chips) produce identical predictions and epochs, both
+    matching the direct forward."""
+    model, state, images = moe_setup
+    stacks = [images[i:i + 1 + (i % 3)] for i in range(16)]
+    repl = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:4], buckets=(1, 4, 8),
+                      params_epoch=2)
+    repl.warmup()
+    shard = EnginePool(model.apply, state.params,
+                       devices=jax.local_devices()[:4], buckets=(1, 4, 8),
+                       params_epoch=2, serve_mode="expert", mesh_size=2,
+                       model_name="moe_mlp")
+    assert shard.n_replicas == 2 and shard.n_devices == 4
+    shard.warmup()
+    got = _drive_pool(shard, stacks, max_inflight=3)
+    assert got == _drive_pool(repl, stacks, max_inflight=5)
+    for stack, (labels, epochs) in zip(stacks, got):
+        assert labels == _direct_labels(model, state, stack).tolist()
+        assert epochs == [2]
+
+
+def test_sharded_pool_snapshot_and_least_loaded_groups(moe_setup):
+    model, state, images = moe_setup
+    log = ServeLog()
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:4], buckets=(4,),
+                      serve_log=log, serve_mode="expert", mesh_size=2,
+                      model_name="moe_mlp")
+    pool.warmup()
+    handles = [pool.dispatch(pool.preprocess(images[i:i + 2]))
+               for i in range(2)]
+    assert sorted(h.replica.name for h in handles) \
+        == ["expert.g0", "expert.g1"]
+    snap = pool.snapshot()
+    assert sorted(snap) == ["expert.g0", "expert.g1"]
+    for row in snap.values():
+        assert row["mode"] == "expert" and len(row["devices"]) == 2
+        assert row["pending"] == 1
+    for h in handles:
+        pool.complete(h)
+    assert all(r["pending"] == 0 for r in pool.snapshot().values())
+
+
+def test_pool_sharded_requires_model_name_and_mesh_fit(moe_setup):
+    model, state, _ = moe_setup
+    with pytest.raises(ValueError, match="model_name"):
+        EnginePool(model.apply, state.params,
+                   devices=jax.local_devices()[:4], serve_mode="expert",
+                   mesh_size=2)
+    with pytest.raises(ValueError, match="sharded serve_mode"):
+        EnginePool(model.apply, state.params,
+                   devices=jax.local_devices()[:4], mesh_size=2)
+
+
+def test_hot_reload_no_mixed_epochs_on_sharded_pool(moe_setup):
+    """The no-mixed-epoch-within-a-batch guarantee survives the sharded
+    plane: hammer requests through a 2-group expert pool while params
+    hot-swap; every reply carries exactly one installed epoch, and the
+    final swap serves everywhere with logits pinned to the direct
+    forward."""
+    model, state, images = moe_setup
+    states = {e: create_train_state(model, jax.random.key(e))
+              for e in (10, 11, 12)}
+    pool = EnginePool(model.apply, states[10].params,
+                      devices=jax.local_devices()[:4], buckets=(1, 8),
+                      params_epoch=10, serve_mode="expert", mesh_size=2,
+                      model_name="moe_mlp")
+    pool.warmup()
+
+    def complete(handle):
+        labels, epoch = pool.predict_complete(handle)
+        tag = np.full_like(labels, -1 if epoch is None else epoch)
+        return np.stack([labels, tag], axis=1)
+
+    failures = []
+    stop = threading.Event()
+
+    def hammer(wid):
+        i = 0
+        while not stop.is_set():
+            stack = pool.preprocess(images[(wid + i) % 24:
+                                           (wid + i) % 24 + 4])
+            out = batcher.predict(stack, timeout=30.0)
+            epochs = set(out[:, 1].tolist())
+            if len(epochs) != 1 or not epochs <= {10, 11, 12}:
+                failures.append(out[:, 1].tolist())
+            i += 1
+
+    with MicroBatcher(None, max_batch=8, max_wait_s=0.002,
+                      dispatch_fn=pool.dispatch, complete_fn=complete,
+                      max_inflight=3) as batcher:
+        threads = [threading.Thread(target=hammer, args=(w,), daemon=True)
+                   for w in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        for epoch in (11, 12):
+            assert pool.swap_params(states[epoch].params, epoch=epoch) == 2
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+    assert not failures, failures[:5]
+    labels, epoch = pool.predict_complete(
+        pool.dispatch(pool.preprocess(images[:8])))
+    assert epoch == 12
+    np.testing.assert_array_equal(
+        labels, _direct_labels(model, states[12], images[:8]))
+
+
+# -- the checkpoint parallel-layout gate -------------------------------------
+
+
+def test_check_checkpoint_layout_rules():
+    check_checkpoint_layout(None, "replicated", "cnn")  # no provenance
+    check_checkpoint_layout({"tensor": 1, "expert": 1}, "replicated", "cnn")
+    check_checkpoint_layout({"expert": 4}, "expert", "moe_mlp")
+    check_checkpoint_layout({"sequence": 4}, "replicated", "vit")  # SP ok
+    with pytest.raises(ValueError, match="--serve-mode expert"):
+        check_checkpoint_layout({"expert": 4}, "replicated", "moe_mlp")
+    with pytest.raises(ValueError, match="--serve-mode tensor"):
+        check_checkpoint_layout({"tensor": 2}, "replicated", "vit")
+    with pytest.raises(ValueError, match="--serve-mode tensor"):
+        check_checkpoint_layout({"tensor": 2}, "expert", "vit")
+    with pytest.raises(ValueError, match="pipeline"):
+        check_checkpoint_layout({"pipeline": 2}, "replicated", "vit")
+
+
+def test_parallel_layout_round_trips_through_meta(tmp_path, moe_setup):
+    model, state, _ = moe_setup
+    layout = {"tensor": 1, "sequence": 1, "expert": 4, "pipeline": 1}
+    path = save_checkpoint(state, epoch=3, best_acc=0.1, is_best=False,
+                           directory=str(tmp_path), process_index=0,
+                           parallel_layout=layout)
+    assert checkpoint_parallel_layout(path) == layout
+    # A stamp-less save reads back None (legacy files, library callers).
+    bare = save_checkpoint(state, epoch=4, best_acc=0.1, is_best=False,
+                           directory=str(tmp_path), process_index=0)
+    assert checkpoint_parallel_layout(bare) is None
+
+
+def test_watcher_skips_layout_mismatched_reload(tmp_path, moe_setup):
+    """A published checkpoint whose recorded layout contradicts the
+    serving mode is SKIPPED (recorded as a reload failure, permanent for
+    that file); the server keeps serving, and the next layout-clean
+    publish loads normally."""
+    model, state, images = moe_setup
+    template = create_train_state(model, jax.random.key(1))
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:2], buckets=(8,),
+                      params_epoch=0)
+    pool.warmup()
+    log = ServeLog()
+
+    def validate(path):
+        check_checkpoint_layout(checkpoint_parallel_layout(path),
+                                "replicated", "moe_mlp")
+
+    watcher = CheckpointWatcher(str(tmp_path), template, pool.swap_params,
+                                serve_log=log, validate_fn=validate)
+    bad = create_train_state(model, jax.random.key(7))
+    save_checkpoint(bad, epoch=5, best_acc=0.5, is_best=False,
+                    directory=str(tmp_path), process_index=0,
+                    parallel_layout={"expert": 4})
+    assert not watcher.poll_once()
+    assert log.snapshot()["reload_failures"] == 1
+    assert [r.engine.params_epoch for r in pool.replicas] == [0, 0]
+    # Permanent for the file: the next poll does not retry it.
+    assert not watcher.poll_once()
+    assert log.snapshot()["reload_failures"] == 1
+    good = create_train_state(model, jax.random.key(8))
+    save_checkpoint(good, epoch=6, best_acc=0.5, is_best=False,
+                    directory=str(tmp_path), process_index=0,
+                    parallel_layout={"expert": 1})
+    assert watcher.poll_once()
+    assert [r.engine.params_epoch for r in pool.replicas] == [6, 6]
+    np.testing.assert_array_equal(
+        pool.predict_complete(pool.dispatch(
+            pool.preprocess(images[:8])))[0],
+        _direct_labels(model, good, images[:8]))
+
+
+# -- analyzer cleanliness ----------------------------------------------------
+
+
+@pytest.mark.lint
+def test_programs_module_clean_under_analyzer():
+    """The new sharded-serve module is pinned clean under the four
+    checkers its code could plausibly trip: collective symmetry (mesh
+    building), trace purity (the pjit-lowered forward), recompile
+    hazard (bucket lowering), lock discipline (it owns no locks and
+    must not acquire any engine lock around device work)."""
+    from tools.analyzer import run_analysis
+
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve",
+                      "programs.py")],
+        checkers=["collective-symmetry", "trace-purity",
+                  "recompile-hazard", "lock-discipline"],
+        baseline=None)
+    assert result.findings == []

@@ -214,6 +214,11 @@ def main(argv=None) -> int:
                         "--serve-devices data plane) whose batch counts "
                         "sum to the server's batch total; 0 skips the "
                         "check")
+    p.add_argument("--expect-mode", type=str, default=None,
+                   help="smoke: additionally require /stats to report "
+                        "this serve_mode (e.g. 'tensor' — the sharded "
+                        "--serve-mode data plane), with the mesh-shape "
+                        "fields present for sharded modes")
     args = p.parse_args(argv)
 
     url = args.url.rstrip("/")
@@ -230,6 +235,23 @@ def main(argv=None) -> int:
                                bodies, args.timeout)
     out = report(collector, time.perf_counter() - t0,
                  "closed" if args.smoke else args.mode)
+    # Data-plane shape from /stats on EVERY run (not just smoke): a
+    # loadgen report without the serve mode and mesh shape can't say
+    # WHAT it measured. Smoke mode reuses its own /stats fetch below
+    # (one snapshot feeds both the assertions and these fields);
+    # otherwise best-effort — a server predating the fields (or an
+    # unreachable /stats) just omits them.
+    def _shape_fields(stats: dict) -> None:
+        for key in ("serve_mode", "serve_devices", "mesh_devices",
+                    "mesh_groups", "max_inflight"):
+            if key in stats:
+                out[key] = stats[key]
+
+    if not args.smoke:
+        try:
+            _shape_fields(_get_json(url, "/stats", args.timeout))
+        except Exception:  # noqa: BLE001 - shape fields are advisory
+            pass
 
     rc = 0
     if args.smoke:
@@ -239,6 +261,7 @@ def main(argv=None) -> int:
         try:
             health = _get_json(url, "/healthz", args.timeout)
             stats = _get_json(url, "/stats", args.timeout)
+            _shape_fields(stats)
             out["healthz"] = health
             out["stats_keys"] = sorted(stats)
             smoke_ok = (
@@ -262,6 +285,17 @@ def main(argv=None) -> int:
                     and len(replicas) == args.expect_replicas
                     and sum(r.get("batches", 0) for r in replicas.values())
                     == stats.get("batches")
+                )
+            if args.expect_mode:
+                # The sharded data plane really is the requested one:
+                # /stats names the mode, and sharded modes carry their
+                # mesh shape (mesh_devices x mesh_groups).
+                smoke_ok = (
+                    smoke_ok
+                    and stats.get("serve_mode") == args.expect_mode
+                    and (args.expect_mode == "replicated"
+                         or (stats.get("mesh_devices", 0) >= 1
+                             and stats.get("mesh_groups", 0) >= 1))
                 )
         except Exception as exc:  # noqa: BLE001
             out["smoke_error"] = repr(exc)
