@@ -44,6 +44,7 @@ checkpoint (``:230-255``).
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import sys
 from typing import Optional
@@ -63,7 +64,7 @@ from pytorch_distributed_mnist_tpu.parallel.mesh import (
     data_replica_coords,
     make_mesh,
 )
-from pytorch_distributed_mnist_tpu.runtime import supervision
+from pytorch_distributed_mnist_tpu.runtime import elastic, supervision
 from pytorch_distributed_mnist_tpu.train.checkpoint import (
     is_corrupt_checkpoint_error,
     quarantine_checkpoint,
@@ -314,6 +315,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "the epoch's; a sharded directory is published at "
                         "the next epoch's save via a main-thread barrier, "
                         "Orbax-style deferred commit)")
+    p.add_argument("--elastic", action="store_true",
+                   help="survive a host loss by SHRINKING the world "
+                        "instead of exiting: run the spawned world "
+                        "under the elastic supervisor "
+                        "(runtime/elastic.py) — on a PeerFailure the "
+                        "survivors agree the shrunk membership, are "
+                        "re-execed as a smaller world, and resume from "
+                        "the last published checkpoint (cross-world "
+                        "checkpoint resharding), with no operator "
+                        "action. Requires --spawn (the supervisor owns "
+                        "the worker processes; on a real pod that "
+                        "actor is the cluster manager, for which "
+                        "runtime/elastic.py::supervise is the "
+                        "reference implementation)")
+    p.add_argument("--min-world", type=int, default=1, metavar="W",
+                   help="elastic floor: stop shrinking (exit code "
+                        f"{elastic.EXIT_FLOOR}) when fewer than W "
+                        "healthy hosts remain, instead of training on "
+                        "a world this small (default 1: a single "
+                        "survivor finishes the job alone)")
     p.add_argument("--agreement-timeout", type=float, default=None,
                    metavar="SECONDS",
                    help="watchdog deadline for every multi-host agreement "
@@ -595,6 +616,40 @@ def _resolve_resume_auto(args) -> str:
     return leader.detail
 
 
+def _note_cross_world_resume(resume_path: str) -> None:
+    """Meta-only inspection before the resume load: when the checkpoint
+    was saved by a DIFFERENT world (the elastic shrink path, or any
+    relaunch at a new topology), say so up front — the restore is a
+    deliberate cross-world reshard, recorded as a ``checkpoint_reshard``
+    event, not a surprise to reconstruct from a failed load. Best-effort
+    on purpose: unreadable meta is left for the load itself to classify
+    (corruption vs mismatch), pre-stamp checkpoints carry no provenance.
+    """
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        checkpoint_world,
+    )
+
+    try:
+        saved = checkpoint_world(resume_path)
+    except Exception:  # noqa: BLE001 - the load will classify the damage
+        return
+    if not saved:
+        return
+    current = {"processes": process_count(),
+               "devices": jax.device_count()}
+    if saved != current:
+        failure_events.record(
+            "checkpoint_reshard",
+            f"{resume_path}: saved by a {saved['processes']}-process/"
+            f"{saved['devices']}-device world; resharding onto this "
+            f"{current['processes']}-process/{current['devices']}-device "
+            f"world", saved=saved, current=current)
+        log0(f"=> checkpoint '{resume_path}' was saved at world "
+             f"{saved['processes']}x{saved['devices']} (processes x "
+             f"devices); resharding onto {current['processes']}x"
+             f"{current['devices']}")
+
+
 def _resume_supervised(args, state):
     """Resolve + load the resume checkpoint under the agreement protocol.
 
@@ -630,6 +685,9 @@ def _resume_supervised(args, state):
                 return state, 0, 0.0, ""
         else:
             resume_path = args.resume
+        if resume_path and (os.path.isfile(resume_path)
+                            or os.path.isdir(resume_path)):
+            _note_cross_world_resume(resume_path)
         if not (multi and resume_path):
             try:
                 new_state, start_epoch, best_acc = try_resume(
@@ -747,9 +805,19 @@ def run(args, epoch_callback=None) -> dict:
         # KeyboardInterrupt, for already-agreed failures (PeerFailure /
         # watchdog aborts), and when the saver's __exit__ already sent
         # the pill for this exception (idempotent per exception).
+        # write_survivor_record is the elastic runtime's membership
+        # vote (runtime/elastic.py): under an elastic supervisor, a
+        # PeerFailure/transport unwind serializes this host's survival
+        # and the dead set before exit, so the supervisor can rebuild
+        # the shrunk world; a no-op everywhere else. It runs FIRST —
+        # local file I/O, sub-second — because a transport-shaped raw
+        # error would otherwise sit in deliver_poison's bounded (but up
+        # to 60s) undeliverable-pill attempt while the supervisor's
+        # settle deadline counts this healthy host toward the dead.
         # escalate_exit arms a hard-exit timer ONLY for peer-failure
         # deaths, whose interpreter teardown would otherwise hang in the
         # distributed shutdown barrier the dead peers can never join.
+        elastic.write_survivor_record(exc)
         supervision.deliver_poison(exc)
         supervision.escalate_exit(exc)
         raise
@@ -822,6 +890,11 @@ def _run_body(args, epoch_callback=None) -> dict:
         failure_events.set_sink(metrics_sink, source="train")
     if agreement_timeout:
         log0(f"agreement watchdog: {agreement_timeout:g}s deadline")
+    # Elastic rebuild provenance: when this process is the first
+    # generation after a shrink, record the world_shrunk event (old/new
+    # membership) — after the reset + sink attach above, so it reaches
+    # both the run summary and the metrics JSONL.
+    elastic.note_rebuilt_world()
     log0(args)  # startup args print parity (:337)
     seed = args.seed if args.seed is not None else 0
     if args.seed is not None:
@@ -1562,6 +1635,21 @@ def main(argv: Optional[list] = None) -> None:
         serve_main(argv[1:])
         return
     args = build_parser().parse_args(argv)
+    if args.elastic and not args.spawn:
+        raise SystemExit(
+            "--elastic supervises the worker processes it spawns, so it "
+            "requires --spawn N (the local world). On a real pod the "
+            "restart actor is the cluster manager — "
+            "runtime/elastic.py::supervise is the reference "
+            "implementation to integrate there."
+        )
+    if args.min_world < 1:
+        raise SystemExit(f"--min-world must be >= 1, got {args.min_world}")
+    if args.elastic and args.min_world > args.spawn:
+        raise SystemExit(
+            f"--min-world {args.min_world} exceeds the initial world "
+            f"size --spawn {args.spawn}"
+        )
     if args.spawn:
         if args.spawn < 2:
             raise SystemExit(
@@ -1576,6 +1664,12 @@ def main(argv: Optional[list] = None) -> None:
                 "--coordinator/--num-processes/--process-id (those join an "
                 "existing one)"
             )
+        if args.elastic:
+            # The elastic supervisor: same local world as --spawn, but a
+            # host loss shrinks it (survivors re-exec at W-1 resumed
+            # from the last published checkpoint) instead of ending it.
+            raise SystemExit(elastic.supervise(
+                args.spawn, argv, min_world=args.min_world))
         from pytorch_distributed_mnist_tpu.parallel.launcher import spawn_local
 
         raise SystemExit(spawn_local(args.spawn, argv))

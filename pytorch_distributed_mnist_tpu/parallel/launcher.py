@@ -42,21 +42,32 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def strip_spawn_flag(argv: Sequence[str]) -> List[str]:
-    """Remove ``--spawn N`` / ``--spawn=N`` from an argv copy."""
+def strip_flags(argv: Sequence[str], flags: dict) -> List[str]:
+    """Remove launcher-consumed flags from an argv copy.
+
+    ``flags`` maps flag name -> number of value tokens to drop with it
+    (``=``-joined forms are always one token). The ONE argv-stripping
+    loop for every spawner-side flag — ``--spawn`` here, the elastic
+    supervisor's ``--elastic``/``--min-world``/``--resume`` rewrites
+    (``runtime/elastic.py``) — so a flag-syntax fix lands once."""
     out: List[str] = []
-    skip = False
+    skip = 0
     for a in argv:
         if skip:
-            skip = False
+            skip -= 1
             continue
-        if a == "--spawn":
-            skip = True
+        if a in flags:
+            skip = flags[a]
             continue
-        if a.startswith("--spawn="):
+        if any(a.startswith(flag + "=") for flag in flags):
             continue
         out.append(a)
     return out
+
+
+def strip_spawn_flag(argv: Sequence[str]) -> List[str]:
+    """Remove ``--spawn N`` / ``--spawn=N`` from an argv copy."""
+    return strip_flags(argv, {"--spawn": 1})
 
 
 def _child_env() -> dict:

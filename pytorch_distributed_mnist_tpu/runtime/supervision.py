@@ -140,6 +140,11 @@ FAULT_POINTS: Dict[str, str] = {
                   "host, before the cross-host dataset agreement",
     "train_epoch": "Trainer.train entry: host-side work of one training "
                    "epoch (staging, dispatch)",
+    "train_step": "Trainer.train per-batch loop (stepwise/explicit "
+                  "modes): before each step's dispatch, so kills land "
+                  "BETWEEN device programs mid-epoch (scan mode runs "
+                  "the epoch as one program — no per-step host "
+                  "boundary to hook)",
     "eval": "Trainer.evaluate entry: host-side work of one eval pass",
     "ckpt_prepare": "checkpoint._sharded_prepare entry: tmp-dir cleanup "
                     "before the prepare agreement",
@@ -153,6 +158,11 @@ FAULT_POINTS: Dict[str, str] = {
               "and the resume broadcast/agreement",
     "download_fetch": "data.download._fetch entry: one mirror fetch "
                       "attempt",
+    "elastic_rebuild": "runtime/elastic.py survivor-record write: a "
+                       "surviving worker between its PeerFailure and "
+                       "its shrink exit — kill/stall here is a SECOND "
+                       "failure during the world rebuild (the "
+                       "supervisor must shrink further, never hang)",
 }
 
 _FAULT_KINDS = ("kill", "raise", "stall")
@@ -178,6 +188,12 @@ class FaultPlan:
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
+        if "," in spec:
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {spec!r}: one fault per spec "
+                f"(comma-join multiple specs and parse with "
+                f"parse_fault_specs)"
+            )
         parts = spec.split(":")
         if len(parts) not in (3, 4):
             raise ValueError(
@@ -227,35 +243,56 @@ class FaultPlan:
         raise InjectedFault(f"injected fault at {detail} (chaos harness)")
 
 
-_fault_plan: Optional[FaultPlan] = None
+def parse_fault_specs(spec: str) -> List[FaultPlan]:
+    """Parse a comma-joined list of fault specs (the ``TPUMNIST_FAULT``
+    surface): ``point:host:kind[:arg][,point:host:kind[:arg]...]``.
+
+    Multiple plans exist for the mid-rebuild chaos scenarios: the first
+    spec injects the host loss, the second sabotages a SURVIVOR during
+    the shrink (``elastic_rebuild``). Host indices are process ranks
+    within the world that reads the plan — in an elastic run, each
+    generation's ranks, not stable host ids (tools/chaos.py documents
+    the caveat)."""
+    plans = [FaultPlan.parse(part) for part in spec.split(",")
+             if part.strip()]
+    if not plans and spec.strip():
+        raise ValueError(f"bad {FAULT_ENV} spec {spec!r}: no fault specs")
+    return plans
+
+
+_fault_plans: List[FaultPlan] = []
 _fault_parsed = False
 _fault_hits: Dict[str, int] = {}
 
 
-def _load_fault_plan() -> Optional[FaultPlan]:
-    global _fault_plan, _fault_parsed
+def _load_fault_plans() -> List[FaultPlan]:
+    global _fault_plans, _fault_parsed
     if not _fault_parsed:
         spec = os.environ.get(FAULT_ENV, "").strip()
-        _fault_plan = FaultPlan.parse(spec) if spec else None
+        _fault_plans = parse_fault_specs(spec) if spec else []
         _fault_parsed = True
-    return _fault_plan
+    return _fault_plans
 
 
 def maybe_fault(point: str) -> None:
-    """Fire the configured fault when this call site/host matches.
+    """Fire the first configured fault whose point/host matches.
 
     Call sites must use a string literal from ``FAULT_POINTS`` (pinned by
     test); the hook is a no-op (one dict probe) when no plan is set.
+    Matching hits are counted PER POINT (shared across plans targeting
+    the same point — one plan per point is the supported shape).
     """
     assert point in FAULT_POINTS, f"unregistered fault point {point!r}"
-    plan = _load_fault_plan()
-    if plan is None or not plan.matches(point):
+    plans = [p for p in _load_fault_plans() if p.matches(point)]
+    if not plans:
         return
     hits = _fault_hits.get(point, 0)
     _fault_hits[point] = hits + 1
-    if plan.kind in ("kill", "raise") and hits < int(plan.arg):
-        return  # arg = number of matching hits to skip first
-    plan.fire()
+    for plan in plans:
+        if plan.kind in ("kill", "raise") and hits < int(plan.arg):
+            continue  # arg = number of matching hits to skip first
+        plan.fire()
+        return
 
 
 # ---------------------------------------------------------------------------
@@ -282,7 +319,7 @@ def configure(timeout: Optional[float] = None,
     cache) so re-entrant ``cli.run`` calls supervise their own run only.
     """
     global _timeout, _hard_exit_after, _phase, _agreements
-    global _fault_parsed, _fault_plan
+    global _fault_parsed, _fault_plans
     if timeout is None:
         env = os.environ.get(TIMEOUT_ENV, "").strip()
         try:
@@ -297,7 +334,7 @@ def configure(timeout: Optional[float] = None,
     _agreements = 0
     _last_seen.clear()
     _fault_parsed = False
-    _fault_plan = None
+    _fault_plans = []
     _fault_hits.clear()
     return _timeout
 

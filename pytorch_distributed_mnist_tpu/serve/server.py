@@ -386,6 +386,7 @@ def create_server(args) -> ThreadingHTTPServer:
     )
     from pytorch_distributed_mnist_tpu.train.checkpoint import (
         checkpoint_parallel_layout,
+        checkpoint_world,
     )
 
     devices = jax.local_devices()
@@ -456,8 +457,20 @@ def create_server(args) -> ThreadingHTTPServer:
             print(f"WARNING: cannot serve checkpoint {candidate!r} "
                   f"({exc!r}); trying the next-older epoch", flush=True)
     if boot_path is not None:
-        print(f"serving checkpoint {boot_path!r} (epoch {epoch})",
-              flush=True)
+        # World provenance by meta inspection (the training world's
+        # shape, stamped at save): a checkpoint from an N-host world is
+        # served here after a cross-topology reshard — worth one log
+        # line, since epoch metrics in a shared metrics file may
+        # straddle world sizes (the elastic shrink path).
+        try:
+            world = checkpoint_world(boot_path)
+        except Exception:  # noqa: BLE001 - provenance only; it loaded
+            world = None
+        provenance = (f", saved at world {world['processes']}x"
+                      f"{world['devices']} processes x devices"
+                      if world else "")
+        print(f"serving checkpoint {boot_path!r} (epoch {epoch}"
+              f"{provenance})", flush=True)
     elif layout_rejection is not None:
         raise SystemExit(
             f"{layout_rejection[0]!r}: {layout_rejection[1]}")

@@ -38,6 +38,18 @@ Two layouts, chosen automatically at save time:
   cross-host barrier. Restore stitches the global array from the slice
   index and redistributes onto the template's shardings — so the layout
   round-trips across different mesh shapes, same as the npz path.
+
+Cross-world resharding contract: BOTH layouts restore onto any world —
+any process count, any mesh, any optimizer-sharding level the template
+was built with — because restore always goes through full host arrays
+and the template's own shardings (``_restore_onto_template``; for ZeRO
+states the specs are ``parallel/zero.py::zero_state_sharding``'s, so a
+resumed state is bit-identical to a fresh shard of the gathered
+arrays). This is what lets the elastic runtime (``runtime/elastic.py``)
+resume a checkpoint saved at world size W on the W' survivors of a host
+loss, and a serve pool reload across topologies. The saving world is
+stamped in meta (``checkpoint_world``) as inspectable provenance;
+``tests/test_reshard.py`` pins the (W, W') round-trip matrix.
 """
 
 from __future__ import annotations
@@ -72,6 +84,19 @@ def _leaves_with_names(tree: Any):
 def _state_tree(state) -> Dict[str, Any]:
     return {"params": state.params, "opt_state": state.opt_state,
             "step": state.step}
+
+
+def _world_stamp() -> Dict[str, int]:
+    """The saving world's shape, stamped into checkpoint meta (both
+    layouts) as provenance: the elastic resume path and serve boot can
+    see — by meta inspection, before any array bytes move — that a
+    checkpoint was saved at a different world size and will be
+    re-sharded onto this one. The restore path never *requires* a
+    match: ``_restore_onto_template`` re-shards any layout onto any
+    process count and mesh (the cross-world contract
+    ``tests/test_reshard.py`` pins)."""
+    return {"processes": int(jax.process_count()),
+            "devices": int(jax.device_count())}
 
 
 def _npz_saveable(leaf: Any) -> bool:
@@ -134,6 +159,7 @@ def save_checkpoint(
         "best_acc": float(best_acc),
         "leaf_names": [k for k, _ in named],
         "format_version": 1,
+        "world": _world_stamp(),
     }
     if parallel_layout is not None:
         meta["parallel_layout"] = dict(parallel_layout)
@@ -238,6 +264,7 @@ def _sharded_meta(named, epoch: int, best_acc: float,
         "dtypes": [np.dtype(getattr(v, "dtype", np.float32)).name
                    for _, v in named],
         "format_version": 2,
+        "world": _world_stamp(),
     }
     if parallel_layout is not None:
         meta["parallel_layout"] = dict(parallel_layout)
@@ -441,10 +468,21 @@ def _save_sharded(named, *, epoch: int, best_acc: float, is_best: bool,
 def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
     """Stitch global arrays from the shard index, redistribute to ``state``.
 
-    Mesh-shape agnostic by construction: the global array is assembled on
-    the host and handed to ``jax.make_array_from_callback`` with the
-    template leaf's sharding, so a state saved from a ``(4, 2)`` mesh
-    restores onto an ``(8,)`` mesh (or a single device) unchanged.
+    World-agnostic by construction, and that generality is load-bearing
+    (the elastic runtime's reshard-resume path, ``runtime/elastic.py``):
+    the shard index is keyed by global slice regions, not by the saving
+    world's topology, so the loader reads WHATEVER set of per-process
+    index files the directory holds, assembles each full global array
+    on the host, and hands it to ``_restore_onto_template`` to place
+    with the template leaf's sharding. A state saved from a ``(4, 2)``
+    mesh of 4 processes restores onto an ``(8,)`` mesh, a single
+    device, or a 3-process shrunk world unchanged — the loading world's
+    process count and mesh never have to match the saving world's.
+
+    The saving world's shape (``meta["world"]``, when stamped) is used
+    only for diagnostics: a shard-coverage gap is reported as the
+    incomplete filesystem view it is, naming how many index files the
+    saving world wrote versus how many are visible here.
     """
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
@@ -454,9 +492,11 @@ def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
         for shape, dt in zip(meta["global_shapes"], meta["dtypes"])
     ]
     filled = [0] * n_leaves
+    index_files = 0
     for idx_name in sorted(os.listdir(path)):
         if not idx_name.startswith("index_p"):
             continue
+        index_files += 1
         with open(os.path.join(path, idx_name)) as f:
             idx = json.load(f)
         if idx["file"] is None:
@@ -473,11 +513,17 @@ def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
                 data = z[rec["key"]]
                 globals_np[i][region] = data.reshape(globals_np[i][region].shape)
                 filled[i] += data.size
+    saved_procs = (meta.get("world") or {}).get("processes")
     for i, (total, arr) in enumerate(zip(filled, globals_np)):
         if total < arr.size:
+            world = (f" (saved by a {saved_procs}-process world; "
+                     f"{index_files} index file(s) visible here — an "
+                     f"incomplete shared-filesystem view?)"
+                     if saved_procs and index_files != saved_procs else
+                     " — incomplete save?")
             raise ValueError(
                 f"{path}: leaf {meta['leaf_names'][i]} is missing shards "
-                f"({total}/{arr.size} elements present) — incomplete save?"
+                f"({total}/{arr.size} elements present){world}"
             )
 
     new_state = _restore_onto_template(
@@ -544,20 +590,42 @@ def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
     return new_state, int(meta["epoch"]), float(meta["best_acc"])
 
 
+def _read_meta(path: str) -> Dict[str, Any]:
+    """The checkpoint's meta dict, without touching array bytes — the
+    one dir-vs-npz container read behind every inspection gate
+    (``checkpoint_parallel_layout``, ``checkpoint_world``), so a meta
+    container change lands once."""
+    if os.path.isdir(path):
+        with open(os.path.join(path, "meta.json")) as f:
+            return json.load(f)
+    with np.load(path) as z:
+        return json.loads(bytes(z["__meta__"]).decode())
+
+
 def checkpoint_parallel_layout(path: str) -> Optional[Dict[str, Any]]:
     """Read just the ``parallel_layout`` provenance stamp from a
     checkpoint's meta — no array bytes touched, so the serve boot/reload
     layout gate can run before (and far cheaper than) the template load.
     Returns ``None`` for checkpoints saved without the stamp (library
     callers, pre-stamp files): no provenance, nothing to contradict."""
-    if os.path.isdir(path):
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
-    else:
-        with np.load(path) as z:
-            meta = json.loads(bytes(z["__meta__"]).decode())
-    layout = meta.get("parallel_layout")
+    layout = _read_meta(path).get("parallel_layout")
     return dict(layout) if layout is not None else None
+
+
+def checkpoint_world(path: str) -> Optional[Dict[str, int]]:
+    """Read just the saving world's shape (``{"processes": P,
+    "devices": D}``) from a checkpoint's meta — no array bytes touched.
+
+    The inspection twin of ``checkpoint_parallel_layout``: the elastic
+    resume path and serve boot read it to KNOW a restore is a
+    cross-world reshard (and log/record it) instead of discovering
+    world provenance from a failed load. Returns ``None`` for
+    checkpoints saved before the stamp existed — no provenance, and the
+    restore path reshards regardless."""
+    world = _read_meta(path).get("world")
+    return ({"processes": int(world["processes"]),
+             "devices": int(world["devices"])}
+            if world is not None else None)
 
 
 def is_corrupt_checkpoint_error(exc: BaseException) -> bool:

@@ -586,6 +586,11 @@ class Trainer:
                     else "train_step_zero_overlap" if self._zero_overlap
                     else "train_step")
             for gbatch in self._feeder.epoch():
+                # Per-batch chaos hook: a kill here lands BETWEEN device
+                # programs, genuinely mid-epoch — the host-loss shape
+                # the elastic runtime (runtime/elastic.py) shrinks
+                # around. One dict probe when no fault plan is set.
+                maybe_fault("train_step")
                 if carried:
                     new_state, gathered, m = self._run_program(
                         name, self._train_step,

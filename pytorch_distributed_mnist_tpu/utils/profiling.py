@@ -456,6 +456,29 @@ class EventLog:
 failure_events = EventLog()
 
 
+def record_world_shrunk(old_members, new_members, generation) -> Dict:
+    """Record the elastic runtime's shrink event: this run is the
+    rebuilt world after a host loss (``runtime/elastic.py``).
+
+    One structured ``world_shrunk`` failure event carrying the old and
+    new membership (stable host ids) and the rebuild generation — so
+    the shrink shows up in the run summary's ``failure_events`` block
+    AND, through the attached sink, as one line in the shared
+    ``--metrics-file`` JSONL next to the epoch rows it explains (epoch
+    metrics jump worlds exactly here). Called by
+    ``elastic.note_rebuilt_world`` at run start, after ``cli.run``
+    resets the log and attaches the sink."""
+    old_members, new_members = list(old_members), list(new_members)
+    return failure_events.record(
+        "world_shrunk",
+        f"world shrank from {len(old_members)} to {len(new_members)} "
+        f"host(s) at generation {int(generation)}: members "
+        f"{old_members} -> {new_members}; resumed from the last "
+        f"published checkpoint",
+        old_members=old_members, new_members=new_members,
+        generation=int(generation))
+
+
 def _percentile(sorted_vals: list, q: float) -> float:
     """Nearest-rank percentile over an already-sorted list (0 when empty).
     Nearest-rank (not interpolated) so p99 of a small sample is a latency

@@ -444,3 +444,77 @@ def boot_sharded_plane(devices, mesh_size):
     return groups
 """
     assert _findings(src) == []
+
+
+# -- the elastic shrink shape (ISSUE 10, runtime/elastic.py) -----------------
+
+
+def test_fires_on_membership_agreement_on_lowest_survivor_only():
+    """The elastic shape gone wrong: after a PeerFailure, 'agree' the
+    shrunk membership by running the agreement collective on the lowest
+    surviving rank only — the other survivors never arrive, and the
+    shrink becomes a second hang. (The sanctioned design never runs a
+    post-failure collective at all: survivors vote through records the
+    SUPERVISOR reads, runtime/elastic.py.)"""
+    src = """
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def agree_membership(survivors):
+    if process_index() == min(survivors):
+        allgather_records("membership", True)
+    return survivors
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "agree_membership"
+
+
+def test_fires_on_rebuild_barrier_with_member_dependent_trips():
+    """Rebuild-time drain whose collective trip count depends on this
+    host's rank: generation members run different numbers of
+    agreements — the count-misalignment hang."""
+    src = """
+def drain_rebuild(members):
+    while process_index() > members[0]:
+        agree("rebuild_tick")
+        members = members[1:]
+"""
+    (f,) = _findings(src)
+    assert "host-dependent while" in f.message
+
+
+def test_silent_on_survivor_record_write_under_pid_branch():
+    """The sanctioned worker-side shrink shape: the survivor RECORD is
+    host-local file I/O (each host writes its own vote; no collective
+    anywhere on the unwind path), so a process_index-conditioned branch
+    around it is clean — and a symmetric agreement BEFORE the failure
+    window stays clean beside it."""
+    src = """
+import json
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def unwind_with_vote(directory, error):
+    records = allgather_records("ckpt_publish", True)
+    if process_index() in getattr(error, "hosts", []):
+        return None
+    with open(f"{directory}/survivor_r{process_index()}.json", "w") as f:
+        json.dump({"rank": process_index()}, f)
+    return records
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_world_size_guarded_shrink_note():
+    """The rebuilt-world bootstrap: process_count() guards are the
+    sanctioned symmetric fast path, and the world_shrunk event record
+    is host-local."""
+    src = """
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_count
+
+def note_rebuilt_world(old_members, new_members):
+    if process_count() <= 1:
+        return record_world_shrunk(old_members, new_members, 1)
+    records = allgather_records("rebuild_ready", True)
+    raise_if_poisoned(records, "the rebuild bootstrap")
+    return record_world_shrunk(old_members, new_members, 1)
+"""
+    assert _findings(src) == []

@@ -529,3 +529,103 @@ def build_groups(devices, mesh_size, axis, params):
     return groups
 """
     assert _findings(src) == []
+
+
+# -- the elastic supervisor shape (ISSUE 10, runtime/elastic.py) -------------
+
+
+def test_fires_on_worker_join_under_membership_lock():
+    """The elastic supervisor shape gone wrong: holding the membership
+    lock while joining a worker's exit — a stalled worker (the exact
+    mid-rebuild failure the settle deadline exists for) would wedge
+    every reader of the membership."""
+    src = """
+import threading
+
+_members_lock = threading.Lock()
+_members = [0, 1, 2]
+
+def collect_generation(threads):
+    with _members_lock:
+        for t in threads:
+            t.join()
+        return list(_members)
+"""
+    assert len(_findings(src)) >= 1
+
+
+def test_fires_on_survivor_record_io_under_membership_lock():
+    """Record file I/O under the membership lock: a slow shared
+    filesystem write (the rendezvous dir is exactly that) blocks every
+    membership reader for the duration."""
+    src = """
+import json
+import threading
+
+_members_lock = threading.Lock()
+
+def persist_vote(path, record):
+    with _members_lock:
+        with open(path, "w") as f:
+            json.dump(record, f)
+"""
+    assert len(_findings(src)) >= 1
+
+
+def test_silent_on_snapshot_members_then_write_record():
+    """The sanctioned shape: snapshot the membership under the lock,
+    do the file I/O after release (the survivor-record write in
+    runtime/elastic.py is lock-free end to end — atomic tmp+replace,
+    one writer per rank by construction)."""
+    src = """
+import json
+import threading
+
+_members_lock = threading.Lock()
+_members = [0, 1, 2]
+
+def persist_vote(path):
+    with _members_lock:
+        snapshot = list(_members)
+    with open(path + ".tmp", "w") as f:
+        json.dump({"members": snapshot}, f)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_membership_mutation_under_lock():
+    """Pure membership bookkeeping under the lock — list mutation and
+    arithmetic only — is what the lock is FOR."""
+    src = """
+import threading
+
+_members_lock = threading.Lock()
+_members = [0, 1, 2]
+
+def shrink(dead):
+    with _members_lock:
+        for host in dead:
+            if host in _members:
+                _members.remove(host)
+        return len(_members)
+"""
+    assert _findings(src) == []
+
+
+def test_elastic_module_clean_and_lock_free():
+    """ISSUE 10 acceptance pin: runtime/elastic.py stays clean under
+    the collective-symmetry, lock-discipline, and trace-purity
+    checkers — the worker-side unwind path runs NO collectives (votes
+    are files), the supervisor holds no locks (one thread, poll loop),
+    and nothing traces."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "runtime",
+                      "elastic.py")],
+        checkers=["lock-discipline", "trace-purity",
+                  "collective-symmetry"],
+        baseline=None)
+    assert result.findings == []
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    elastic_graph = graph.get(
+        "pytorch_distributed_mnist_tpu/runtime/elastic.py", {})
+    assert elastic_graph.get("locks", []) == []

@@ -1,0 +1,235 @@
+"""Elastic runtime unit tests (fast, tier-1): the worker-side membership
+vote (survivor records), the supervisor's pure membership planning, flag
+plumbing, and the world_shrunk observability event. The real
+multi-process shrink twins live in tests/test_elastic_chaos.py."""
+
+import json
+import os
+
+import pytest
+
+from pytorch_distributed_mnist_tpu.runtime import elastic, supervision
+from pytorch_distributed_mnist_tpu.runtime.elastic import (
+    DIR_ENV,
+    GEN_ENV,
+    MEMBERS_ENV,
+    PREV_ENV,
+    is_transport_suspect,
+    plan_next_world,
+    strip_elastic_flags,
+    write_survivor_record,
+)
+from pytorch_distributed_mnist_tpu.utils.profiling import failure_events
+
+pytestmark = pytest.mark.elastic
+
+
+def _peer_failure(hosts=(1,), phase="ckpt_publish", reason="died"):
+    return supervision.PeerFailure(
+        "PeerFailure: test", hosts=list(hosts), phase=phase, reason=reason)
+
+
+def _elastic_env(monkeypatch, tmp_path, gen=0, members="0,1"):
+    monkeypatch.setenv(DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(GEN_ENV, str(gen))
+    monkeypatch.setenv(MEMBERS_ENV, members)
+    monkeypatch.delenv(PREV_ENV, raising=False)
+
+
+# -- worker side: the membership vote ---------------------------------------
+
+
+def test_survivor_record_written_for_peer_failure(monkeypatch, tmp_path):
+    _elastic_env(monkeypatch, tmp_path, gen=2, members="0,3,5")
+    path = write_survivor_record(_peer_failure(hosts=[1], phase="train@4"))
+    assert path == elastic.record_path(str(tmp_path), 2, 0)
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["generation"] == 2 and rec["rank"] == 0
+    assert rec["host"] == 0  # members[rank]
+    assert rec["dead_ranks"] == [1] and rec["dead_hosts"] == [3]
+    assert rec["phase"] == "train@4"
+
+
+def test_survivor_record_for_transport_shaped_error(monkeypatch, tmp_path):
+    """A peer death surfacing inside a DEVICE program (a step's psum)
+    arrives as a raw runtime error, not a PeerFailure — still a
+    survivorship vote, with the dead set left for the supervisor to
+    infer from who else exited recordless."""
+    _elastic_env(monkeypatch, tmp_path)
+    exc = ValueError(
+        "UNKNOWN: Gloo AllGather failed: [external/gloo/...] "
+        "Connection reset by peer [127.0.0.1]:36237")
+    prev_phase = supervision.set_phase("train@1")
+    try:
+        path = write_survivor_record(exc)
+    finally:
+        supervision.set_phase(prev_phase)
+    assert path is not None
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["dead_ranks"] == [] and rec["dead_hosts"] == []
+    # The record names where the world DIED, not the membership phase
+    # the unwind itself enters (a transport error has no .phase of its
+    # own — the pre-unwind lifecycle phase is the right attribution).
+    assert rec["phase"] == "train@1"
+
+
+@pytest.mark.parametrize("error", [
+    RuntimeError("division by zero in my own staging code"),
+    KeyboardInterrupt(),
+    SystemExit("resume outcome diverged across hosts"),
+])
+def test_no_record_for_non_survivor_errors(monkeypatch, tmp_path, error):
+    """A host failing on its OWN error (or an agreed symmetric exit, or
+    the operator's ctrl-C) must not vote itself back into the world."""
+    _elastic_env(monkeypatch, tmp_path)
+    assert write_survivor_record(error) is None
+    assert os.listdir(tmp_path) == []
+
+
+def test_no_record_outside_elastic_worker(monkeypatch, tmp_path):
+    monkeypatch.delenv(DIR_ENV, raising=False)
+    assert write_survivor_record(_peer_failure()) is None
+
+
+def test_record_write_failure_is_swallowed(monkeypatch, tmp_path, capsys):
+    """The record write runs on an unwind path: an IO failure must warn
+    and return None (the supervisor counts this rank dead — strictly a
+    smaller world), never mask the run's own exception."""
+    target = tmp_path / "not_a_dir"
+    target.write_text("a file where the rendezvous dir should be")
+    monkeypatch.setenv(DIR_ENV, str(target))
+    monkeypatch.setenv(GEN_ENV, "0")
+    monkeypatch.setenv(MEMBERS_ENV, "0,1")
+    assert write_survivor_record(_peer_failure()) is None
+    assert "could not be written" in capsys.readouterr().err
+
+
+def test_elastic_rebuild_fault_point_fires_in_record_path(
+        monkeypatch, tmp_path):
+    """The mid-rebuild chaos hook: a fault injected at elastic_rebuild
+    fires exactly in the survivor-record window (a second failure
+    DURING the shrink)."""
+    _elastic_env(monkeypatch, tmp_path)
+    monkeypatch.setenv(supervision.FAULT_ENV, "elastic_rebuild:0:raise")
+    monkeypatch.setattr(supervision, "_fault_parsed", False)
+    try:
+        with pytest.raises(supervision.InjectedFault):
+            write_survivor_record(_peer_failure())
+        assert os.listdir(tmp_path) == []  # died before the vote landed
+    finally:
+        monkeypatch.setattr(supervision, "_fault_parsed", False)
+        monkeypatch.delenv(supervision.FAULT_ENV)
+
+
+def test_transport_suspect_classifier():
+    assert is_transport_suspect(ValueError("Gloo AllReduce failed"))
+    assert is_transport_suspect(RuntimeError("connection reset by peer"))
+    assert is_transport_suspect(
+        RuntimeError("coordination service heartbeat failure"))
+    assert not is_transport_suspect(ValueError("shapes do not match"))
+    assert not is_transport_suspect(OSError("no space left on device"))
+
+
+# -- worker side: the world_shrunk event ------------------------------------
+
+
+def test_note_rebuilt_world_records_event(monkeypatch, tmp_path):
+    _elastic_env(monkeypatch, tmp_path, gen=1, members="0,2")
+    monkeypatch.setenv(PREV_ENV, "0,1,2")
+    failure_events.reset()
+    elastic.note_rebuilt_world()
+    events = [e for e in failure_events.snapshot()
+              if e["kind"] == "world_shrunk"]
+    assert len(events) == 1
+    assert events[0]["old_members"] == [0, 1, 2]
+    assert events[0]["new_members"] == [0, 2]
+    assert events[0]["generation"] == 1
+
+
+def test_note_rebuilt_world_noop_outside_rebuild(monkeypatch, tmp_path):
+    failure_events.reset()
+    # Generation 0 (no PREV): nothing shrank yet.
+    _elastic_env(monkeypatch, tmp_path)
+    elastic.note_rebuilt_world()
+    # Not an elastic worker at all.
+    for env in (DIR_ENV, GEN_ENV, MEMBERS_ENV, PREV_ENV):
+        monkeypatch.delenv(env, raising=False)
+    elastic.note_rebuilt_world()
+    assert [e for e in failure_events.snapshot()
+            if e["kind"] == "world_shrunk"] == []
+
+
+# -- supervisor side: pure membership planning ------------------------------
+
+
+def test_plan_survivors_from_records_and_clean_exits():
+    # rank 0 finished (rc 0), rank 1 voted (record), rank 2 SIGKILLed.
+    survivors, dead = plan_next_world(3, [0, 75, -9], [1])
+    assert survivors == [0, 1] and dead == [2]
+
+
+def test_plan_recordless_nonzero_exit_is_dead():
+    # rank 1 exited on its own error without a record: not a survivor.
+    survivors, dead = plan_next_world(2, [1, 1], [0])
+    assert survivors == [0] and dead == [1]
+
+
+def test_plan_record_outranks_exit_code():
+    # A survivor killed during teardown (hard exit 75 / supervisor
+    # straggler kill -9) still survives: the record is the proof.
+    survivors, dead = plan_next_world(2, [-9, -9], [0])
+    assert survivors == [0] and dead == [1]
+
+
+def test_plan_no_survivors():
+    survivors, dead = plan_next_world(2, [-9, 1], [])
+    assert survivors == [] and dead == [0, 1]
+
+
+def test_plan_symmetric_failure_shrinks_nothing():
+    # Everyone voted survivor (all PeerFailure'd on ... nothing dead?)
+    # — plan reports no dead rank; supervise() treats that as a
+    # non-shrink failure and propagates.
+    survivors, dead = plan_next_world(2, [1, 1], [0, 1])
+    assert survivors == [0, 1] and dead == []
+
+
+# -- supervisor side: flag plumbing and validation --------------------------
+
+
+def test_strip_elastic_flags():
+    argv = ["--spawn", "3", "--elastic", "--min-world", "2",
+            "--model", "linear", "--min-world=1", "--elastic"]
+    assert strip_elastic_flags(argv) == ["--spawn", "3", "--model",
+                                         "linear"]
+
+
+def test_strip_resume():
+    argv = ["--resume", "auto", "--model", "linear",
+            "--resume=/some/path.npz"]
+    assert elastic._strip_resume(argv) == ["--model", "linear"]
+
+
+def test_supervise_validates_inputs():
+    with pytest.raises(ValueError, match=">= 2"):
+        elastic.supervise(1, [])
+    with pytest.raises(ValueError, match="min-world"):
+        elastic.supervise(2, [], min_world=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        elastic.supervise(2, [], min_world=3)
+
+
+def test_cli_rejects_elastic_without_spawn():
+    from pytorch_distributed_mnist_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="requires --spawn"):
+        main(["--elastic", "--dataset", "synthetic"])
+
+
+def test_cli_rejects_min_world_over_spawn():
+    from pytorch_distributed_mnist_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="exceeds the initial world"):
+        main(["--elastic", "--spawn", "2", "--min-world", "3"])

@@ -1,0 +1,223 @@
+"""Elastic-world chaos twins: real multi-process worlds losing real
+hosts to SIGKILL, supervised by ``runtime/elastic.py`` — proving the
+shrink-don't-exit contract end to end:
+
+- THE acceptance twin (tier-1): a 2-process world loses host 1 to
+  SIGKILL *mid-epoch* (between per-batch step programs); the survivor
+  agrees the shrunk world, is re-execed as a 1-host world, resumes from
+  the last *published* checkpoint (cross-world reshard of the sharded
+  zero1 layout), and trains to completion with NO operator action — and
+  its post-shrink epoch metrics EQUAL a run started directly at the
+  smaller world from the same checkpoint;
+- a 3-process world shrinking to a 2-process world (multi-survivor
+  membership agreement + a real 2-host rebuilt world);
+- a SECOND failure *during* the shrink: a survivor killed (or stalled)
+  in its survivor-record window just shrinks the next world further —
+  never a hang (the supervisor's settle deadline bounds every rebuild);
+- the ``--min-world`` floor: shrinking below it exits with the
+  distinct floor code instead of training on a world the operator
+  ruled out.
+
+All twins drive ``elastic.supervise`` in-process (the supervisor makes
+no jax calls; the workers are real subprocesses).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pytorch_distributed_mnist_tpu.parallel.launcher import _child_env
+from pytorch_distributed_mnist_tpu.runtime.elastic import (
+    EXIT_FLOOR,
+    supervise,
+)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.elastic]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEADLINE = "8"
+
+_BASE = ["--dataset", "synthetic", "--model", "linear",
+         "--synthetic-train-size", "256", "--synthetic-test-size", "128",
+         "--trainer-mode", "stepwise", "--seed", "0", "--resume", "auto"]
+
+
+def _flags(ckpt, metrics, epochs=3, batch=64, extra=()):
+    return _BASE + ["--epochs", str(epochs), "--batch-size", str(batch),
+                    "--checkpoint-dir", str(ckpt),
+                    "--metrics-file", str(metrics)] + list(extra)
+
+
+def _rows(metrics_path):
+    with open(metrics_path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _events(rows, kind):
+    return [r for r in rows if r.get("kind") == kind]
+
+
+def _epoch_rows_after_shrink(rows):
+    """Epoch metric rows written by the rebuilt world (after the
+    world_shrunk event line in the shared JSONL)."""
+    idx = next(i for i, r in enumerate(rows)
+               if r.get("kind") == "world_shrunk")
+    return [r for r in rows[idx + 1:] if "train_loss" in r]
+
+
+def _strip_timing(row):
+    return {k: v for k, v in row.items() if k not in ("images_per_sec",)}
+
+
+def test_elastic_survives_midepoch_kill_and_matches_direct_small_world(
+        tmp_path, monkeypatch):
+    """THE acceptance twin. Host 1 is SIGKILLed between two of epoch 1's
+    step programs (the ``train_step`` fault point). The elastic
+    supervisor must: see host 0 unwind with the failure attributed,
+    collect its survivor record, rebuild a 1-host world, and resume
+    from epoch 0's published checkpoint (saved SHARDED by the 2-host
+    zero1 world — a real cross-world reshard) to completion, rc 0, no
+    operator action. Then the proof of equivalence: a fresh run started
+    DIRECTLY at world size 1 from a copy of the same published
+    checkpoint produces byte-equal epoch metrics."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    # Skip 5 hits: epoch 0's four steps run whole (its checkpoint
+    # publishes), the kill lands inside epoch 1's step loop.
+    monkeypatch.setenv("TPUMNIST_FAULT", "train_step:1:kill:5")
+    t0 = time.monotonic()
+    rc = supervise(2, _flags(ckpt, metrics,
+                             extra=["--optimizer-sharding", "zero1"]),
+                   settle_timeout=60, generation_timeout=240)
+    elapsed = time.monotonic() - t0
+    assert rc == 0, f"elastic run failed (rc={rc})"
+    assert elapsed < 200, f"shrink+resume took {elapsed:.0f}s"
+
+    rows = _rows(metrics)
+    shrunk = _events(rows, "world_shrunk")
+    assert len(shrunk) == 1
+    assert shrunk[0]["old_members"] == [0, 1]
+    assert shrunk[0]["new_members"] == [0]
+    # The resume inspected the checkpoint's world stamp: a 2-process
+    # save resharded onto the 1-process world, recorded, not inferred
+    # from a failed load.
+    reshard = _events(rows, "checkpoint_reshard")
+    assert reshard and reshard[0]["saved"]["processes"] == 2
+    assert reshard[0]["current"]["processes"] == 1
+    resumed = _epoch_rows_after_shrink(rows)
+    assert [r["epoch"] for r in resumed] == [1, 2]
+    # The rebuilt 1-host world published its epochs (npz at world 1).
+    names = set(os.listdir(ckpt))
+    assert {"checkpoint_1.npz", "checkpoint_2.npz"} <= names
+
+    # Equivalence: world-1 run started directly from the published
+    # checkpoint the shrink resumed from (epoch 0's — the only one
+    # published before the kill).
+    direct_ckpt = tmp_path / "direct_ckpts"
+    direct_ckpt.mkdir()
+    shutil.copytree(ckpt / "checkpoint_0.ckpt",
+                    direct_ckpt / "checkpoint_0.ckpt")
+    direct_metrics = tmp_path / "direct_metrics.jsonl"
+    env = _child_env()
+    env["TPUMNIST_AGREEMENT_TIMEOUT"] = _DEADLINE
+    env.pop("TPUMNIST_FAULT", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_mnist_tpu"]
+        + _flags(direct_ckpt, direct_metrics,
+                 extra=["--optimizer-sharding", "zero1"]),
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    direct = [r for r in _rows(direct_metrics) if "train_loss" in r]
+    assert [r["epoch"] for r in direct] == [1, 2]
+    for elastic_row, direct_row in zip(resumed, direct):
+        assert _strip_timing(elastic_row) == _strip_timing(direct_row)
+
+
+@pytest.mark.slow
+def test_three_host_world_shrinks_to_two(tmp_path, monkeypatch):
+    """Multi-survivor membership: a 3-host world loses host 2 at a
+    host-side supervised phase (resume resolution — at 3+ ranks a kill
+    must surface on the HOST side, because survivors of a mid-device-
+    program death park in a timeout-less gloo collective: the
+    residual-hazard row in DESIGN.md; the supervisor's settle deadline
+    bounds that case but there is nothing to shrink around). Hosts 0
+    and 1 both vote, agree the shrunk membership, and are rebuilt as a
+    REAL 2-host world (rank renumbering, fresh coordinator) that
+    trains to completion. Batch 48 divides 3, 2, and 1 — worlds chosen
+    with divisible fallbacks, as the elastic docs prescribe."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    monkeypatch.setenv("TPUMNIST_FAULT", "resume:2:kill")
+    rc = supervise(3, _flags(ckpt, metrics, batch=48),
+                   settle_timeout=60, generation_timeout=300)
+    assert rc == 0
+    rows = _rows(metrics)
+    shrunk = _events(rows, "world_shrunk")
+    assert len(shrunk) == 1
+    assert shrunk[0]["old_members"] == [0, 1, 2]
+    assert shrunk[0]["new_members"] == [0, 1]
+    # The rebuilt 2-host world ran the whole job (the loss struck
+    # before any epoch, so the shrunk world trains 0..2).
+    assert [r["epoch"] for r in _epoch_rows_after_shrink(rows)] == [0, 1, 2]
+
+
+@pytest.mark.slow
+def test_second_kill_during_rebuild_shrinks_further(tmp_path, monkeypatch):
+    """A second failure DURING the shrink: host 2 dies, then host 0 is
+    killed inside its survivor-record window (``elastic_rebuild``
+    fault). Host 0's vote never lands, so the supervisor counts it
+    dead too and rebuilds with host 1 alone — a further shrink, a
+    clean completion, never a hang."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    monkeypatch.setenv("TPUMNIST_FAULT",
+                       "resume:2:kill,elastic_rebuild:0:kill")
+    rc = supervise(3, _flags(ckpt, metrics, batch=48),
+                   settle_timeout=60, generation_timeout=300)
+    assert rc == 0
+    shrunk = _events(_rows(metrics), "world_shrunk")
+    assert len(shrunk) == 1
+    assert shrunk[0]["old_members"] == [0, 1, 2]
+    assert shrunk[0]["new_members"] == [1]
+
+
+@pytest.mark.slow
+def test_stall_during_rebuild_killed_at_settle_deadline(
+        tmp_path, monkeypatch):
+    """The silent mid-rebuild failure: host 1 STALLS inside its
+    survivor-record window. The supervisor's settle deadline kills the
+    straggler (recordless -> dead) and rebuilds with host 0 alone;
+    the whole scenario is bounded, not a hang."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    monkeypatch.setenv("TPUMNIST_FAULT",
+                       "resume:2:kill,elastic_rebuild:1:stall:600")
+    t0 = time.monotonic()
+    rc = supervise(3, _flags(ckpt, metrics, batch=48),
+                   settle_timeout=25, generation_timeout=300)
+    assert rc == 0
+    assert time.monotonic() - t0 < 280
+    shrunk = _events(_rows(metrics), "world_shrunk")
+    assert len(shrunk) == 1
+    assert shrunk[0]["new_members"] == [0]
+
+
+@pytest.mark.slow
+def test_min_world_floor_stops_shrinking(tmp_path, monkeypatch):
+    """--min-world 2 on a 2-host world losing a host: the survivor is
+    below the floor, so the supervisor exits with the distinct floor
+    code instead of rebuilding a world the operator ruled out."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    monkeypatch.setenv("TPUMNIST_FAULT", "train_epoch:1:kill:1")
+    rc = supervise(2, _flags(ckpt, metrics), min_world=2,
+                   settle_timeout=60, generation_timeout=240)
+    assert rc == EXIT_FLOOR
+    # No rebuilt generation ever ran: no world_shrunk event, and the
+    # epoch-1 training never happened anywhere.
+    assert _events(_rows(metrics), "world_shrunk") == []

@@ -1,0 +1,217 @@
+"""Cross-world checkpoint resharding: the elastic runtime's enabling
+contract (ISSUE 10, ROADMAP item 5).
+
+A checkpoint saved at world size W must load at ANY world size W' —
+npz and sharded layouts, plain DP / zero1 / zero3 state layouts — with
+the resumed state bit-identical to a fresh shard of the gathered
+arrays. Worlds are simulated as device-subset meshes (the same
+in-process strategy the mesh suites use; the REAL multi-process twins
+live in tests/test_elastic_chaos.py): the property under test is that
+neither the saving mesh nor the saving process count constrains the
+loading template, because restore always stitches full host arrays and
+re-places them with the template's own shardings.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.zero import shard_state_zero
+from pytorch_distributed_mnist_tpu.train import checkpoint as ck
+from pytorch_distributed_mnist_tpu.train.checkpoint import (
+    checkpoint_world,
+    load_checkpoint,
+    save_checkpoint,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+
+pytestmark = pytest.mark.elastic
+
+
+def _mesh(n: int) -> Mesh:
+    """A 'world' of n chips: the first n of the suite's 8 CPU devices."""
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _fresh(seed: int = 0):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    return create_train_state(model, jax.random.key(seed))
+
+
+def _place(state, mesh: Mesh, level):
+    """State placed on ``mesh`` in the requested layout: replicated DP
+    (level None) or ZeRO level 1/3 (the zero_state_sharding spec
+    tables — exactly what a resumed run shards the loaded arrays with).
+    """
+    if level is None:
+        return jax.device_put(state, NamedSharding(mesh, P()))
+    placed, _ = shard_state_zero(state, mesh, level=level)
+    return placed
+
+
+def _gathered(state):
+    return [np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(ck._state_tree(state))]
+
+
+WORLD_PAIRS = [(8, 4), (4, 8), (8, 1), (1, 8)]
+
+
+@pytest.mark.parametrize("level", [None, 1, 3],
+                         ids=["plain", "zero1", "zero3"])
+@pytest.mark.parametrize("layout", ["npz", "sharded"])
+@pytest.mark.parametrize("w_save,w_load", WORLD_PAIRS)
+def test_cross_world_round_trip(tmp_path, level, layout, w_save, w_load):
+    """Save at world W, load at world W': gathered state equal bitwise,
+    and the loaded leaves land exactly on the template's shardings (a
+    fresh shard of the gathered arrays — nothing about the saving world
+    leaks into the loaded placement)."""
+    saved_state = _place(_fresh(seed=0), _mesh(w_save), level)
+    path = save_checkpoint(saved_state, epoch=3, best_acc=0.25,
+                           is_best=False, directory=str(tmp_path),
+                           layout=layout)
+    template = _place(_fresh(seed=1), _mesh(w_load), level)
+    loaded, start_epoch, best_acc = load_checkpoint(path, template)
+    assert start_epoch == 4 and best_acc == 0.25
+    for want, got in zip(_gathered(saved_state), _gathered(loaded)):
+        np.testing.assert_array_equal(want, got)
+    for tmpl_leaf, got_leaf in zip(
+            jax.tree_util.tree_leaves(ck._state_tree(template)),
+            jax.tree_util.tree_leaves(ck._state_tree(loaded))):
+        assert got_leaf.sharding == tmpl_leaf.sharding
+
+
+@pytest.mark.parametrize("layout", ["npz", "sharded"])
+def test_cross_world_equals_same_world_resume(tmp_path, layout):
+    """The acceptance identity: a W -> W' load is bit-identical to a
+    same-world (W' -> W') resume of the gathered state."""
+    w_save, w_load = 8, 2
+    saved_state = _place(_fresh(seed=0), _mesh(w_save), 1)
+    path = save_checkpoint(saved_state, epoch=0, best_acc=0.0,
+                           is_best=False, directory=str(tmp_path),
+                           layout=layout)
+    cross, _, _ = load_checkpoint(path, _place(_fresh(seed=1),
+                                               _mesh(w_load), 1))
+    # Same-world twin: re-save the cross-loaded state AT W' and load it
+    # back at W'.
+    twin_dir = tmp_path / "same_world"
+    twin = save_checkpoint(cross, epoch=0, best_acc=0.0, is_best=False,
+                           directory=str(twin_dir), layout=layout)
+    same, _, _ = load_checkpoint(twin, _place(_fresh(seed=2),
+                                              _mesh(w_load), 1))
+    for a, b in zip(_gathered(cross), _gathered(same)):
+        np.testing.assert_array_equal(a, b)
+    for la, lb in zip(jax.tree_util.tree_leaves(ck._state_tree(cross)),
+                      jax.tree_util.tree_leaves(ck._state_tree(same))):
+        assert la.sharding == lb.sharding
+        for sa, sb in zip(la.addressable_shards, lb.addressable_shards):
+            np.testing.assert_array_equal(np.asarray(sa.data),
+                                          np.asarray(sb.data))
+
+
+def test_world_stamp_round_trip(tmp_path):
+    """Both layouts stamp the saving world into meta, readable without
+    touching array bytes (the inspection surface the elastic resume
+    path and serve boot use)."""
+    state = _place(_fresh(), _mesh(8), None)
+    for layout in ("npz", "sharded"):
+        path = save_checkpoint(state, epoch=0, best_acc=0.0,
+                               is_best=False,
+                               directory=str(tmp_path / layout),
+                               layout=layout)
+        world = checkpoint_world(path)
+        assert world == {"processes": 1, "devices": 8}
+
+
+def test_pre_stamp_checkpoint_has_no_world(tmp_path):
+    """Checkpoints saved before the stamp existed read as None — no
+    provenance, and the restore path reshards regardless."""
+    state = _fresh()
+    path = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                           directory=str(tmp_path), process_index=0)
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        payload = {k: z[k] for k in z.files if k != "__meta__"}
+    del meta["world"]
+    with open(path, "wb") as f:
+        np.savez(f, __meta__=np.frombuffer(
+            json.dumps(meta).encode(), np.uint8), **payload)
+    assert checkpoint_world(path) is None
+    loaded, epoch, _ = load_checkpoint(path, _fresh(seed=1))
+    assert epoch == 1
+    for a, b in zip(_gathered(state), _gathered(loaded)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_missing_shards_error_names_saving_world(tmp_path):
+    """A shard-coverage gap on a world-stamped directory is reported as
+    the incomplete filesystem view it is: the error names how many
+    index files the saving world wrote vs how many are visible."""
+    state = _place(_fresh(), _mesh(8), 1)
+    path = save_checkpoint(state, epoch=0, best_acc=0.0, is_best=False,
+                           directory=str(tmp_path), layout="sharded")
+    meta_path = os.path.join(path, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["world"]["processes"] = 4  # as if 3 peers' files never synced
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    os.remove(os.path.join(path, "shards_p00000.npz"))
+    with pytest.raises(ValueError, match="4-process world"):
+        load_checkpoint(path, _place(_fresh(seed=1), _mesh(8), 1))
+
+
+def _resume_args(ckpt_dir):
+    from pytorch_distributed_mnist_tpu.cli import build_parser
+
+    return build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "linear",
+        "--epochs", "1", "--batch-size", "64",
+        "--synthetic-train-size", "256", "--synthetic-test-size", "128",
+        "--trainer-mode", "stepwise", "--seed", "0",
+        "--optimizer-sharding", "zero1",
+        "--checkpoint-dir", str(ckpt_dir), "--resume", "auto",
+    ])
+
+
+def test_corrupt_latest_cross_world_falls_back(tmp_path):
+    """The elastic resume path composed with PR 2's quarantine: the
+    latest checkpoint (saved at a DIFFERENT world, sharded layout) is
+    corrupt; --resume auto quarantines it and falls back to the
+    next-older epoch — which is ALSO a cross-world file — and the run
+    proceeds from there."""
+    from pytorch_distributed_mnist_tpu.cli import run
+
+    old_world = _place(_fresh(seed=0), _mesh(4), 1)
+    older = save_checkpoint(old_world, epoch=0, best_acc=0.1,
+                            is_best=False, directory=str(tmp_path),
+                            layout="sharded")
+    latest = save_checkpoint(old_world, epoch=1, best_acc=0.2,
+                             is_best=False, directory=str(tmp_path),
+                             layout="sharded")
+    # The in-process 'other world' is a device-subset mesh, so the meta
+    # stamp records THIS process's world; rewrite it to what a real
+    # 4-host save would have stamped, so the resume sees a cross-world
+    # file by inspection.
+    meta_path = os.path.join(older, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["world"] = {"processes": 4, "devices": 4}
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    shard = os.path.join(latest, "shards_p00000.npz")
+    with open(shard, "wb") as f:
+        f.write(b"this is not a zip file")
+    summary = run(_resume_args(tmp_path))
+    # Fell back past the quarantined epoch 1 to epoch 0 (resume at 1).
+    assert summary["start_epoch"] == 1
+    assert os.path.isdir(str(latest) + ".corrupt")
+    kinds = [ev["kind"] for ev in summary["failure_events"]]
+    assert "checkpoint_quarantined" in kinds
+    assert "checkpoint_reshard" in kinds  # 4-device save, 8-device world

@@ -168,6 +168,34 @@ def test_unregistered_fault_point_asserts():
         sup.maybe_fault("not_a_point")
 
 
+def test_parse_fault_specs_multi():
+    """Comma-joined multi-fault plans: the mid-rebuild chaos shape (a
+    host loss plus an elastic_rebuild sabotage of a survivor)."""
+    plans = sup.parse_fault_specs(
+        "train_epoch:2:kill:1,elastic_rebuild:1:stall")
+    assert [(p.point, p.host, p.kind) for p in plans] == [
+        ("train_epoch", "2", "kill"), ("elastic_rebuild", "1", "stall")]
+    assert plans[1].arg == 3600.0
+    # single-spec back-compat and per-spec validation
+    assert len(sup.parse_fault_specs("eval:0:raise")) == 1
+    with pytest.raises(ValueError, match="unknown fault point"):
+        sup.parse_fault_specs("eval:0:raise,bogus:0:kill")
+    with pytest.raises(ValueError, match="one fault per spec"):
+        sup.FaultPlan.parse("eval:0:raise,eval:1:raise")
+
+
+def test_maybe_fault_multi_plan_fires_matching_point(monkeypatch):
+    """With two plans configured, each point fires only its own."""
+    monkeypatch.setenv(sup.FAULT_ENV,
+                       "eval:*:raise,elastic_rebuild:*:raise")
+    sup.configure(timeout=0, hard_exit_after=None)
+    sup.maybe_fault("train_epoch")  # matches neither plan
+    with pytest.raises(sup.InjectedFault, match="eval"):
+        sup.maybe_fault("eval")
+    with pytest.raises(sup.InjectedFault, match="elastic_rebuild"):
+        sup.maybe_fault("elastic_rebuild")
+
+
 def _analyzer():
     """Thin-wrapper plumbing: since ISSUE 5 the registry<->hook drift
     logic lives in tpumnist-lint (tools/analyzer, ``registry-drift``

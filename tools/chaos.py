@@ -3,9 +3,10 @@
 
 Drives the same local N-process world as ``tpu-mnist --spawn`` with ONE
 process sabotaged at a named fault point (``runtime/supervision.py``'s
-``TPUMNIST_FAULT=point:host:kind[:arg]`` hook), so the agreed-exit
-protocol and the collective watchdogs can be exercised against real
-process deaths instead of monkeypatches:
+``TPUMNIST_FAULT=point:host:kind[:arg]`` hook, comma-join for multiple
+faults), so the agreed-exit protocol, the collective watchdogs, and the
+elastic shrink-don't-exit runtime can be exercised against real process
+deaths instead of monkeypatches:
 
     # what can be injected, and where each point fires
     python tools/chaos.py --list
@@ -22,10 +23,39 @@ process deaths instead of monkeypatches:
         --model linear --epochs 2 --optimizer-sharding zero1 \\
         --trainer-mode stepwise --resume auto
 
-Exit code: 0 when every rank exited 0 (only meaningful for no-fault
-runs); otherwise the first failing rank's code (killed ranks surface as
-128+signal). tests/test_chaos.py runs these scenarios with assertions;
-this tool is the operator-facing way to reproduce one interactively.
+    # ELASTIC: kill host 1 mid-run and watch the world SHRINK instead
+    # of exit — the survivor is re-execed as a 1-host world resumed
+    # from the last published checkpoint and trains to completion
+    python tools/chaos.py --elastic --fault train_epoch:1:kill:1 \\
+        --nprocs 2 -- --dataset synthetic --model linear --epochs 3 \\
+        --optimizer-sharding zero1 --trainer-mode stepwise
+
+    # mid-REBUILD second failure: host 2 dies, then host 1 stalls while
+    # writing its survivor record — the supervisor's settle deadline
+    # kills the straggler and the world shrinks to host 0 alone
+    python tools/chaos.py --elastic --min-world 1 --nprocs 3 \\
+        --fault "resume:2:kill,elastic_rebuild:1:stall" -- \\
+        --dataset synthetic --model linear --epochs 3 --batch-size 48 \\
+        --trainer-mode stepwise --resume auto
+
+Fault host indices are process RANKS within the world that reads the
+plan — in an elastic run each rebuilt generation renumbers its ranks
+0..W'-1, so a spec aimed at rank 2 cannot re-fire once the world is
+smaller than 3 (the usual way to target "the first failure only").
+For a shrink to happen the survivors must reach a HOST-side failure
+(an agreement, or a transport error): at 3+ ranks a kill mid-device-
+program parks the others in a timeout-less gloo collective — bounded
+by the supervisor's settle deadline, but recordless ranks count dead
+(the residual-hazard boundary in docs/DESIGN.md) — so aim elastic
+faults at supervised phases (resume, ckpt_*) on worlds above 2.
+
+Exit code: 0 when every rank exited 0 (for elastic runs: the job
+trained to completion on whatever world remained); otherwise the first
+failing rank's code (killed ranks surface as 128+signal; an elastic
+shrink past --min-world exits the supervisor's floor code).
+tests/test_chaos.py and tests/test_elastic_chaos.py run these scenarios
+with assertions; this tool is the operator-facing way to reproduce one
+interactively.
 
 ``--list`` is the drift gate: tests/test_supervision.py pins that its
 output, the ``FAULT_POINTS`` registry, and the ``maybe_fault()`` call
@@ -46,11 +76,14 @@ if _REPO not in sys.path:
 from pytorch_distributed_mnist_tpu.parallel.launcher import (  # noqa: E402
     spawn_local,
 )
+from pytorch_distributed_mnist_tpu.runtime.elastic import (  # noqa: E402
+    supervise,
+)
 from pytorch_distributed_mnist_tpu.runtime.supervision import (  # noqa: E402
     FAULT_ENV,
     FAULT_POINTS,
     TIMEOUT_ENV,
-    FaultPlan,
+    parse_fault_specs,
 )
 
 
@@ -68,9 +101,26 @@ def main(argv=None) -> int:
     p.add_argument("--list", action="store_true",
                    help="enumerate injectable fault points and exit")
     p.add_argument("--fault", type=str, default=None,
-                   metavar="POINT:HOST:KIND[:ARG]",
-                   help="the fault to inject (see --list; kinds: kill, "
-                        "raise, stall). Omit for a clean control run")
+                   metavar="POINT:HOST:KIND[:ARG][,...]",
+                   help="the fault(s) to inject (see --list; kinds: "
+                        "kill, raise, stall; comma-join for multiple, "
+                        "e.g. a host loss plus an elastic_rebuild "
+                        "sabotage of a survivor). Omit for a clean "
+                        "control run")
+    p.add_argument("--elastic", action="store_true",
+                   help="run under the elastic supervisor "
+                        "(runtime/elastic.py): a host loss SHRINKS the "
+                        "world — survivors re-exec at the smaller size "
+                        "and resume from the last published checkpoint "
+                        "— instead of ending the run")
+    p.add_argument("--min-world", type=int, default=1, metavar="W",
+                   help="elastic floor: stop shrinking below W healthy "
+                        "hosts (default 1)")
+    p.add_argument("--settle-timeout", type=float, default=60.0,
+                   help="elastic: seconds the supervisor waits for the "
+                        "remaining ranks to exit once one has failed, "
+                        "before killing stragglers and shrinking "
+                        "without them (default 60)")
     p.add_argument("--nprocs", type=int, default=2,
                    help="local host processes to spawn (default 2)")
     p.add_argument("--agreement-timeout", type=float, default=15.0,
@@ -79,7 +129,8 @@ def main(argv=None) -> int:
                         "the watchdog — a hang is the bug under test)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="whole-run wall clock bound before every rank "
-                        "is killed (default 600s)")
+                        "is killed (default 600s); for elastic runs, "
+                        "the per-generation bound")
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="arguments after -- go to tpu-mnist verbatim")
     args = p.parse_args(argv)
@@ -89,7 +140,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.fault:
-        FaultPlan.parse(args.fault)  # fail fast with the spec's message
+        parse_fault_specs(args.fault)  # fail fast with the spec's message
         os.environ[FAULT_ENV] = args.fault
     else:
         os.environ.pop(FAULT_ENV, None)
@@ -99,9 +150,15 @@ def main(argv=None) -> int:
     if cli_args and cli_args[0] == "--":
         cli_args = cli_args[1:]
     print(f"chaos: spawning {args.nprocs} ranks"
+          + (" under the elastic supervisor" if args.elastic else "")
           + (f", fault {args.fault}" if args.fault else " (control run)")
           + f", agreement timeout {args.agreement_timeout:g}s",
           file=sys.stderr)
+    if args.elastic:
+        return supervise(
+            args.nprocs, cli_args, min_world=args.min_world,
+            settle_timeout=args.settle_timeout,
+            generation_timeout=args.timeout)
     return spawn_local(args.nprocs, cli_args, timeout=args.timeout)
 
 
