@@ -335,6 +335,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "healthy hosts remain, instead of training on "
                         "a world this small (default 1: a single "
                         "survivor finishes the job alone)")
+    p.add_argument("--elastic-grow", action="store_true",
+                   help="make topology change bidirectional: each "
+                        "epoch boundary runs a grow rendezvous — rank "
+                        "0 checks the elastic dir for join records "
+                        "(announce_join: a returned or replacement "
+                        "host announcing itself), the observation is "
+                        "agreed, and when joiners are pending the "
+                        "generation yields so the supervisor rebuilds "
+                        "it LARGER, resumed from the last published "
+                        "checkpoint (the (W, W') reshard matrix "
+                        "already covers W' > W). Without this flag "
+                        "joiners are still admitted whenever a failure "
+                        "rebuild happens anyway. Requires --elastic")
+    p.add_argument("--max-world", type=int, default=0, metavar="W",
+                   help="elastic ceiling for the grow direction: never "
+                        "admit joiners past W total hosts (their join "
+                        "records stay pending); 0 (default) = "
+                        "unbounded. Requires --elastic")
     p.add_argument("--agreement-timeout", type=float, default=None,
                    metavar="SECONDS",
                    help="watchdog deadline for every multi-host agreement "
@@ -618,12 +636,17 @@ def _resolve_resume_auto(args) -> str:
 
 def _note_cross_world_resume(resume_path: str) -> None:
     """Meta-only inspection before the resume load: when the checkpoint
-    was saved by a DIFFERENT world (the elastic shrink path, or any
-    relaunch at a new topology), say so up front — the restore is a
+    was saved by a DIFFERENT world (the elastic shrink/grow paths, or
+    any relaunch at a new topology), say so up front — the restore is a
     deliberate cross-world reshard, recorded as a ``checkpoint_reshard``
-    event, not a surprise to reconstruct from a failed load. Best-effort
-    on purpose: unreadable meta is left for the load itself to classify
-    (corruption vs mismatch), pre-stamp checkpoints carry no provenance.
+    event LABELED with its direction (``grow`` when this world is
+    larger than the saving one — lexicographic on (processes, devices),
+    the order resharding cost follows — ``shrink`` when smaller), so
+    the metrics JSONL tells the two elastic directions apart without
+    diffing member lists. Not a surprise to reconstruct from a failed
+    load. Best-effort on purpose: unreadable meta is left for the load
+    itself to classify (corruption vs mismatch), pre-stamp checkpoints
+    carry no provenance.
     """
     from pytorch_distributed_mnist_tpu.train.checkpoint import (
         checkpoint_world,
@@ -638,16 +661,24 @@ def _note_cross_world_resume(resume_path: str) -> None:
     current = {"processes": process_count(),
                "devices": jax.device_count()}
     if saved != current:
+        # The worlds differ, and both dicts hold exactly (processes,
+        # devices), so the tuple comparison is a strict two-way split.
+        if (current["processes"], current["devices"]) \
+                > (saved["processes"], saved["devices"]):
+            direction = "grow"
+        else:
+            direction = "shrink"
         failure_events.record(
             "checkpoint_reshard",
             f"{resume_path}: saved by a {saved['processes']}-process/"
             f"{saved['devices']}-device world; resharding onto this "
             f"{current['processes']}-process/{current['devices']}-device "
-            f"world", saved=saved, current=current)
+            f"world ({direction})", saved=saved, current=current,
+            direction=direction)
         log0(f"=> checkpoint '{resume_path}' was saved at world "
              f"{saved['processes']}x{saved['devices']} (processes x "
              f"devices); resharding onto {current['processes']}x"
-             f"{current['devices']}")
+             f"{current['devices']} ({direction})")
 
 
 def _resume_supervised(args, state):
@@ -1503,6 +1534,7 @@ def _run_body(args, epoch_callback=None) -> dict:
     # device_put, and a daemon thread mid-device_put racing interpreter
     # teardown is a crash. Listed last so it exits FIRST (before the
     # saver drains its write).
+    grow_joiners = None
     with profile_trace(args.profile_dir), (
         saver if saver is not None else nullcontext()
     ), closing(trainer):
@@ -1571,6 +1603,25 @@ def _run_body(args, epoch_callback=None) -> dict:
                 })
             if epoch_callback is not None and epoch_callback(epoch, history[-1]):
                 break
+            if epoch + 1 < args.epochs:
+                # The elastic grow rendezvous (no-op outside an
+                # --elastic-grow supervisor): after this epoch's
+                # checkpoint save, agree whether join records are
+                # pending. Gated off the LAST epoch — a finished job
+                # has nothing to grow for. On a yes, BREAK rather than
+                # raise: the saver context below must exit CLEANLY so
+                # an async saver's deferred publish barrier runs — only
+                # then does yield_for_grow exit the process, and the
+                # grown world really resumes from THIS epoch.
+                grow_joiners = elastic.maybe_grow_rendezvous()
+                if grow_joiners:
+                    break
+    if grow_joiners:
+        # Saver context exited cleanly above: every checkpoint —
+        # including an async saver's deferred sharded publish — is on
+        # disk and published. Now (and only now) the generation may
+        # yield; the grown world resumes from the epoch just trained.
+        elastic.yield_for_grow(grow_joiners)
     supervision.set_phase("shutdown")
     ips = timer.images_per_sec
     log0(f"throughput: {ips:,.0f} images/sec "
@@ -1650,6 +1701,17 @@ def main(argv: Optional[list] = None) -> None:
             f"--min-world {args.min_world} exceeds the initial world "
             f"size --spawn {args.spawn}"
         )
+    if (args.elastic_grow or args.max_world) and not args.elastic:
+        raise SystemExit(
+            "--elastic-grow/--max-world shape the elastic supervisor's "
+            "grow direction; they require --elastic (and --spawn N)"
+        )
+    if args.max_world < 0 or (args.elastic and args.max_world
+                              and args.max_world < args.spawn):
+        raise SystemExit(
+            f"--max-world {args.max_world} is below the initial world "
+            f"size --spawn {args.spawn} (0 = unbounded)"
+        )
     if args.spawn:
         if args.spawn < 2:
             raise SystemExit(
@@ -1667,9 +1729,11 @@ def main(argv: Optional[list] = None) -> None:
         if args.elastic:
             # The elastic supervisor: same local world as --spawn, but a
             # host loss shrinks it (survivors re-exec at W-1 resumed
-            # from the last published checkpoint) instead of ending it.
+            # from the last published checkpoint) instead of ending it —
+            # and with --elastic-grow, announced joiners grow it back.
             raise SystemExit(elastic.supervise(
-                args.spawn, argv, min_world=args.min_world))
+                args.spawn, argv, min_world=args.min_world,
+                max_world=args.max_world, grow=args.elastic_grow))
         from pytorch_distributed_mnist_tpu.parallel.launcher import spawn_local
 
         raise SystemExit(spawn_local(args.spawn, argv))

@@ -72,6 +72,43 @@ the chaos harness can kill or stall a survivor *mid-shrink*
 (``tools/chaos.py --elastic --fault
 "resume:2:kill,elastic_rebuild:1:stall"``) and prove the
 second-failure-during-rebuild story end to end.
+
+**Growing the world** (ROADMAP item 3: topology change as a routine
+event, both directions): a returned or replacement host announces
+itself by writing a **join record** (``announce_join``) into the same
+rendezvous directory the survivor votes live in. Join records are
+admitted at *generation boundaries* — the only points where the world
+is already being rebuilt and a membership change costs nothing extra:
+
+- after any failure-triggered rebuild, unconditionally (a replacement
+  arriving mid-shrink rides the rebuild that is happening anyway — a
+  simultaneous loss-plus-replacement re-launches at the same size);
+- at an **epoch-boundary grow rendezvous** when the supervisor runs
+  with ``--elastic-grow``: rank 0 lists pending join records after each
+  epoch's checkpoint publish, the observation is agreed over the one
+  supervision record channel (symmetric — every rank runs the same
+  collective), and when joiners are pending every rank writes a YIELD
+  record and exits with the distinct ``EXIT_GROW`` code. To the
+  supervisor a yielded generation is a planned regroup, not a failure:
+  yielders are survivors by record, joiners are appended (stable new
+  host ids, capped by ``--max-world``), and generation ``g+1`` re-execs
+  as ranks ``0..W'-1`` with ``W' > W``.
+
+The resume bit is the part that was already paid for: ``--resume auto``
+resolves the last published checkpoint and ``load_checkpoint``'s
+(W, W') reshard matrix covers W' > W exactly as it covers W' < W
+(``tests/test_reshard.py``), so the grown world's state is bit-identical
+to a fresh large-world shard of the same arrays. The rebuilt generation
+records a ``world_grown`` event (mirror of ``world_shrunk``) into the
+run summary and the metrics JSONL.
+
+What a joiner cannot do: join MID-collective. A generation's membership
+is fixed at ``jax.distributed`` initialize time, so a joiner is only
+ever admitted between generations — it waits (its record pending) until
+the next boundary. Stale join records — a host that is already a member
+(e.g. its own pre-loss record resurfacing) — are consumed and ignored,
+never double-admitted; records beyond the ``--max-world`` cap stay
+pending for a later boundary.
 """
 
 from __future__ import annotations
@@ -101,12 +138,28 @@ DIR_ENV = "TPUMNIST_ELASTIC_DIR"
 GEN_ENV = "TPUMNIST_ELASTIC_GEN"
 MEMBERS_ENV = "TPUMNIST_ELASTIC_MEMBERS"
 PREV_ENV = "TPUMNIST_ELASTIC_PREV"
+# Set ("1") by a supervisor running with --elastic-grow: workers then
+# run the epoch-boundary grow rendezvous (maybe_grow_rendezvous).
+GROW_ENV = "TPUMNIST_ELASTIC_GROW"
+# The supervisor's --max-world cap, mirrored to workers so a world
+# already AT the cap skips the rendezvous entirely: without this, a
+# join record the supervisor can only defer would re-trigger a yield
+# (full teardown + re-exec) at EVERY epoch boundary.
+MAX_WORLD_ENV = "TPUMNIST_ELASTIC_MAX_WORLD"
 
 # Supervisor exit code when survivors would form a world below
 # --min-world: distinct from worker failure codes (1, watchdog 75,
 # signal 128+N) so an operator-side restart policy can tell "the job
 # shrank past the floor you set" from "the job failed".
 EXIT_FLOOR = 78
+
+# Worker exit code for the planned grow rendezvous: every rank of a
+# generation that agreed pending joiners exist yields with this code
+# (plus a YIELD record — either alone proves the rank is healthy), so
+# the supervisor can tell "the world paused to grow" from every failure
+# shape. Distinct from 0 (trained to completion), 75 (watchdog hard
+# exit), and 78 (the supervisor's floor).
+EXIT_GROW = 76
 
 # Substrings that mark an exception as transport-shaped: the peer died
 # while this host was inside a DEVICE program (a step's psum) or another
@@ -147,6 +200,92 @@ def _members_from_env() -> List[int]:
 def record_path(directory: str, generation: int, rank: int) -> str:
     return os.path.join(directory,
                         f"survivor_g{generation:03d}_r{rank:05d}.json")
+
+
+def join_path(directory: str, host: int) -> str:
+    return os.path.join(directory, f"join_h{host:05d}.json")
+
+
+def announce_join(directory: str, host: int) -> str:
+    """The joiner's announcement: a returned or replacement host writes
+    one join record into the rendezvous directory and waits to be
+    admitted at the next generation boundary (a failure rebuild, or an
+    epoch-boundary grow rendezvous under ``--elastic-grow``). ``host``
+    is the stable host id the new member will carry; a RETURNED host
+    reuses its old id, a replacement picks an unused one. Atomic
+    tmp+replace like the survivor votes, so the supervisor never reads
+    a torn announcement. Returns the record path.
+
+    This is the whole joiner-side protocol on purpose: admission, rank
+    assignment, and resume all belong to the supervisor and the rebuilt
+    generation — a joiner cannot enter a *running* world (jax.distributed
+    membership is fixed at initialize time), so anything beyond
+    "announce and wait" would be a lie about what a mid-collective
+    joiner can do.
+    """
+    record = {"host": int(host), "wall": round(time.time(), 3)}
+    path = join_path(directory, int(host))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, path)
+    return path
+
+
+def pending_joins(directory: str) -> List[Tuple[int, str]]:
+    """All parseable join records in the rendezvous dir, sorted by host
+    id: ``[(host, path), ...]``. Malformed records are warned about and
+    skipped (never admitted, never deleted — the evidence stays for the
+    operator); missing/unreadable dirs read as no joiners."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("join_h") and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                host = int(json.load(f)["host"])
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as exc:
+            print(f"WARNING: ignoring malformed join record {path!r} "
+                  f"({exc!r})", file=sys.stderr, flush=True)
+            continue
+        out.append((host, path))
+    return sorted(out)
+
+
+def plan_grow(
+    members: Sequence[int],
+    join_hosts: Sequence[int],
+    max_world: int = 0,
+) -> Tuple[List[int], List[int], List[int]]:
+    """The grow half of the membership decision, as a pure function:
+    ``(new_members, admitted, stale)``.
+
+    Joiners are appended to the surviving members in host-id order
+    (survivor ranks stay a prefix: the grown world's rank 0 is the old
+    world's rank 0, which keeps log-follows-rank-0 stable across
+    regrows). ``stale`` joiners — already members — are ignored (and the
+    caller consumes their records so a host's pre-loss announcement can
+    never readmit it after a later death). ``max_world`` (0 = unbounded)
+    caps the TOTAL world size; joiners beyond the cap are neither
+    admitted nor stale — they stay pending for a later boundary.
+    """
+    members = list(members)
+    admitted: List[int] = []
+    stale: List[int] = []
+    for host in sorted(set(int(h) for h in join_hosts)):
+        if host in members:
+            stale.append(host)
+            continue
+        if max_world and len(members) + len(admitted) >= max_world:
+            continue  # deferred: stays pending for a later boundary
+        admitted.append(host)
+    return members + admitted, admitted, stale
 
 
 def write_survivor_record(error: BaseException) -> Optional[str]:
@@ -226,29 +365,148 @@ def write_survivor_record(error: BaseException) -> Optional[str]:
     return path
 
 
+def write_yield_record(join_hosts: Sequence[int]) -> Optional[str]:
+    """Worker-side grow vote: serialize this rank's healthy yield at a
+    grow rendezvous (the grow twin of ``write_survivor_record``, written
+    on the agreed EXIT_GROW path rather than an unwind). A yield record
+    is proof of a live, healthy rank — ``plan_next_world`` counts it a
+    survivor — with ``yield: true`` telling the supervisor the
+    generation paused to grow rather than failed. Best-effort like the
+    survivor vote: on a write failure the rank still exits EXIT_GROW,
+    which the supervisor maps to survivor on its own."""
+    directory = os.environ.get(DIR_ENV, "")
+    if not directory:
+        return None
+    members = _members_from_env()
+    generation = int(os.environ.get(GEN_ENV, "0") or 0)
+    rank = supervision.process_index()
+    record = {
+        "generation": generation,
+        "rank": rank,
+        "host": members[rank] if rank < len(members) else rank,
+        "yield": True,
+        "join_hosts": sorted(int(h) for h in join_hosts),
+        "dead_ranks": [],
+        "dead_hosts": [],
+        "phase": "grow_check",
+        "reason": f"grow rendezvous: pending joiner(s) "
+                  f"{sorted(int(h) for h in join_hosts)}",
+        "wall": round(time.time(), 3),
+    }
+    path = record_path(directory, generation, rank)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+    except Exception as exc:  # noqa: BLE001 - EXIT_GROW still proves the yield
+        print(f"WARNING: elastic yield record {path} could not be "
+              f"written ({exc!r}); the EXIT_GROW code alone carries the "
+              f"vote", file=sys.stderr, flush=True)
+        return None
+    return path
+
+
+def maybe_grow_rendezvous() -> Optional[List[int]]:
+    """Worker-side, at each epoch boundary (after the checkpoint save):
+    agree whether join records are pending. Returns the agreed joiner
+    host list when the generation should yield for a grow, ``None``
+    otherwise (not an elastic-grow worker, world at ``--max-world``, or
+    nothing pending). The caller (``cli``'s epoch loop) breaks out of
+    training CLEANLY on a non-None return and only then calls
+    :func:`yield_for_grow` — ordering that matters under
+    ``--async-checkpoint``, where the deferred publish barrier runs on
+    the saver's *clean* exit: raising from inside the saver scope would
+    DROP the just-saved epoch's unpublished checkpoint and make the
+    grown world resume one epoch back.
+
+    Symmetry is the whole design: rank 0 alone lists the rendezvous dir
+    (host-local file I/O — per-rank listings could disagree on a shared
+    filesystem's attribute cache), but EVERY rank runs the one agreement
+    collective, and every rank acts on rank 0's detail — so all ranks
+    yield or none do, and the collective count stays aligned.
+    """
+    directory = os.environ.get(DIR_ENV, "")
+    if not directory or os.environ.get(GROW_ENV, "") != "1":
+        return None
+    members = _members_from_env()
+    max_world = int(os.environ.get(MAX_WORLD_ENV, "0") or 0)
+    if max_world and len(members) >= max_world:
+        # At the cap, nothing can be admitted: yielding would tear the
+        # world down for a rendezvous the supervisor could only defer —
+        # and the still-pending record would re-trigger it EVERY epoch.
+        # (Below the cap a yield always admits at least one joiner:
+        # stale member records are filtered right here.)
+        return None
+    joins: List[int] = []
+    if supervision.process_index() == 0:
+        joins = [h for h, _ in pending_joins(directory)
+                 if h not in set(members)]
+    supervision.set_phase("grow_check")
+    records = supervision.allgather_records(
+        "grow_check", True, ",".join(str(h) for h in joins))
+    supervision.raise_if_poisoned(records, "the grow rendezvous")
+    detail = records[0].detail
+    if not detail:
+        return None
+    return [int(tok) for tok in detail.split(",") if tok.strip()]
+
+
+def yield_for_grow(join_hosts: Sequence[int]) -> None:
+    """Worker-side, after the epoch loop unwound cleanly (checkpoints —
+    including an async saver's deferred publish — all on disk): write
+    this rank's YIELD record and exit ``EXIT_GROW``. Always raises.
+
+    The raise is an agreed symmetric exit (marked, never poisoned):
+    every rank of the generation reached the same ``grow_check``
+    agreement and leaves through here — to the supervisor, EXIT_GROW
+    plus yield records is a planned regroup."""
+    join_hosts = list(join_hosts)
+    write_yield_record(join_hosts)
+    print(f"process {supervision.process_index()}: joiner(s) "
+          f"{join_hosts} pending — yielding for the grow rendezvous "
+          f"(exit {EXIT_GROW}); the supervisor rebuilds the world "
+          f"larger and resumes from the last published checkpoint",
+          file=sys.stderr, flush=True)
+    exc = SystemExit(EXIT_GROW)
+    supervision.mark_agreed(exc)  # symmetric: every rank leaves raising this
+    raise exc
+
+
 def note_rebuilt_world() -> None:
-    """Worker-side, at run start: record the ``world_shrunk`` failure
-    event when this process is the first generation after a shrink.
+    """Worker-side, at run start: record the ``world_shrunk`` /
+    ``world_grown`` failure event when this process is the first
+    generation after a membership change.
 
     Called from ``cli._run_body`` after the failure-event log is reset
     and its metrics sink attached, so the old/new membership lands in
     BOTH the run summary's ``failure_events`` block and the
     ``--metrics-file`` JSONL — the one place an operator (or the
-    acceptance twin) reads what the world survived. No-op outside a
-    rebuilt elastic generation.
+    acceptance twins) reads what the world survived. Direction is sized:
+    more members than the previous generation is a grow, fewer a
+    shrink; a same-size membership CHANGE (a loss whose replacement
+    rode the same rebuild) records as ``world_grown`` — a new host
+    joined, and the old/new member lists carry the loss. No-op outside
+    a rebuilt elastic generation, and for an unchanged relaunch.
     """
     prev = os.environ.get(PREV_ENV, "")
     if not prev or not os.environ.get(DIR_ENV, ""):
         return
     from pytorch_distributed_mnist_tpu.utils.profiling import (
+        record_world_grown,
         record_world_shrunk,
     )
 
     supervision.set_phase("rebuild")
     old_members = [int(t) for t in prev.split(",") if t.strip() != ""]
     new_members = _members_from_env()
+    if new_members == old_members:
+        return  # a same-membership relaunch changed no topology
     generation = int(os.environ.get(GEN_ENV, "0") or 0)
-    record_world_shrunk(old_members, new_members, generation)
+    if len(new_members) < len(old_members):
+        record_world_shrunk(old_members, new_members, generation)
+    else:
+        record_world_grown(old_members, new_members, generation)
 
 
 # ---------------------------------------------------------------------------
@@ -258,12 +516,14 @@ def note_rebuilt_world() -> None:
 
 #: Flags consumed by the supervisor itself; stripped from worker argv
 #: (a worker seeing --elastic without --spawn would reject it).
-_SUPERVISOR_FLAGS = {"--elastic": 0, "--min-world": 1}
+_SUPERVISOR_FLAGS = {"--elastic": 0, "--min-world": 1,
+                     "--elastic-grow": 0, "--max-world": 1}
 
 
 def strip_elastic_flags(argv: Sequence[str]) -> List[str]:
     """Remove supervisor-only flags (``--elastic``, ``--min-world N``,
-    ``=``-joined forms included) from an argv copy."""
+    ``--elastic-grow``, ``--max-world N``, ``=``-joined forms included)
+    from an argv copy."""
     return strip_flags(argv, _SUPERVISOR_FLAGS)
 
 
@@ -332,6 +592,8 @@ def _run_generation(
     prev_members: Optional[List[int]],
     settle_timeout: float,
     generation_timeout: float,
+    grow: bool = False,
+    max_world: int = 0,
 ) -> GenerationResult:
     """Spawn one generation's worker processes and wait them all out.
 
@@ -355,6 +617,14 @@ def _run_generation(
         env[PREV_ENV] = ",".join(str(m) for m in prev_members)
     else:
         env.pop(PREV_ENV, None)
+    if grow:
+        env[GROW_ENV] = "1"
+    else:
+        env.pop(GROW_ENV, None)
+    if max_world:
+        env[MAX_WORLD_ENV] = str(max_world)
+    else:
+        env.pop(MAX_WORLD_ENV, None)
 
     rendezvous: List[str] = []
     if nranks > 1:
@@ -435,19 +705,33 @@ def supervise(
     argv: Sequence[str],
     *,
     min_world: int = 1,
+    max_world: int = 0,
+    grow: bool = False,
+    rejoin: Sequence[Tuple[int, int]] = (),
     settle_timeout: float = 60.0,
     generation_timeout: float = 600.0,
     rendezvous_dir: Optional[str] = None,
 ) -> int:
     """Run an elastic local world: spawn ``nprocs`` ranks, and on a host
     loss rebuild the survivors into a smaller world resumed from the
-    last published checkpoint, until the job completes or cannot
-    continue. Returns a process exit code (0 = the job trained to
-    completion on whatever world remained).
+    last published checkpoint — and, when join records land in the
+    rendezvous dir, rebuild the world LARGER the same way — until the
+    job completes or cannot continue. Returns a process exit code (0 =
+    the job trained to completion on whatever world remained).
+
+    ``grow`` (``--elastic-grow``) additionally makes every generation
+    run the epoch-boundary grow rendezvous, so joiners are admitted
+    between epochs instead of only riding failure rebuilds. ``max_world``
+    (``--max-world``, 0 = unbounded) caps the grown size. ``rejoin`` is
+    the local-simulation hook behind ``tools/chaos.py --rejoin``: for
+    each ``(host, generation)`` pair the supervisor writes that host's
+    join record just before spawning that generation — deterministic
+    stand-in for a replacement host announcing itself while generation
+    ``g`` runs.
 
     The local twin of a cluster manager's restart policy, driven by
-    ``tpu-mnist --spawn N --elastic [--min-world M]`` and
-    ``tools/chaos.py --elastic``. Non-shrink failures propagate: a
+    ``tpu-mnist --spawn N --elastic [--min-world M] [--elastic-grow]``
+    and ``tools/chaos.py --elastic``. Non-shrink failures propagate: a
     generation that fails with no survivor records and no one killed
     (a symmetric agreed abort, a bad flag) exits with that failure's
     code rather than thrashing through rebuild attempts.
@@ -462,6 +746,10 @@ def supervise(
         raise ValueError(
             f"--min-world {min_world} exceeds the initial world size "
             f"{nprocs}")
+    if max_world < 0 or (max_world and max_world < nprocs):
+        raise ValueError(
+            f"--max-world {max_world} is below the initial world size "
+            f"{nprocs} (0 = unbounded)")
     base_argv = strip_spawn_flag(strip_elastic_flags(argv))
     own_dir = rendezvous_dir is None
     if own_dir:
@@ -471,25 +759,76 @@ def supervise(
     generation = 0
     rc: Optional[int] = None
 
+    def _admit_joiners(new_members: List[int]) -> List[int]:
+        """Read, plan, and consume pending join records against the
+        next world's membership; returns the (possibly grown) member
+        list. Stale records (hosts already members) are consumed too —
+        a host's pre-loss announcement must never readmit it after a
+        later death; deferred-by---max-world records stay pending."""
+        pending = pending_joins(rendezvous_dir)
+        if not pending:
+            return new_members
+        paths = dict(pending)
+        grown, admitted, stale = plan_grow(
+            new_members, [h for h, _ in pending], max_world)
+        for host in admitted + stale:
+            try:
+                os.remove(paths[host])
+            except OSError:
+                pass  # consumed logically either way
+        if stale:
+            _say(f"ignoring stale join record(s) for host(s) {stale} "
+                 f"(already members)")
+        deferred = sorted(set(h for h, _ in pending)
+                          - set(admitted) - set(stale))
+        if deferred:
+            _say(f"join record(s) for host(s) {deferred} deferred: "
+                 f"--max-world {max_world} caps the world; they stay "
+                 f"pending for a later boundary")
+        if admitted:
+            _say(f"admitting joiner host(s) {admitted} into the next "
+                 f"generation")
+        return grown
+
     def _loop() -> int:
         nonlocal members, prev, generation
         while True:
             child_argv = list(base_argv)
             if generation > 0:
                 child_argv = _strip_resume(child_argv) + ["--resume", "auto"]
+            for host, at_generation in rejoin:
+                # The chaos/test hook: this host's join record lands
+                # while generation `at_generation` runs (written just
+                # before the spawn — deterministic, and exactly what a
+                # real replacement host would do via announce_join).
+                if at_generation == generation:
+                    announce_join(rendezvous_dir, host)
+                    _say(f"host {host} announced a join (rejoin hook); "
+                         f"admitted at the next generation boundary")
             _say(f"generation {generation}: world size {len(members)} "
                  f"(hosts {members})"
                  + (", resuming from the last published checkpoint"
                     if generation else ""))
             result = _run_generation(
                 generation, members, child_argv, rendezvous_dir, prev,
-                settle_timeout, generation_timeout)
+                settle_timeout, generation_timeout, grow=grow,
+                max_world=max_world)
             if result.clean:
                 _say(f"generation {generation}: trained to completion "
                      f"on world size {len(members)}")
                 return 0
+            # EXIT_GROW is a healthy planned yield, not a failure: map
+            # it to a clean exit for the membership plan (a yield record
+            # normally proves it too, but the exit code alone suffices
+            # when the record write failed).
+            yielded = (
+                any(rc == EXIT_GROW for rc in result.returncodes)
+                or any(rec.get("yield") for rec in result.records.values())
+            )
             survivors, dead = plan_next_world(
-                len(members), result.returncodes,
+                len(members),
+                [0 if rc == EXIT_GROW else rc
+                 for rc in result.returncodes],
                 list(result.records))
             dead_hosts = [members[r] for r in dead]
             for rank in dead:
@@ -499,7 +838,7 @@ def supervise(
                           f"(host {members[rank]}) died "
                           f"(rc={result.returncodes[rank]}) ---\n{tail}",
                           file=sys.stderr, flush=True)
-            if not dead:
+            if not dead and not yielded:
                 # Everyone claims survival yet the generation failed:
                 # a symmetric abort (divergence SystemExit, vote
                 # rejection). There is nothing to shrink around.
@@ -527,6 +866,12 @@ def supervise(
                 _say(f"generation {generation}: record dead-sets "
                      f"disagree with observed exits ({disagreements} vs "
                      f"{dead_hosts}); trusting observed exits")
+            # Joiners ride EVERY generation boundary: the planned grow
+            # rendezvous, and any failure rebuild a replacement arrived
+            # during (admitted before the floor check on purpose — a
+            # loss whose replacement already announced keeps the world
+            # at or above the floor).
+            new_members = _admit_joiners(new_members)
             if len(new_members) < min_world:
                 _say(f"generation {generation}: host(s) {dead_hosts} "
                      f"lost; {len(new_members)} survivor(s) "
@@ -534,11 +879,27 @@ def supervise(
                      f"— exiting ({EXIT_FLOOR}) instead of training on "
                      f"a world the operator ruled out")
                 return EXIT_FLOOR
-            _say(f"generation {generation}: host(s) {dead_hosts} lost "
-                 f"in phase(s) "
-                 f"{sorted({rec.get('phase', '?') for rec in result.records.values()}) or '?'}"
-                 f"; survivors {new_members} agree the shrunk world — "
-                 f"rebuilding at world size {len(new_members)}")
+            if yielded and not dead and new_members == members:
+                # A yield with nothing to admit (the joiner's record
+                # vanished between the workers' check and this plan):
+                # relaunch the same world — never an error, never a
+                # tight loop (the next yield needs a fresh join record;
+                # the --max-world-deferred case cannot reach here, the
+                # workers skip the rendezvous at the cap).
+                _say(f"generation {generation}: grow rendezvous found "
+                     f"nothing to admit; relaunching the same world")
+            elif dead:
+                _say(f"generation {generation}: host(s) {dead_hosts} "
+                     f"lost in phase(s) "
+                     f"{sorted({rec.get('phase', '?') for rec in result.records.values()}) or '?'}"
+                     f"; survivors {[members[r] for r in survivors]} "
+                     f"agree — rebuilding at world size "
+                     f"{len(new_members)} (members {new_members})")
+            else:
+                _say(f"generation {generation}: grow rendezvous — "
+                     f"rebuilding at world size {len(new_members)} "
+                     f"(members {new_members}), resumed from the last "
+                     f"published checkpoint")
             prev, members = members, new_members
             generation += 1
 

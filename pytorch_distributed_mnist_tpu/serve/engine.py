@@ -92,14 +92,19 @@ class InferenceEngine:
     """Params + one AOT-compiled forward executable per batch bucket.
 
     Threading contract: ``logits``/``predict``/``dispatch_logits`` are
-    called from ONE thread at a time (the batcher's dispatch worker
-    serializes device submission — concurrent forward calls to one chip
-    would just contend for it); ``complete`` runs on the batcher's
-    completion worker, which only touches the in-flight batch's own
-    state plus the staging free-list (its own lock); ``swap_params`` may
-    be called from any thread (the reload watcher) at any moment. The
-    only shared mutable state is the params reference + epoch, read
-    together once per batch under the lock.
+    normally called from ONE thread at a time (the batcher's dispatch
+    worker serializes device submission — concurrent forward calls to
+    one chip would just contend for it); ``complete`` runs on the
+    batcher's completion worker, which only touches the in-flight
+    batch's own state plus the staging free-list (its own lock);
+    ``swap_params`` may be called from any thread (the reload watcher)
+    at any moment. One-thread dispatch is a contention guideline, not a
+    correctness invariant: per-batch dispatch state is function-local
+    (chunks, buffers) or lock-protected (the params+epoch capture, the
+    staging free-list), so the pool's failover path may re-dispatch a
+    failed batch from its completion thread concurrently with the
+    dispatch worker. The only shared mutable state is the params
+    reference + epoch, read together once per batch under the lock.
 
     ``device``: pin this engine to one local device — params are
     committed there and every bucket program is AOT-compiled for it

@@ -40,11 +40,44 @@ host-side load per group with that group's ``NamedSharding`` tree, and
 per-group ``CompileLog`` names (``serve_forward_b{b}@{mode}.g{i}``;
 just ``@{mode}`` when one group spans the whole pool) keep the
 zero-recompile verdict attributable.
+
+**Self-healing** (ROADMAP item 3: topology change as a routine event,
+serve side). A replica/mesh-group failure used to take its chips out of
+service until a human restarted the server; now the pool treats it as a
+lifecycle:
+
+- **Attribution.** Every dispatch or completion error lands on the
+  replica that raised it (input-shaped errors — ``ValueError``/
+  ``TypeError``, the request's fault — are exempt: three malformed
+  requests must never condemn a healthy group).
+- **Failover, never a drop.** The failed batch immediately re-dispatches
+  on another healthy replica (the handle keeps the preprocessed rows for
+  exactly this), and only when NO healthy replica remains does the error
+  reach the caller — so a group death under live traffic costs latency,
+  not answers.
+- **Quarantine.** ``quarantine_after`` consecutive failures (any success
+  resets the count) quarantine the replica: the least-loaded dispatcher
+  skips it, the reload fan-out skips it (the rebuild installs the latest
+  params anyway).
+- **Regroup.** A background thread rebuilds the group from its own chips
+  — fresh engine (fresh :class:`MeshPlacement` on the sharded plane),
+  AOT warm, then an atomic install under the pool lock (build and warm
+  run OUTSIDE it: traffic keeps flowing on the healthy groups for the
+  whole rebuild) — and bumps ``topology_generation``. Rebuild failures
+  retry with backoff; an unhealable group stays quarantined and says so.
+
+``resize()`` is the same machinery driven on purpose instead of by
+failure: build + warm the new replica/group layout in the background,
+swap the whole replica list atomically, let in-flight batches complete
+on the old engines they hold handles to. ``topology()`` is the
+observability surface ``/stats`` and ``loadgen --expect-groups`` read.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -56,6 +89,38 @@ from pytorch_distributed_mnist_tpu.serve.engine import (
     _InFlightBatch,
 )
 
+# Chaos-harness fault injection for the serve plane: "GROUP[:AFTER]"
+# makes mesh group / replica GROUP's dispatch raise after AFTER
+# successful dispatches — the single-process stand-in for a group's
+# chips dying under it (the rebuilt generation of the group serves
+# cleanly: the chips come back with the fresh engine). Driven by
+# ``tools/chaos.py --serve --serve-fault`` and the self-healing twins.
+SERVE_FAULT_ENV = "TPUMNIST_SERVE_FAULT"
+
+
+def _parse_serve_fault(spec: str) -> Optional[Tuple[int, int]]:
+    spec = spec.strip()
+    if not spec:
+        return None
+    parts = spec.split(":")
+    try:
+        group = int(parts[0])
+        after = int(parts[1]) if len(parts) > 1 else 0
+        if len(parts) > 2:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"bad {SERVE_FAULT_ENV} spec {spec!r}: expected "
+            f"GROUP_INDEX[:AFTER_N_BATCHES]") from None
+    return group, after
+
+
+def _is_input_error(exc: BaseException) -> bool:
+    """Errors the REQUEST caused (shape/dtype validation), not the
+    replica: they must neither count toward quarantine nor fail over
+    (another replica would reject the same rows identically)."""
+    return isinstance(exc, (ValueError, TypeError))
+
 
 class EngineReplica:
     """One pinned (or mesh-group) engine + the pool's dispatch
@@ -65,11 +130,15 @@ class EngineReplica:
     POOL's lock, not the replica: dispatch-time placement decisions need
     a consistent view across all replicas. ``device`` is the one pinned
     device on the replicated plane; ``devices`` is the full span (a
-    1-tuple there, the mesh group on the sharded plane).
+    1-tuple there, the mesh group on the sharded plane). ``generation``
+    counts rebuilds of this group (0 = the boot engine);
+    ``consecutive_failures``/``quarantined`` are the health state the
+    self-healing lifecycle walks.
     """
 
     __slots__ = ("index", "name", "device", "devices", "engine", "pending",
-                 "dispatched")
+                 "dispatched", "completed", "failures",
+                 "consecutive_failures", "quarantined", "generation")
 
     def __init__(self, index: int, device, engine: InferenceEngine,
                  name: Optional[str] = None, devices=None) -> None:
@@ -80,17 +149,25 @@ class EngineReplica:
         self.engine = engine
         self.pending = 0  # in-flight batches (pool lock)
         self.dispatched = 0  # lifetime batches assigned (pool lock)
+        self.completed = 0  # lifetime batches fetched OK (pool lock)
+        self.failures = 0  # lifetime attributed errors (pool lock)
+        self.consecutive_failures = 0  # reset by any success (pool lock)
+        self.quarantined = False  # skipped by dispatch + reload fan-out
+        self.generation = 0  # rebuilds of this group
 
 
 class _PoolHandle:
-    """An in-flight batch plus the replica that owns it."""
+    """An in-flight batch plus the replica that owns it — and the
+    preprocessed rows themselves, so a completion failure can fail the
+    batch over to a healthy replica instead of dropping it."""
 
-    __slots__ = ("replica", "inflight")
+    __slots__ = ("replica", "inflight", "images")
 
     def __init__(self, replica: EngineReplica,
-                 inflight: _InFlightBatch) -> None:
+                 inflight: _InFlightBatch, images) -> None:
         self.replica = replica
         self.inflight = inflight
+        self.images = images
 
 
 class EnginePool:
@@ -116,18 +193,57 @@ class EnginePool:
         serve_mode: str = "replicated",
         mesh_size: int = 1,
         model_name: Optional[str] = None,
+        quarantine_after: int = 3,
+        auto_regroup: bool = True,
+        regroup_retries: int = 3,
     ) -> None:
         devices = list(devices) if devices is not None \
             else list(jax.local_devices())
         if not devices:
             raise ValueError("EnginePool needs at least one device")
+        if quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got {quarantine_after}")
+        self.apply_fn = apply_fn
         self.serve_log = serve_log
         self.serve_mode = serve_mode
         self.mesh_size = mesh_size
+        self.model_name = model_name
+        self.input_shape = tuple(input_shape)
+        self.workers = workers
         self.n_devices = len(devices)
+        self.quarantine_after = quarantine_after
+        self.auto_regroup = auto_regroup
+        self.regroup_retries = regroup_retries
+        self._buckets = tuple(buckets)
+        self._injected_fault = _parse_serve_fault(
+            os.environ.get(SERVE_FAULT_ENV, ""))
         self._lock = threading.Lock()
-        self.replicas: List[EngineReplica] = []
-        if serve_mode != "replicated":
+        # Latest HOST-side params + epoch (the pre-device_put reference
+        # every fan-out received): what a regroup/resize builds its
+        # fresh engines from, so a rebuilt group can never boot on
+        # boot-time params after a hot reload moved the fleet on.
+        self._params_host = params
+        self._params_host_epoch = params_epoch
+        # Topology bookkeeping (pool lock): generation bumps on every
+        # quarantine/regroup/resize so /stats can say "the shape
+        # changed" without diffing replica rows.
+        self._topology_generation = 0
+        self._regroups = 0
+        self._failovers = 0
+        self._resizing = False
+        self.replicas: List[EngineReplica] = self._make_replicas(
+            devices, mesh_size, params, params_epoch)
+        if serve_log is not None:
+            serve_log.set_replicas_probe(self.snapshot)
+
+    def _make_replicas(self, devices: List, mesh_size: int, params,
+                       params_epoch: Optional[int]) -> List[EngineReplica]:
+        """Build one generation of replicas over ``devices`` — the boot
+        layout and every :meth:`resize` target go through here, so the
+        two can never drift."""
+        replicas: List[EngineReplica] = []
+        if self.serve_mode != "replicated":
             # Sharded plane: partition chips into mesh groups, one
             # spanning engine per group (serve/programs.py owns the
             # mesh/sharding derivation and every validity check).
@@ -135,19 +251,20 @@ class EnginePool:
                 build_group_placements,
             )
 
-            if model_name is None:
+            if self.model_name is None:
                 raise ValueError(
-                    f"serve_mode {serve_mode!r} needs model_name= (the "
-                    f"mode's rule table is per model family)")
+                    f"serve_mode {self.serve_mode!r} needs model_name= "
+                    f"(the mode's rule table is per model family)")
             placements = build_group_placements(
-                serve_mode, model_name, devices, mesh_size, params)
+                self.serve_mode, self.model_name, devices, mesh_size,
+                params)
             for i, placement in enumerate(placements):
                 engine = InferenceEngine(
-                    apply_fn, params, buckets=buckets,
-                    input_shape=input_shape, serve_log=serve_log,
+                    self.apply_fn, params, buckets=self._buckets,
+                    input_shape=self.input_shape, serve_log=self.serve_log,
                     params_epoch=params_epoch, placement=placement,
-                    name=placement.name, workers=workers)
-                self.replicas.append(EngineReplica(
+                    name=placement.name, workers=self.workers)
+                replicas.append(EngineReplica(
                     i, placement.devices[0], engine, name=placement.name,
                     devices=placement.devices))
         else:
@@ -158,13 +275,36 @@ class EnginePool:
             for i, device in enumerate(devices):
                 name = f"r{i}"
                 engine = InferenceEngine(
-                    apply_fn, params, buckets=buckets,
-                    input_shape=input_shape, serve_log=serve_log,
+                    self.apply_fn, params, buckets=self._buckets,
+                    input_shape=self.input_shape, serve_log=self.serve_log,
                     params_epoch=params_epoch, device=device, name=name,
-                    workers=workers)
-                self.replicas.append(EngineReplica(i, device, engine))
-        if serve_log is not None:
-            serve_log.set_replicas_probe(self.snapshot)
+                    workers=self.workers)
+                replicas.append(EngineReplica(i, device, engine))
+        return replicas
+
+    def _build_group_engine(self, devices: Tuple, name: str, params,
+                            params_epoch: Optional[int]) -> InferenceEngine:
+        """One fresh engine for an existing group's chips — the regroup
+        path (the group keeps its name, so its CompileLog programs and
+        /stats row stay attributable across rebuilds)."""
+        if self.serve_mode != "replicated":
+            from pytorch_distributed_mnist_tpu.serve.programs import (
+                build_placement,
+            )
+
+            placement = build_placement(
+                self.serve_mode, self.model_name, list(devices), params,
+                name=name)
+            return InferenceEngine(
+                self.apply_fn, params, buckets=self._buckets,
+                input_shape=self.input_shape, serve_log=self.serve_log,
+                params_epoch=params_epoch, placement=placement,
+                name=name, workers=self.workers)
+        return InferenceEngine(
+            self.apply_fn, params, buckets=self._buckets,
+            input_shape=self.input_shape, serve_log=self.serve_log,
+            params_epoch=params_epoch, device=devices[0], name=name,
+            workers=self.workers)
 
     # -- engine-compatible surface ----------------------------------------
 
@@ -196,17 +336,21 @@ class EnginePool:
         replica's compiles land under its own ``@r{i}`` program names).
         With a warm persistent cache these are fetches; cold, the
         parallelism overlaps N replicas' compile wall-clock."""
+        self._warm(self.replicas)
+
+    @staticmethod
+    def _warm(replicas: Sequence[EngineReplica]) -> None:
         errors: List[BaseException] = []
 
-        def _warm(replica: EngineReplica) -> None:
+        def _one(replica: EngineReplica) -> None:
             try:
                 replica.engine.warmup()
             except BaseException as exc:  # noqa: BLE001 - re-raised below
                 errors.append(exc)
 
-        threads = [threading.Thread(target=_warm, args=(r,), daemon=True,
+        threads = [threading.Thread(target=_one, args=(r,), daemon=True,
                                     name=f"pool-warmup-{r.name}")
-                   for r in self.replicas]
+                   for r in replicas]
         for t in threads:
             t.start()
         for t in threads:
@@ -216,13 +360,24 @@ class EnginePool:
 
     def swap_params(self, params, epoch: Optional[int] = None,
                     path: Optional[str] = None) -> int:
-        """Fan one host-side checkpoint load out to every replica (one
-        ``device_put`` per device). Each replica enforces the
-        swap-ordering rule under its own lock, so a stale fan-out racing
-        a newer one can never downgrade any replica. Returns the number
-        of replicas that installed (0 == stale everywhere)."""
+        """Fan one host-side checkpoint load out to every healthy
+        replica (one ``device_put`` per device). Each replica enforces
+        the swap-ordering rule under its own lock, so a stale fan-out
+        racing a newer one can never downgrade any replica. Quarantined
+        replicas are skipped — their rebuild installs the pool's latest
+        params anyway (tracked here, under the same ordering rule).
+        Returns the number of replicas that installed (0 == stale
+        everywhere)."""
+        with self._lock:
+            stale = (epoch is not None
+                     and self._params_host_epoch is not None
+                     and epoch < self._params_host_epoch)
+            if not stale:
+                self._params_host = params
+                self._params_host_epoch = epoch
+            replicas = [r for r in self.replicas if not r.quarantined]
         installed = 0
-        for replica in self.replicas:
+        for replica in replicas:
             if replica.engine.swap_params(params, epoch=epoch, path=path):
                 installed += 1
         return installed
@@ -230,32 +385,97 @@ class EnginePool:
     # -- dispatch / complete ----------------------------------------------
 
     def dispatch(self, images) -> _PoolHandle:
-        """Assign one formed batch to the least-loaded replica and
-        enqueue it there (JAX async dispatch: returns immediately; the
-        bounded in-flight window lives in the batcher, which is the only
-        caller that can overrun the fleet)."""
-        with self._lock:
-            replica = min(self.replicas, key=lambda r: (r.pending, r.index))
-            replica.pending += 1
-            replica.dispatched += 1
-        try:
-            inflight = replica.engine.dispatch_logits(images)
-        except BaseException:
+        """Assign one formed batch to the least-loaded HEALTHY replica
+        and enqueue it there (JAX async dispatch: returns immediately;
+        the bounded in-flight window lives in the batcher, which is the
+        only caller that can overrun the fleet). A replica whose
+        dispatch raises is attributed and excluded, and the batch fails
+        over to the next healthy replica — the caller sees an error only
+        when no healthy replica remains."""
+        return self._dispatch_excluding(images, set())
+
+    def _dispatch_excluding(self, images, exclude: set) -> _PoolHandle:
+        while True:
             with self._lock:
-                replica.pending -= 1
-            raise
-        return _PoolHandle(replica, inflight)
+                candidates = [r for r in self.replicas
+                              if not r.quarantined and r not in exclude]
+                if not candidates:
+                    quarantined = [r.name for r in self.replicas
+                                   if r.quarantined]
+                    raise RuntimeError(
+                        f"no healthy replica/mesh group to dispatch to "
+                        f"({len(self.replicas)} group(s), quarantined "
+                        f"{quarantined}"
+                        + (f", {len(exclude)} failed for this batch"
+                           if exclude else "")
+                        + "); regroup in progress — retry")
+                replica = min(candidates,
+                              key=lambda r: (r.pending, r.index))
+                replica.pending += 1
+                replica.dispatched += 1
+                injected = (
+                    self._injected_fault is not None
+                    and replica.generation == 0
+                    and replica.index == self._injected_fault[0]
+                    and replica.dispatched > self._injected_fault[1])
+            try:
+                if injected:
+                    raise RuntimeError(
+                        f"injected serve-group fault on {replica.name} "
+                        f"({SERVE_FAULT_ENV}) — this group's chips are "
+                        f"'dead' until the regroup rebuilds it")
+                inflight = replica.engine.dispatch_logits(images)
+            except BaseException as exc:  # noqa: BLE001 - attributed below
+                with self._lock:
+                    replica.pending -= 1
+                if _is_input_error(exc):
+                    raise  # the request's fault: no attribution, no failover
+                self._note_failure(replica, exc, "dispatch")
+                exclude.add(replica)
+                with self._lock:
+                    self._failovers += 1
+                continue
+            return _PoolHandle(replica, inflight, images)
 
     def complete(self, handle: _PoolHandle) \
             -> Tuple[np.ndarray, Optional[int]]:
         """Block on one dispatched batch's results; returns
         ``(logits (N, classes), epoch)`` with the epoch captured at that
-        batch's dispatch on its replica."""
-        try:
-            return handle.inflight.complete()
-        finally:
+        batch's dispatch on its replica. A completion failure (the
+        fetch surfacing a dead group) is attributed to the replica and
+        the batch FAILS OVER — re-dispatched whole on a healthy replica
+        — so an in-flight request on a dying group is answered, never
+        dropped; only with no healthy replica left does the error reach
+        the caller (a per-request error, by the batcher's contract)."""
+        current = handle
+        exclude: set = set()
+        while True:
+            try:
+                out = current.inflight.complete()
+            except BaseException as exc:  # noqa: BLE001 - attributed below
+                with self._lock:
+                    current.replica.pending -= 1
+                if _is_input_error(exc):
+                    raise
+                self._note_failure(current.replica, exc, "complete")
+                exclude.add(current.replica)
+                with self._lock:
+                    self._failovers += 1
+                # This re-dispatch runs on the COMPLETION thread and may
+                # race the batcher's dispatch worker on the same healthy
+                # engine. That is safe: an engine's per-batch dispatch
+                # state is function-local (chunks/buffers) or
+                # lock-protected (params capture, staging free-list) —
+                # the one-dispatch-thread convention is a contention
+                # guideline, not a correctness invariant (engine.py
+                # documents both).
+                current = self._dispatch_excluding(handle.images, exclude)
+                continue
             with self._lock:
-                handle.replica.pending -= 1
+                current.replica.pending -= 1
+                current.replica.completed += 1
+                current.replica.consecutive_failures = 0
+            return out
 
     def predict_complete(self, handle: _PoolHandle) \
             -> Tuple[np.ndarray, Optional[int]]:
@@ -263,25 +483,234 @@ class EnginePool:
         logits, epoch = self.complete(handle)
         return np.argmax(logits, axis=-1), epoch
 
+    # -- self-healing ------------------------------------------------------
+
+    def _note_failure(self, replica: EngineReplica, exc: BaseException,
+                      stage: str) -> None:
+        """Attribute one dispatch/completion error to its replica and
+        walk the quarantine threshold. Counter mutation under the pool
+        lock; logging, sink events, and the rebuild thread start all
+        outside it."""
+        with self._lock:
+            replica.failures += 1
+            replica.consecutive_failures += 1
+            quarantine = (not replica.quarantined
+                          and replica.consecutive_failures
+                          >= self.quarantine_after)
+            if quarantine:
+                replica.quarantined = True
+                self._topology_generation += 1
+        print(f"serve pool: {stage} failed on {replica.name} "
+              f"({replica.consecutive_failures} consecutive): {exc!r}",
+              flush=True)
+        if not quarantine:
+            return
+        print(f"serve pool: QUARANTINED {replica.name} after "
+              f"{self.quarantine_after} consecutive failures; "
+              f"dispatch skips it"
+              + ("; rebuilding it from its chips in the background"
+                 if self.auto_regroup else ""), flush=True)
+        if self.serve_log is not None:
+            self.serve_log.record_pool_event(
+                "serve_quarantine", group=replica.name,
+                consecutive_failures=replica.consecutive_failures,
+                error=repr(exc)[:300])
+        if self.auto_regroup:
+            threading.Thread(
+                target=self._regroup, args=(replica,), daemon=True,
+                name=f"pool-regroup-{replica.name}").start()
+
+    def _regroup(self, replica: EngineReplica) -> None:
+        """Background rebuild of one quarantined group from its own
+        chips: fresh engine (fresh mesh placement on the sharded
+        plane), AOT warm, atomic install under the pool lock — traffic
+        keeps flowing on the healthy groups throughout. Retries with
+        backoff; an unhealable group stays quarantined, loudly."""
+        for attempt in range(self.regroup_retries):
+            try:
+                with self._lock:
+                    params = self._params_host
+                    epoch = self._params_host_epoch
+                engine = self._build_group_engine(
+                    replica.devices, replica.name, params, epoch)
+                engine.warmup()
+            except BaseException as exc:  # noqa: BLE001 - retried, never fatal
+                print(f"serve pool: regroup of {replica.name} failed "
+                      f"(attempt {attempt + 1}/{self.regroup_retries}): "
+                      f"{exc!r}", flush=True)
+                time.sleep(0.2 * (attempt + 1))
+                continue
+            with self._lock:
+                replica.engine = engine
+                replica.quarantined = False
+                replica.consecutive_failures = 0
+                replica.generation += 1
+                self._regroups += 1
+                self._topology_generation += 1
+                generation = replica.generation
+                if (self._injected_fault is not None
+                        and replica.index == self._injected_fault[0]):
+                    # The injected 'group death' is spent the moment its
+                    # group is rebuilt: without this, a later resize's
+                    # fresh generation-0 replica at the same index would
+                    # 're-die' (the fault models ONE boot-engine death).
+                    self._injected_fault = None
+            # A hot reload may have landed during the build/warm: the
+            # stale-rejecting swap makes this catch-up idempotent.
+            with self._lock:
+                params = self._params_host
+                epoch = self._params_host_epoch
+            engine.swap_params(params, epoch=epoch)
+            print(f"serve pool: REGROUPED {replica.name} (generation "
+                  f"{generation}) from its {len(replica.devices)} "
+                  f"chip(s); back in dispatch", flush=True)
+            if self.serve_log is not None:
+                self.serve_log.record_pool_event(
+                    "serve_regroup", group=replica.name,
+                    generation=generation)
+            return
+        print(f"serve pool: giving up on {replica.name} after "
+              f"{self.regroup_retries} rebuild attempts; it stays "
+              f"quarantined (resize or restart to recover its chips)",
+              flush=True)
+
+    # -- resize ------------------------------------------------------------
+
+    def resize(self, n_devices: Optional[int] = None,
+               mesh_size: Optional[int] = None,
+               devices: Optional[Sequence] = None) -> dict:
+        """Re-shape the pool under live traffic: add/remove replicas
+        (``n_devices``; 0 = all local devices) and/or change the mesh
+        group size on the sharded plane (``mesh_size``). The new layout
+        is built and AOT-warmed in full while the OLD replicas keep
+        serving; the swap is one atomic replica-list install under the
+        pool lock. In-flight batches hold handles to their old replicas
+        and complete on them untouched — zero dropped requests by
+        construction. Returns ``{"old": topology, "new": topology}``.
+
+        One resize at a time (a concurrent call raises); the serve mode
+        itself is fixed at boot (a mode change means different param
+        shardings AND a different layout-gate contract — restart for
+        that, deliberately)."""
+        with self._lock:
+            if self._resizing:
+                raise RuntimeError("a resize is already in progress")
+            self._resizing = True
+            params = self._params_host
+            epoch = self._params_host_epoch
+            old = self._topology_locked()
+        try:
+            local = list(devices) if devices is not None \
+                else list(jax.local_devices())
+            n = self.n_devices if n_devices is None else int(n_devices)
+            if n == 0:
+                n = len(local)
+            if n < 1 or n > len(local):
+                raise ValueError(
+                    f"resize to {n} device(s): this host has "
+                    f"{len(local)} local device(s)")
+            sharded = self.serve_mode != "replicated"
+            mesh = self.mesh_size if mesh_size is None else int(mesh_size)
+            if sharded:
+                from pytorch_distributed_mnist_tpu.serve.programs import (
+                    validate_serve_mode,
+                )
+
+                if mesh == 0:
+                    mesh = n
+                if n % mesh:
+                    raise ValueError(
+                        f"serve_mesh {mesh} must divide serve_devices "
+                        f"{n} (the pool runs one spanning engine per "
+                        f"mesh group)")
+                validate_serve_mode(self.serve_mode, self.model_name,
+                                    mesh, params)
+            else:
+                if mesh not in (0, 1):
+                    raise ValueError(
+                        "replicated serving has no mesh to resize; "
+                        "serve_mesh must stay 1")
+                mesh = 1
+            new_replicas = self._make_replicas(local[:n], mesh, params,
+                                               epoch)
+            self._warm(new_replicas)
+            with self._lock:
+                self.replicas = new_replicas
+                self.n_devices = n
+                self.mesh_size = mesh
+                self._topology_generation += 1
+                # The injection hook targets the BOOT layout; a resized
+                # pool's fresh generation-0 replicas must not inherit it.
+                self._injected_fault = None
+                new = self._topology_locked()
+            # Latest-params catch-up, same as regroup: a reload may have
+            # raced the warm; the stale-rejecting swap is idempotent.
+            with self._lock:
+                params = self._params_host
+                epoch = self._params_host_epoch
+            for replica in new_replicas:
+                replica.engine.swap_params(params, epoch=epoch)
+            print(f"serve pool: RESIZED {old['groups']} group(s) x "
+                  f"{old['mesh_devices']} -> {new['groups']} group(s) x "
+                  f"{new['mesh_devices']} (topology generation "
+                  f"{new['topology_generation']}); in-flight batches "
+                  f"drain on the old engines", flush=True)
+            if self.serve_log is not None:
+                self.serve_log.record_pool_event(
+                    "serve_resize", old=old, new=new)
+            return {"old": old, "new": new}
+        finally:
+            with self._lock:
+                self._resizing = False
+
     # -- observability -----------------------------------------------------
+
+    def _topology_locked(self) -> dict:
+        quarantined = [r.name for r in self.replicas if r.quarantined]
+        return {
+            "topology_generation": self._topology_generation,
+            "serve_mode": self.serve_mode,
+            "serve_devices": self.n_devices,
+            "mesh_devices": self.mesh_size,
+            "groups": len(self.replicas),
+            "active_groups": len(self.replicas) - len(quarantined),
+            "quarantined_groups": quarantined,
+            "regroups": self._regroups,
+            "failovers": self._failovers,
+        }
+
+    def topology(self) -> dict:
+        """The pool's shape + self-healing counters — the ``/stats``
+        block ``loadgen --expect-groups`` asserts against."""
+        with self._lock:
+            return self._topology_locked()
 
     def snapshot(self) -> dict:
         """Per-replica rows for ``/stats`` and the JSONL sink: device,
         serving epoch, in-flight and lifetime dispatch counts. Sharded
         (mesh-group) rows additionally carry the group's full device
         span and the serve mode; replicated rows keep the exact pre-mesh
-        schema."""
+        schema, with health fields (``quarantined``, rebuild
+        ``generation``, ``failures``) appearing only once they are
+        true/nonzero."""
         sharded = self.serve_mode != "replicated"
         with self._lock:
             rows = {}
-            for r in self.replicas:
+            replicas = list(self.replicas)
+            for r in replicas:
                 row = {"device": str(r.device),
                        "pending": r.pending,
                        "dispatched": r.dispatched}
                 if sharded:
                     row["mode"] = self.serve_mode
                     row["devices"] = [str(d) for d in r.devices]
+                if r.quarantined:
+                    row["quarantined"] = True
+                if r.generation:
+                    row["generation"] = r.generation
+                if r.failures:
+                    row["failures"] = r.failures
                 rows[r.name] = row
-        for replica in self.replicas:
+        for replica in replicas:
             rows[replica.name]["params_epoch"] = replica.engine.params_epoch
         return rows

@@ -26,7 +26,13 @@ device submission):
 - ``GET /healthz`` — liveness + which checkpoint epoch is serving.
 - ``GET /stats`` — the ServeLog snapshot: p50/p95/p99 latency, queue
   depth/waits, batch-size histogram, reload + rejection counters, and
-  the serve programs' compile stats (the zero-recompile evidence).
+  the serve programs' compile stats (the zero-recompile evidence);
+  pooled servers add the topology block (``topology_generation``,
+  ``groups``/``active_groups``, ``quarantined_groups``, ``regroups``,
+  ``failovers``) the self-healing pool maintains.
+- ``POST /resize`` — the admin topology dial (pooled servers):
+  ``{"serve_devices": N?, "serve_mesh": M?}`` re-shapes the pool under
+  live traffic with zero dropped requests (``serve/pool.py::resize``).
 
 The deliberately boring transport (no asyncio, no framework dep) is the
 point: the serving smarts live in engine/batcher/reload, which are all
@@ -110,6 +116,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "divide --serve-devices; the pool then runs one "
                         "spanning engine per mesh group. Ignored (must be "
                         "left 0) in replicated mode")
+    p.add_argument("--quarantine-after", type=int, default=3,
+                   help="serve-pool self-healing threshold: this many "
+                        "CONSECUTIVE dispatch/completion failures on one "
+                        "replica/mesh group (any success resets the "
+                        "count) quarantine it — dispatch skips it, "
+                        "in-flight batches fail over to healthy groups, "
+                        "and a background regroup rebuilds it from its "
+                        "chips under live traffic. Pooled data plane "
+                        "only; input-shaped (4xx) errors never count")
     p.add_argument("--max-inflight", type=int, default=0,
                    help="pipelined dispatch window: batches dispatched "
                         "but not yet completed (0 = auto: replicas+1 on "
@@ -264,6 +279,17 @@ class _Handler(BaseHTTPRequestHandler):
             if ctx.pool is not None:
                 stats["serve_devices"] = ctx.pool.n_devices
                 stats["max_inflight"] = ctx.max_inflight
+                # The self-healing/resize topology block (read LIVE from
+                # the pool, so a /resize or regroup shows up on the next
+                # fetch): generation counter, group counts, quarantine
+                # state, failover/regroup totals. loadgen's
+                # --expect-groups smoke asserts active_groups; its report
+                # carries topology_generation.
+                topo = ctx.pool.topology()
+                for key in ("topology_generation", "groups",
+                            "active_groups", "quarantined_groups",
+                            "regroups", "failovers"):
+                    stats[key] = topo[key]
                 if ctx.serve_mode != "replicated":
                     # The mesh shape the sharded plane is running:
                     # loadgen's report and --expect-mode smoke read
@@ -275,6 +301,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"no route {self.path!r}"})
 
     def do_POST(self) -> None:  # noqa: N802 - stdlib name
+        if self.path == "/resize":
+            self._do_resize()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": f"no route {self.path!r}"})
             return
@@ -327,6 +356,68 @@ class _Handler(BaseHTTPRequestHandler):
             "predictions": [int(v) for v in out[:, 0]],
             "model_epoch": None if epoch < 0 else epoch,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+
+    def _do_resize(self) -> None:
+        """``POST /resize`` — the admin topology dial: body
+        ``{"serve_devices": N?, "serve_mesh": M?}`` re-shapes the pool
+        under live traffic (new layout built + AOT-warmed while the old
+        one keeps serving; atomic swap; in-flight batches drain on the
+        old engines — zero dropped requests). Replies with the old and
+        new topology. An operator's curl today, the autoscaler's
+        actuator tomorrow (ROADMAP item 1)."""
+        ctx = self.ctx
+        if ctx.pool is None:
+            self._reply(400, {
+                "error": "resize needs the pooled data plane; start "
+                         "with --serve-devices/--max-inflight/"
+                         "--serve-mode (the default single-engine "
+                         "server has no pool to re-shape)"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_BODY_BYTES:
+            self._reply(413, {"error": "oversized /resize body"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    "body must be a JSON object with serve_devices "
+                    "and/or serve_mesh")
+            n_devices = payload.get("serve_devices")
+            mesh_size = payload.get("serve_mesh")
+            if n_devices is None and mesh_size is None:
+                raise ValueError(
+                    "body must be JSON with serve_devices and/or "
+                    "serve_mesh")
+            if n_devices is not None:
+                n_devices = int(n_devices)
+            if mesh_size is not None:
+                mesh_size = int(mesh_size)
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        t0 = time.perf_counter()
+        try:
+            result = ctx.pool.resize(n_devices=n_devices,
+                                     mesh_size=mesh_size)
+        except ValueError as exc:
+            # An invalid target topology (device bounds, mesh
+            # divisibility, a replicated mesh) — flag-language message,
+            # nothing changed.
+            self._reply(400, {"error": str(exc)})
+            return
+        except RuntimeError as exc:
+            # One resize at a time: the concurrent caller backs off.
+            self._reply(409, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - an admin op never kills serving
+            self._reply(500, {"error": repr(exc)})
+            return
+        self._reply(200, {
+            "ok": True,
+            **result,
+            "warm_s": round(time.perf_counter() - t0, 3),
         })
 
 
@@ -525,6 +616,7 @@ def create_server(args) -> ThreadingHTTPServer:
             params_epoch=epoch, workers=getattr(args, "workers", 4),
             serve_mode=serve_mode, mesh_size=mesh_size,
             model_name=args.model,
+            quarantine_after=getattr(args, "quarantine_after", 3),
         )
         engine = pool
         pool.warmup()

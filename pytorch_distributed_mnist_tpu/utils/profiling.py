@@ -479,6 +479,24 @@ def record_world_shrunk(old_members, new_members, generation) -> Dict:
         generation=int(generation))
 
 
+def record_world_grown(old_members, new_members, generation) -> Dict:
+    """The grow mirror of :func:`record_world_shrunk`: this run is the
+    rebuilt world after a join rendezvous admitted a returned or
+    replacement host (``runtime/elastic.py`` grow path). Same shape,
+    distinct ``world_grown`` kind, so the metrics JSONL tells the two
+    topology directions apart at a glance."""
+    old_members, new_members = list(old_members), list(new_members)
+    return failure_events.record(
+        "world_grown",
+        f"world grew from {len(old_members)} to {len(new_members)} "
+        f"host(s) at generation {int(generation)}: members "
+        f"{old_members} -> {new_members}; resumed from the last "
+        f"published checkpoint (cross-world reshard onto the larger "
+        f"world)",
+        old_members=old_members, new_members=new_members,
+        generation=int(generation))
+
+
 def _percentile(sorted_vals: list, q: float) -> float:
     """Nearest-rank percentile over an already-sorted list (0 when empty).
     Nearest-rank (not interpolated) so p99 of a small sample is a latency
@@ -596,6 +614,19 @@ class ServeLog:
             sink.try_write({"t": round(time.time(), 3),
                             "kind": "serve_reload_failed", "path": path,
                             "detail": detail, "source": source})
+
+    def record_pool_event(self, kind: str, **fields) -> None:
+        """Sink-only pool lifecycle line (``serve_quarantine`` /
+        ``serve_regroup`` / ``serve_resize``): the counters live in the
+        pool's ``topology()`` block (surfaced via ``/stats`` only when
+        pooled), so the single-engine snapshot schema stays untouched —
+        this just lands the event in the shared ``--metrics-file``
+        stream next to the reloads it rides with."""
+        with self._lock:
+            sink, source = self._sink, self._source
+        if sink is not None:
+            sink.try_write({"t": round(time.time(), 3), "kind": kind,
+                            "source": source, **fields})
 
     # -- consumers --------------------------------------------------------
 

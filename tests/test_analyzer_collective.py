@@ -503,6 +503,46 @@ def unwind_with_vote(directory, error):
     assert _findings(src) == []
 
 
+def test_fires_on_joiner_conditioned_grow_rendezvous():
+    """The grow rendezvous gone wrong (ISSUE 11): running the agreement
+    collective only when rank 0 SEES pending joiners — every other rank
+    skips it (they can't see the joins), and the worlds' collective
+    counts diverge the moment a join record lands. The sanctioned shape
+    agrees rank 0's observation unconditionally and branches on the
+    agreed detail."""
+    src = """
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def grow_check(pending_joins):
+    if process_index() == 0 and pending_joins:
+        allgather_records("grow_check", True)
+        return True
+    return False
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "grow_check"
+
+
+def test_silent_on_rank0_listing_with_symmetric_rendezvous():
+    """The sanctioned grow rendezvous (runtime/elastic.py::
+    maybe_grow_rendezvous): only the host-local DIR LISTING is
+    rank-0-gated; the agreement collective runs unconditionally on
+    every rank, and every rank acts on the agreed detail — all yield
+    or none do."""
+    src = """
+import os
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def grow_check(directory):
+    joins = []
+    if process_index() == 0:
+        joins = sorted(os.listdir(directory))
+    records = allgather_records("grow_check", True, ",".join(joins))
+    return records[0].detail != ""
+"""
+    assert _findings(src) == []
+
+
 def test_silent_on_world_size_guarded_shrink_note():
     """The rebuilt-world bootstrap: process_count() guards are the
     sanctioned symmetric fast path, and the world_shrunk event record

@@ -612,12 +612,90 @@ def shrink(dead):
     assert _findings(src) == []
 
 
+# -- the self-healing/regroup shape (ISSUE 11, serve/pool.py grow) -----------
+
+
+def test_fires_on_regroup_warm_join_under_pool_lock():
+    """The regroup gone wrong: holding the pool lock across the rebuilt
+    engine's parallel warm (thread joins — the whole AOT compile wall)
+    wedges every dispatcher and /stats reader for the rebuild's
+    duration. The sanctioned shape builds + warms outside and installs
+    the reference under the lock."""
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def regroup(self, replica, build_engine):
+        with self._lock:
+            engine = build_engine(replica.devices)
+            threads = [threading.Thread(target=engine.warmup)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            replica.engine = engine
+"""
+    assert len(_findings(src)) >= 1
+
+
+def test_fires_on_join_record_io_under_supervisor_lock():
+    """Join-record IO under a lock: reading the rendezvous dir's
+    announcements (shared-filesystem file I/O) while holding a
+    membership lock blocks every reader for the listing's duration —
+    the same shape as the survivor-record firing twin, on the grow
+    path."""
+    src = """
+import json
+import threading
+
+_members_lock = threading.Lock()
+
+def admit_joiners(directory, members):
+    with _members_lock:
+        with open(f"{directory}/join_h00001.json") as f:
+            record = json.load(f)
+        members.append(record["host"])
+        return members
+"""
+    assert len(_findings(src)) >= 1
+
+
+def test_silent_on_regroup_warm_outside_install_under():
+    """The sanctioned regroup (serve/pool.py::_regroup): snapshot the
+    latest params under the lock, build + warm the replacement engine
+    with no lock held, install the reference (and clear quarantine)
+    under it."""
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def regroup(self, replica, build_engine):
+        with self._lock:
+            params = self._params_host
+        engine = build_engine(replica.devices, params)
+        engine.warmup()
+        with self._lock:
+            replica.engine = engine
+            replica.quarantined = False
+            replica.generation += 1
+"""
+    assert _findings(src) == []
+
+
 def test_elastic_module_clean_and_lock_free():
-    """ISSUE 10 acceptance pin: runtime/elastic.py stays clean under
+    """ISSUE 10/11 acceptance pin: runtime/elastic.py stays clean under
     the collective-symmetry, lock-discipline, and trace-purity
     checkers — the worker-side unwind path runs NO collectives (votes
-    are files), the supervisor holds no locks (one thread, poll loop),
-    and nothing traces."""
+    are files), the grow rendezvous runs its ONE agreement collective
+    unconditionally on every rank (only the dir listing is
+    rank-0-gated), the supervisor holds no locks (one thread, poll
+    loop), and nothing traces."""
     result = run_analysis(
         [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "runtime",
                       "elastic.py")],

@@ -1,6 +1,6 @@
 """Elastic-world chaos twins: real multi-process worlds losing real
 hosts to SIGKILL, supervised by ``runtime/elastic.py`` — proving the
-shrink-don't-exit contract end to end:
+shrink-don't-exit contract (and its GROW mirror) end to end:
 
 - THE acceptance twin (tier-1): a 2-process world loses host 1 to
   SIGKILL *mid-epoch* (between per-batch step programs); the survivor
@@ -16,7 +16,13 @@ shrink-don't-exit contract end to end:
   never a hang (the supervisor's settle deadline bounds every rebuild);
 - the ``--min-world`` floor: shrinking below it exits with the
   distinct floor code instead of training on a world the operator
-  ruled out.
+  ruled out;
+- the GROW acceptance twin (tier-1): the 2 -> 1 -> 2 round trip — host
+  1 SIGKILLed mid-epoch, the world shrinks to 1, host 1's join record
+  lands (the ``rejoin`` hook), the next epoch-boundary grow rendezvous
+  admits it, and the job finishes back at world size 2 with post-grow
+  epoch metrics BYTE-EQUAL to a direct 2-host run resumed from the
+  same published checkpoint.
 
 All twins drive ``elastic.supervise`` in-process (the supervisor makes
 no jax calls; the workers are real subprocesses).
@@ -31,7 +37,10 @@ import time
 
 import pytest
 
-from pytorch_distributed_mnist_tpu.parallel.launcher import _child_env
+from pytorch_distributed_mnist_tpu.parallel.launcher import (
+    _child_env,
+    spawn_local,
+)
 from pytorch_distributed_mnist_tpu.runtime.elastic import (
     EXIT_FLOOR,
     supervise,
@@ -67,6 +76,14 @@ def _epoch_rows_after_shrink(rows):
     world_shrunk event line in the shared JSONL)."""
     idx = next(i for i, r in enumerate(rows)
                if r.get("kind") == "world_shrunk")
+    return [r for r in rows[idx + 1:] if "train_loss" in r]
+
+
+def _epoch_rows_after_grow(rows):
+    """Epoch metric rows written by the GROWN world (after the
+    world_grown event line in the shared JSONL)."""
+    idx = next(i for i, r in enumerate(rows)
+               if r.get("kind") == "world_grown")
     return [r for r in rows[idx + 1:] if "train_loss" in r]
 
 
@@ -138,6 +155,74 @@ def test_elastic_survives_midepoch_kill_and_matches_direct_small_world(
         assert _strip_timing(elastic_row) == _strip_timing(direct_row)
 
 
+def test_shrink_then_grow_matches_direct_large_world(
+        tmp_path, monkeypatch):
+    """THE grow acceptance twin (tier-1): the 2 -> 1 -> 2 round trip.
+
+    Host 1 is SIGKILLed inside epoch 1's step loop; the world shrinks
+    to host 0 alone (generation 1), which trains epoch 1 and publishes
+    its checkpoint. Meanwhile host 1 'returns': its join record lands
+    while generation 1 runs (the supervise ``rejoin`` hook — exactly
+    ``announce_join``). Generation 1's next epoch-boundary grow
+    rendezvous admits it: every rank yields EXIT_GROW, and generation 2
+    re-execs as a REAL 2-host world resumed from the 1-host world's
+    checkpoint — a genuine W' > W cross-world reshard. The run
+    completes rc 0 with both directions recorded and labeled.
+
+    Then the proof of equivalence the ISSUE names: a fresh run started
+    DIRECTLY at world size 2 from a copy of the same published
+    checkpoint produces byte-equal post-grow epoch metrics."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    # Skip 5 hits: epoch 0's four steps run whole (its checkpoint
+    # publishes), the kill lands inside epoch 1's step loop.
+    monkeypatch.setenv("TPUMNIST_FAULT", "train_step:1:kill:5")
+    rc = supervise(2, _flags(ckpt, metrics,
+                             extra=["--optimizer-sharding", "zero1"]),
+                   grow=True, rejoin=[(1, 1)],
+                   settle_timeout=60, generation_timeout=240)
+    assert rc == 0, f"elastic grow run failed (rc={rc})"
+
+    rows = _rows(metrics)
+    shrunk = _events(rows, "world_shrunk")
+    assert len(shrunk) == 1
+    assert shrunk[0]["old_members"] == [0, 1]
+    assert shrunk[0]["new_members"] == [0]
+    grown = _events(rows, "world_grown")
+    assert len(grown) == 1
+    assert grown[0]["old_members"] == [0]
+    assert grown[0]["new_members"] == [0, 1]
+    # Both reshard events carry their direction label (the satellite):
+    # the shrink resumed a 2-process save on 1 process, the grow a
+    # 1-process save on 2.
+    reshards = _events(rows, "checkpoint_reshard")
+    assert [r["direction"] for r in reshards] == ["shrink", "grow"]
+    assert reshards[1]["saved"]["processes"] == 1
+    assert reshards[1]["current"]["processes"] == 2
+    # The shrunk world trained epoch 1; the grown world epoch 2.
+    assert [r["epoch"] for r in _epoch_rows_after_shrink(rows)] == [1, 2]
+    resumed = _epoch_rows_after_grow(rows)
+    assert [r["epoch"] for r in resumed] == [2]
+
+    # Equivalence: a 2-host world started directly from the checkpoint
+    # the grow resumed from (epoch 1's — published by the 1-HOST world,
+    # so the direct twin reshards 1 -> 2 exactly as generation 2 did).
+    direct_ckpt = tmp_path / "direct_ckpts"
+    direct_ckpt.mkdir()
+    shutil.copy(ckpt / "checkpoint_1.npz",
+                direct_ckpt / "checkpoint_1.npz")
+    direct_metrics = tmp_path / "direct_metrics.jsonl"
+    monkeypatch.delenv("TPUMNIST_FAULT", raising=False)
+    rc = spawn_local(2, _flags(direct_ckpt, direct_metrics,
+                               extra=["--optimizer-sharding", "zero1"]),
+                     timeout=240)
+    assert rc == 0
+    direct = [r for r in _rows(direct_metrics) if "train_loss" in r]
+    assert [r["epoch"] for r in direct] == [2]
+    for grown_row, direct_row in zip(resumed, direct):
+        assert _strip_timing(grown_row) == _strip_timing(direct_row)
+
+
 @pytest.mark.slow
 def test_three_host_world_shrinks_to_two(tmp_path, monkeypatch):
     """Multi-survivor membership: a 3-host world loses host 2 at a
@@ -205,6 +290,40 @@ def test_stall_during_rebuild_killed_at_settle_deadline(
     shrunk = _events(_rows(metrics), "world_shrunk")
     assert len(shrunk) == 1
     assert shrunk[0]["new_members"] == [0]
+
+
+@pytest.mark.slow
+def test_replacement_join_keeps_world_at_min_world_floor(
+        tmp_path, monkeypatch):
+    """The --min-world x join interaction: a 2-host world with
+    --min-world 2 loses host 1 — alone that is a floor exit (the twin
+    below) — but host 7's join record is already pending when the
+    rebuild plans, and admission runs BEFORE the floor check, so the
+    supervisor rebuilds at [0, 7]: same size, different members, a
+    world_grown event with the loss visible in the member lists.
+
+    The kill targets rank 1 with skip 9, landing it in epoch 2's step
+    loop (epochs 0-1 published): fault specs target RANKS, and the
+    rebuilt same-size world HAS a rank 1 (host 7) — a smaller skip
+    would re-kill the replacement when its own hit count caught up
+    (the rank-renumbering caveat in the chaos docs). With skip 9 the
+    rebuilt generation runs only epoch 2's four steps and the fault
+    can never re-fire."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    monkeypatch.setenv("TPUMNIST_FAULT", "train_step:1:kill:9")
+    rc = supervise(2, _flags(ckpt, metrics,
+                             extra=["--optimizer-sharding", "zero1"]),
+                   min_world=2, rejoin=[(7, 0)],
+                   settle_timeout=60, generation_timeout=240)
+    assert rc == 0
+    rows = _rows(metrics)
+    grown = _events(rows, "world_grown")
+    assert len(grown) == 1
+    assert grown[0]["old_members"] == [0, 1]
+    assert grown[0]["new_members"] == [0, 7]
+    assert _events(rows, "world_shrunk") == []
+    assert [r["epoch"] for r in _epoch_rows_after_grow(rows)] == [2]
 
 
 @pytest.mark.slow

@@ -1,0 +1,300 @@
+"""Elastic GROW unit tests (fast, tier-1): join-record IO, the pure
+grow planner, the worker-side epoch-boundary grow rendezvous (yield
+records + the agreed EXIT_GROW exit), the world_grown observability
+event, and the reshard event's direction label. The real 2->1->2
+shrink-then-grow twin lives in tests/test_elastic_chaos.py."""
+
+import json
+import os
+
+import pytest
+
+from pytorch_distributed_mnist_tpu.runtime import elastic, supervision
+from pytorch_distributed_mnist_tpu.runtime.elastic import (
+    DIR_ENV,
+    EXIT_GROW,
+    GEN_ENV,
+    GROW_ENV,
+    MAX_WORLD_ENV,
+    MEMBERS_ENV,
+    PREV_ENV,
+    announce_join,
+    join_path,
+    maybe_grow_rendezvous,
+    pending_joins,
+    plan_grow,
+    strip_elastic_flags,
+    write_yield_record,
+)
+from pytorch_distributed_mnist_tpu.utils.profiling import failure_events
+
+pytestmark = pytest.mark.elastic
+
+
+def _elastic_env(monkeypatch, tmp_path, gen=0, members="0,1", grow=True):
+    monkeypatch.setenv(DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(GEN_ENV, str(gen))
+    monkeypatch.setenv(MEMBERS_ENV, members)
+    monkeypatch.delenv(PREV_ENV, raising=False)
+    if grow:
+        monkeypatch.setenv(GROW_ENV, "1")
+    else:
+        monkeypatch.delenv(GROW_ENV, raising=False)
+
+
+# -- join-record IO ----------------------------------------------------------
+
+
+def test_announce_and_list_joins(tmp_path):
+    path = announce_join(str(tmp_path), 7)
+    assert path == join_path(str(tmp_path), 7)
+    announce_join(str(tmp_path), 2)
+    assert pending_joins(str(tmp_path)) == [(2, join_path(str(tmp_path), 2)),
+                                            (7, path)]
+    # No torn reads: the write is tmp+replace, nothing else in the dir.
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_pending_joins_skips_malformed_records(tmp_path, capsys):
+    announce_join(str(tmp_path), 3)
+    (tmp_path / "join_h00009.json").write_text("{not json")
+    (tmp_path / "join_h00011.json").write_text('{"wrong": "shape"}')
+    assert [h for h, _ in pending_joins(str(tmp_path))] == [3]
+    err = capsys.readouterr().err
+    assert "malformed join record" in err
+
+
+def test_pending_joins_missing_dir_is_empty(tmp_path):
+    assert pending_joins(str(tmp_path / "nope")) == []
+
+
+# -- the pure grow planner ---------------------------------------------------
+
+
+def test_plan_grow_appends_joiners_after_survivors():
+    new, admitted, stale = plan_grow([0, 2], [5, 1])
+    # Survivor ranks stay a prefix (rank 0 keeps streaming logs);
+    # joiners append in host-id order.
+    assert new == [0, 2, 1, 5]
+    assert admitted == [1, 5] and stale == []
+
+
+def test_plan_grow_ignores_stale_member_records():
+    new, admitted, stale = plan_grow([0, 1], [1, 3])
+    assert new == [0, 1, 3]
+    assert admitted == [3] and stale == [1]
+
+
+def test_plan_grow_caps_at_max_world_and_defers_the_rest():
+    new, admitted, stale = plan_grow([0], [1, 2, 3], max_world=2)
+    assert new == [0, 1]
+    assert admitted == [1]
+    # 2 and 3 are neither admitted nor stale: they stay pending.
+    assert stale == []
+
+
+def test_plan_grow_unbounded_by_default_and_dedups():
+    new, admitted, _ = plan_grow([0], [4, 4, 3])
+    assert new == [0, 3, 4] and admitted == [3, 4]
+
+
+# -- worker side: the grow rendezvous ----------------------------------------
+
+
+def test_grow_rendezvous_noop_outside_elastic_grow(monkeypatch, tmp_path):
+    # Not an elastic worker at all.
+    for env in (DIR_ENV, GEN_ENV, MEMBERS_ENV, GROW_ENV):
+        monkeypatch.delenv(env, raising=False)
+    assert maybe_grow_rendezvous() is None
+    # Elastic worker but no --elastic-grow: the epoch boundary is not a
+    # rendezvous point (joiners still ride failure rebuilds).
+    _elastic_env(monkeypatch, tmp_path, grow=False)
+    announce_join(str(tmp_path), 5)
+    assert maybe_grow_rendezvous() is None
+    # The join record is untouched for the supervisor to admit later.
+    assert [h for h, _ in pending_joins(str(tmp_path))] == [5]
+
+
+def test_grow_rendezvous_noop_without_pending_joiners(
+        monkeypatch, tmp_path):
+    _elastic_env(monkeypatch, tmp_path)
+    assert maybe_grow_rendezvous() is None  # no records: nothing to do
+    assert os.listdir(tmp_path) == []
+
+
+def test_grow_rendezvous_then_yield_writes_record_and_exit_code(
+        monkeypatch, tmp_path):
+    """The worker half of the grow protocol, in its two halves: the
+    rendezvous AGREES the pending joiner list (returned, not raised —
+    the cli epoch loop must first exit its saver scope cleanly so an
+    async saver's deferred publish lands), then yield_for_grow writes
+    the YIELD record (a survivor vote with yield: true) and raises the
+    agreed EXIT_GROW SystemExit."""
+    _elastic_env(monkeypatch, tmp_path, gen=1, members="0")
+    announce_join(str(tmp_path), 1)
+    joiners = maybe_grow_rendezvous()
+    assert joiners == [1]
+    assert not os.path.exists(elastic.record_path(str(tmp_path), 1, 0))
+    with pytest.raises(SystemExit) as exc_info:
+        elastic.yield_for_grow(joiners)
+    assert exc_info.value.code == EXIT_GROW
+    # Agreed symmetric exit: marked so the unwind never poisons peers.
+    assert getattr(exc_info.value, "_poison_delivered", False)
+    with open(elastic.record_path(str(tmp_path), 1, 0)) as f:
+        rec = json.load(f)
+    assert rec["yield"] is True
+    assert rec["join_hosts"] == [1]
+    assert rec["dead_ranks"] == [] and rec["dead_hosts"] == []
+    assert rec["phase"] == "grow_check"
+    # The join record itself is NOT consumed by the worker — admission
+    # (and stale filtering) is the supervisor's job.
+    assert [h for h, _ in pending_joins(str(tmp_path))] == [1]
+
+
+def test_grow_rendezvous_ignores_stale_member_records(
+        monkeypatch, tmp_path):
+    """A join record for a host that is already a member must not make
+    the world yield (nothing to admit)."""
+    _elastic_env(monkeypatch, tmp_path, members="0,1")
+    announce_join(str(tmp_path), 1)
+    assert maybe_grow_rendezvous() is None  # host 1 is already a member
+
+
+def test_grow_rendezvous_skipped_at_max_world_cap(monkeypatch, tmp_path):
+    """A world already AT --max-world must not yield for a joiner the
+    supervisor could only defer: the still-pending record would
+    otherwise re-trigger a full teardown/re-exec at EVERY epoch
+    boundary. The cap is mirrored to workers and the rendezvous is
+    skipped outright; below the cap it runs (and a yield then always
+    admits at least one joiner)."""
+    _elastic_env(monkeypatch, tmp_path, members="0,1")
+    announce_join(str(tmp_path), 5)
+    monkeypatch.setenv(MAX_WORLD_ENV, "2")
+    assert maybe_grow_rendezvous() is None  # at cap: nothing admissible
+    # The record stays pending (a later failure rebuild may use it as a
+    # replacement).
+    assert [h for h, _ in pending_joins(str(tmp_path))] == [5]
+    # One slot below the cap: the rendezvous agrees the joiner.
+    monkeypatch.setenv(MAX_WORLD_ENV, "3")
+    assert maybe_grow_rendezvous() == [5]
+
+
+def test_yield_record_write_failure_is_swallowed(monkeypatch, tmp_path,
+                                                 capsys):
+    target = tmp_path / "not_a_dir"
+    target.write_text("a file where the rendezvous dir should be")
+    monkeypatch.setenv(DIR_ENV, str(target))
+    monkeypatch.setenv(GEN_ENV, "0")
+    monkeypatch.setenv(MEMBERS_ENV, "0")
+    assert write_yield_record([3]) is None
+    assert "could not be written" in capsys.readouterr().err
+
+
+# -- the world_grown event ---------------------------------------------------
+
+
+def test_note_rebuilt_world_records_grow_direction(monkeypatch, tmp_path):
+    _elastic_env(monkeypatch, tmp_path, gen=2, members="0,1,3")
+    monkeypatch.setenv(PREV_ENV, "0,1")
+    failure_events.reset()
+    elastic.note_rebuilt_world()
+    events = failure_events.snapshot()
+    grown = [e for e in events if e["kind"] == "world_grown"]
+    assert len(grown) == 1
+    assert grown[0]["old_members"] == [0, 1]
+    assert grown[0]["new_members"] == [0, 1, 3]
+    assert grown[0]["generation"] == 2
+    assert [e for e in events if e["kind"] == "world_shrunk"] == []
+
+
+def test_note_rebuilt_world_same_size_replacement_is_grown(
+        monkeypatch, tmp_path):
+    """A loss whose replacement rode the same rebuild: same world size,
+    different members — a new host joined, recorded as world_grown
+    (the member lists carry the loss)."""
+    _elastic_env(monkeypatch, tmp_path, gen=1, members="0,7")
+    monkeypatch.setenv(PREV_ENV, "0,1")
+    failure_events.reset()
+    elastic.note_rebuilt_world()
+    grown = [e for e in failure_events.snapshot()
+             if e["kind"] == "world_grown"]
+    assert len(grown) == 1 and grown[0]["new_members"] == [0, 7]
+
+
+def test_note_rebuilt_world_unchanged_membership_records_nothing(
+        monkeypatch, tmp_path):
+    """A same-membership relaunch (a spurious yield) is not a topology
+    change; the metrics stream stays quiet."""
+    _elastic_env(monkeypatch, tmp_path, gen=1, members="0,1")
+    monkeypatch.setenv(PREV_ENV, "0,1")
+    failure_events.reset()
+    elastic.note_rebuilt_world()
+    assert [e for e in failure_events.snapshot()
+            if e["kind"] in ("world_grown", "world_shrunk")] == []
+
+
+# -- the reshard event's direction label -------------------------------------
+
+
+def test_cross_world_resume_labels_direction(monkeypatch):
+    from pytorch_distributed_mnist_tpu import cli
+    from pytorch_distributed_mnist_tpu.train import checkpoint
+
+    for saved_procs, direction in ((1, "grow"), (4, "shrink")):
+        failure_events.reset()
+        monkeypatch.setattr(
+            checkpoint, "checkpoint_world",
+            lambda path, _n=saved_procs: {"processes": _n, "devices": _n})
+        cli._note_cross_world_resume("ckpt_x.npz")
+        (event,) = [e for e in failure_events.snapshot()
+                    if e["kind"] == "checkpoint_reshard"]
+        assert event["direction"] == direction, direction
+        assert direction in event["detail"]
+
+
+def test_cross_world_resume_same_world_records_nothing(monkeypatch):
+    import jax
+
+    from pytorch_distributed_mnist_tpu import cli
+    from pytorch_distributed_mnist_tpu.train import checkpoint
+
+    failure_events.reset()
+    monkeypatch.setattr(
+        checkpoint, "checkpoint_world",
+        lambda path: {"processes": 1, "devices": jax.device_count()})
+    cli._note_cross_world_resume("ckpt_x.npz")
+    assert [e for e in failure_events.snapshot()
+            if e["kind"] == "checkpoint_reshard"] == []
+
+
+# -- supervisor-side flag plumbing and validation ----------------------------
+
+
+def test_strip_elastic_flags_covers_grow_flags():
+    argv = ["--spawn", "3", "--elastic", "--elastic-grow",
+            "--max-world", "4", "--model", "linear", "--max-world=2"]
+    assert strip_elastic_flags(argv) == ["--spawn", "3", "--model",
+                                         "linear"]
+
+
+def test_supervise_validates_max_world():
+    with pytest.raises(ValueError, match="max-world"):
+        elastic.supervise(3, [], max_world=2)
+    with pytest.raises(ValueError, match="max-world"):
+        elastic.supervise(2, [], max_world=-1)
+
+
+def test_cli_rejects_grow_flags_without_elastic():
+    from pytorch_distributed_mnist_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="require --elastic"):
+        main(["--elastic-grow", "--spawn", "2", "--dataset", "synthetic"])
+    with pytest.raises(SystemExit, match="require --elastic"):
+        main(["--max-world", "4", "--dataset", "synthetic"])
+
+
+def test_cli_rejects_max_world_below_spawn():
+    from pytorch_distributed_mnist_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="below the initial world"):
+        main(["--elastic", "--spawn", "3", "--max-world", "2"])

@@ -1,0 +1,366 @@
+"""Serve-pool self-healing unit tests: per-group failure attribution,
+the quarantine threshold, failover-never-drops (dispatch AND completion
+failures answered by healthy replicas), background regroup, the resize
+state machine, and the topology observability block. Stub engines
+injected per replica drive the failure paths deterministically; the
+regroup path rebuilds REAL engines from the pool's stored config."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import (
+    normalize_images,
+    synthetic_dataset,
+)
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.pool import (
+    SERVE_FAULT_ENV,
+    EnginePool,
+    _parse_serve_fault,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    images, labels = synthetic_dataset(64, seed=3)
+    return model, state, images, labels
+
+
+def _direct_labels(model, state, raw_images):
+    logits = model.apply(state.params, jnp.asarray(
+        normalize_images(raw_images)), train=False)
+    return np.argmax(np.asarray(logits), axis=-1)
+
+
+def _pool(model, state, n=3, **kwargs):
+    pool = EnginePool(model.apply, state.params,
+                      devices=jax.local_devices()[:n], buckets=(8,),
+                      params_epoch=1, **kwargs)
+    pool.warmup()
+    return pool
+
+
+class _DeadInflight:
+    def __init__(self, inner):
+        self.inner = inner
+
+    def complete(self):
+        self.inner.complete()  # release the real staging buffers first
+        raise RuntimeError("group died between dispatch and fetch")
+
+
+class _SabotagedEngine:
+    """Wraps a real engine; fails at the chosen stage like a group whose
+    chips died (RuntimeError — never the input-shaped errors that must
+    not count)."""
+
+    def __init__(self, inner, fail_dispatch=False, fail_complete=False):
+        self._inner = inner
+        self.fail_dispatch = fail_dispatch
+        self.fail_complete = fail_complete
+
+    def dispatch_logits(self, images):
+        if self.fail_dispatch:
+            raise RuntimeError("chips gone (sabotaged)")
+        inflight = self._inner.dispatch_logits(images)
+        if self.fail_complete:
+            return _DeadInflight(inflight)
+        return inflight
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _serve_ok(pool, model, state, images):
+    labels, _ = pool.predict_complete(pool.dispatch(
+        pool.preprocess(images[:8])))
+    np.testing.assert_array_equal(
+        labels, _direct_labels(model, state, images[:8]))
+
+
+# -- attribution + failover ---------------------------------------------------
+
+
+def test_dispatch_failure_fails_over_and_quarantines(linear_setup):
+    """Replica 0's dispatch dies persistently: every request still
+    answers correctly (failover to healthy replicas — never a drop),
+    and after quarantine_after consecutive failures r0 is quarantined
+    and skipped entirely."""
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, quarantine_after=3, auto_regroup=False)
+    r0 = pool.replicas[0]
+    r0.engine = _SabotagedEngine(r0.engine, fail_dispatch=True)
+    for _ in range(5):
+        _serve_ok(pool, model, state, images)
+    topo = pool.topology()
+    assert topo["quarantined_groups"] == ["r0"]
+    assert topo["active_groups"] == 2
+    assert topo["failovers"] >= 3
+    assert r0.failures == 3  # quarantined after exactly the threshold
+    snap = pool.snapshot()
+    assert snap["r0"]["quarantined"] is True
+    assert "quarantined" not in snap["r1"]  # healthy rows keep their schema
+    # Once quarantined, r0 is never dispatched to again.
+    dispatched_before = r0.dispatched
+    _serve_ok(pool, model, state, images)
+    assert r0.dispatched == dispatched_before
+
+
+def test_completion_failure_fails_over_in_flight_batch(linear_setup):
+    """The in-flight case the ISSUE names: the batch is already
+    dispatched when the group dies — the fetch fails, and the SAME rows
+    re-dispatch on a healthy replica. The caller sees the right answer,
+    not an error."""
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, quarantine_after=2, auto_regroup=False)
+    r0 = pool.replicas[0]
+    r0.engine = _SabotagedEngine(r0.engine, fail_complete=True)
+    for _ in range(3):
+        _serve_ok(pool, model, state, images)
+    topo = pool.topology()
+    assert topo["quarantined_groups"] == ["r0"]
+    assert topo["failovers"] >= 2
+
+
+def test_input_errors_never_count_toward_quarantine(linear_setup):
+    """Malformed requests (ValueError out of preprocess/shape checks)
+    are the REQUEST's fault: no attribution, no failover, no quarantine
+    — three bad payloads must not condemn a healthy group."""
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, quarantine_after=2, auto_regroup=False)
+    for _ in range(4):
+        with pytest.raises(ValueError):
+            pool.dispatch(np.zeros((3, 5, 5, 1), np.float32))
+    topo = pool.topology()
+    assert topo["quarantined_groups"] == []
+    assert topo["failovers"] == 0
+    assert all(r.failures == 0 for r in pool.replicas)
+
+
+def test_success_resets_the_consecutive_counter(linear_setup):
+    """quarantine_after counts CONSECUTIVE failures: a success between
+    them resets the clock, so a flaky-but-recovering group is not
+    condemned."""
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, n=2, quarantine_after=3,
+                 auto_regroup=False)
+    r0 = pool.replicas[0]
+    real = r0.engine
+    for _ in range(3):
+        r0.engine = _SabotagedEngine(real, fail_dispatch=True)
+        _serve_ok(pool, model, state, images)  # one failure + failover
+        r0.engine = real
+        _serve_ok(pool, model, state, images)  # success on r0: reset
+    assert pool.topology()["quarantined_groups"] == []
+    assert r0.failures == 3 and r0.consecutive_failures == 0
+
+
+def test_no_healthy_replica_raises_never_hangs(linear_setup):
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, n=2, quarantine_after=1,
+                 auto_regroup=False)
+    for r in pool.replicas:
+        r.engine = _SabotagedEngine(r.engine, fail_dispatch=True)
+    with pytest.raises(RuntimeError, match="no healthy replica"):
+        pool.dispatch(pool.preprocess(images[:8]))
+    assert pool.topology()["quarantined_groups"] == ["r0", "r1"]
+
+
+# -- regroup ------------------------------------------------------------------
+
+
+def _wait_healed(pool, deadline_s=30.0, regroups=1):
+    """Block until the full quarantine->regroup cycle ran: at least
+    ``regroups`` rebuilds AND no group left quarantined (polling for an
+    empty quarantine list alone would return before the failure
+    accumulates)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        topo = pool.topology()
+        if (topo["regroups"] >= regroups
+                and not topo["quarantined_groups"]):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"pool never healed: {pool.topology()}")
+
+
+def test_regroup_rebuilds_quarantined_group_under_traffic(linear_setup):
+    """The self-healing acceptance at pool level: a sabotaged group is
+    quarantined, the background regroup rebuilds a REAL engine from its
+    chips, and the group returns to dispatch serving the same answers —
+    with traffic flowing on the healthy replicas throughout."""
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, quarantine_after=2)  # auto_regroup on
+    r0 = pool.replicas[0]
+    r0.engine = _SabotagedEngine(r0.engine, fail_dispatch=True)
+    stop = threading.Event()
+    failures = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                _serve_ok(pool, model, state, images)
+            except Exception as exc:  # noqa: BLE001
+                failures.append(repr(exc))
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        _wait_healed(pool)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+    assert not failures, failures[:3]
+    topo = pool.topology()
+    assert topo["regroups"] == 1 and topo["active_groups"] == 3
+    assert r0.generation == 1
+    assert not isinstance(r0.engine, _SabotagedEngine)  # a real rebuild
+    assert pool.snapshot()["r0"]["generation"] == 1
+    # The rebuilt group serves, correctly, on its own chips.
+    dispatched_before = r0.dispatched
+    for _ in range(4):
+        _serve_ok(pool, model, state, images)
+    assert r0.dispatched > dispatched_before
+
+
+def test_regroup_catches_up_to_params_swapped_during_rebuild(
+        linear_setup):
+    """A hot reload landing while a group rebuilds: the rebuilt engine
+    must serve the LATEST params (the pool tracks the newest host-side
+    fan-out and the regroup installs it), not the boot checkpoint."""
+    model, state, images, _ = linear_setup
+    newer = create_train_state(model, jax.random.key(42))
+    pool = _pool(model, state, quarantine_after=1)
+    r0 = pool.replicas[0]
+    r0.engine = _SabotagedEngine(r0.engine, fail_dispatch=True)
+    _serve_ok(pool, model, state, images)  # one failure -> quarantine
+    # The reload fans out while r0 is quarantined (and skipped).
+    assert pool.swap_params(newer.params, epoch=9) == 2
+    _wait_healed(pool)
+    assert r0.engine.params_epoch == 9
+    labels, epoch = pool.predict_complete(pool.dispatch(
+        pool.preprocess(images[:8])))
+    assert epoch == 9
+
+
+# -- resize -------------------------------------------------------------------
+
+
+def test_resize_up_and_down_serves_identically(linear_setup):
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, n=2)
+    assert pool.topology()["topology_generation"] == 0
+    result = pool.resize(n_devices=4)
+    assert result["old"]["groups"] == 2 and result["new"]["groups"] == 4
+    assert pool.n_replicas == 4 and pool.n_devices == 4
+    assert pool.topology()["topology_generation"] == 1
+    _serve_ok(pool, model, state, images)
+    pool.resize(n_devices=1)
+    assert pool.n_replicas == 1
+    assert pool.topology()["topology_generation"] == 2
+    _serve_ok(pool, model, state, images)
+
+
+def test_resize_swap_is_atomic_for_in_flight_batches(linear_setup):
+    """A batch dispatched on the OLD layout completes on the old engine
+    its handle holds — a resize mid-flight loses nothing."""
+    model, state, images, _ = linear_setup
+    pool = _pool(model, state, n=2)
+    handle = pool.dispatch(pool.preprocess(images[:8]))
+    old_replica = handle.replica
+    pool.resize(n_devices=3)
+    assert handle.replica is old_replica
+    assert old_replica not in pool.replicas
+    labels, _ = pool.predict_complete(handle)
+    np.testing.assert_array_equal(
+        labels, _direct_labels(model, state, images[:8]))
+    assert old_replica.pending == 0  # accounting drained on the old object
+
+
+def test_resize_carries_latest_params(linear_setup):
+    model, state, images, _ = linear_setup
+    newer = create_train_state(model, jax.random.key(7))
+    pool = _pool(model, state, n=2)
+    pool.swap_params(newer.params, epoch=5)
+    pool.resize(n_devices=3)
+    assert [r.engine.params_epoch for r in pool.replicas] == [5, 5, 5]
+    labels, _ = pool.predict_complete(pool.dispatch(
+        pool.preprocess(images[:8])))
+    np.testing.assert_array_equal(
+        labels, _direct_labels(model, newer, images[:8]))
+
+
+def test_resize_validation_and_serialization(linear_setup):
+    model, state, _, _ = linear_setup
+    pool = _pool(model, state, n=2)
+    with pytest.raises(ValueError, match="local device"):
+        pool.resize(n_devices=99)
+    with pytest.raises(ValueError, match="no mesh to resize"):
+        pool.resize(mesh_size=2)
+    # One resize at a time: a concurrent call backs off loudly.
+    with pool._lock:
+        pool._resizing = True
+    try:
+        with pytest.raises(RuntimeError, match="already in progress"):
+            pool.resize(n_devices=1)
+    finally:
+        with pool._lock:
+            pool._resizing = False
+    assert pool.n_replicas == 2  # nothing changed
+
+
+def test_resize_zero_means_all_local_devices(linear_setup):
+    model, state, _, _ = linear_setup
+    pool = _pool(model, state, n=1)
+    pool.resize(n_devices=0)
+    assert pool.n_devices == len(jax.local_devices())
+
+
+# -- the injection hook -------------------------------------------------------
+
+
+def test_serve_fault_spec_parsing():
+    assert _parse_serve_fault("") is None
+    assert _parse_serve_fault("2") == (2, 0)
+    assert _parse_serve_fault("1:5") == (1, 5)
+    with pytest.raises(ValueError, match=SERVE_FAULT_ENV):
+        _parse_serve_fault("a:b")
+    with pytest.raises(ValueError, match=SERVE_FAULT_ENV):
+        _parse_serve_fault("1:2:3")
+
+
+def test_injected_fault_fires_quarantines_and_heals(
+        linear_setup, monkeypatch):
+    """The chaos-harness injection end to end at pool level: group 0
+    'dies' after 2 batches, requests fail over (all answered), the
+    group quarantines, and the regroup brings it back (generation 1
+    clears the injection: the rebuilt group's chips are healthy)."""
+    model, state, images, _ = linear_setup
+    monkeypatch.setenv(SERVE_FAULT_ENV, "0:2")
+    pool = _pool(model, state, n=2, quarantine_after=2)
+    for _ in range(8):
+        _serve_ok(pool, model, state, images)
+    _wait_healed(pool)
+    topo = pool.topology()
+    assert topo["regroups"] == 1 and topo["failovers"] >= 2
+    assert pool.replicas[0].generation == 1
+    # Post-regroup, group 0 serves cleanly (generation gates the fault).
+    dispatched = pool.replicas[0].dispatched
+    for _ in range(4):
+        _serve_ok(pool, model, state, images)
+    assert pool.replicas[0].dispatched > dispatched
+    assert pool.topology()["quarantined_groups"] == []

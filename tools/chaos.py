@@ -5,7 +5,7 @@ Drives the same local N-process world as ``tpu-mnist --spawn`` with ONE
 process sabotaged at a named fault point (``runtime/supervision.py``'s
 ``TPUMNIST_FAULT=point:host:kind[:arg]`` hook, comma-join for multiple
 faults), so the agreed-exit protocol, the collective watchdogs, and the
-elastic shrink-don't-exit runtime can be exercised against real process
+elastic shrink/grow runtime can be exercised against real process
 deaths instead of monkeypatches:
 
     # what can be injected, and where each point fires
@@ -38,6 +38,28 @@ deaths instead of monkeypatches:
         --dataset synthetic --model linear --epochs 3 --batch-size 48 \\
         --trainer-mode stepwise --resume auto
 
+    # GROW (2 -> 1 -> 2): host 1 dies mid-epoch, the world shrinks to
+    # host 0; --rejoin 1@1 then writes host 1's join record while
+    # generation 1 runs, the next epoch-boundary grow rendezvous admits
+    # it, and the job finishes back at world size 2
+    python tools/chaos.py --elastic --elastic-grow --rejoin 1@1 \\
+        --fault train_step:1:kill:5 --nprocs 2 -- \\
+        --dataset synthetic --model linear --epochs 3 \\
+        --optimizer-sharding zero1 --trainer-mode stepwise
+
+    # SERVE-POOL self-healing: boot a real 4-replica server, 'kill'
+    # group 1 after 5 batches (TPUMNIST_SERVE_FAULT injection), hammer
+    # it with loadgen — every request must answer 200 (failover, never
+    # a drop), the pool must quarantine + regroup, and the final smoke
+    # asserts all 4 groups active again
+    python tools/chaos.py --serve --serve-devices 4 --serve-fault 0:5 \\
+        --expect-groups 4 --requests 400 --cpu-devices 4
+
+    # rolling topology change: /resize 2 -> 4 -> 2 replicas under live
+    # traffic; zero dropped requests end to end
+    python tools/chaos.py --serve --serve-devices 2 --resize 4,2 \\
+        --expect-groups 2 --requests 400 --cpu-devices 4
+
 Fault host indices are process RANKS within the world that reads the
 plan — in an elastic run each rebuilt generation renumbers its ranks
 0..W'-1, so a spec aimed at rank 2 cannot re-fire once the world is
@@ -50,12 +72,13 @@ by the supervisor's settle deadline, but recordless ranks count dead
 faults at supervised phases (resume, ckpt_*) on worlds above 2.
 
 Exit code: 0 when every rank exited 0 (for elastic runs: the job
-trained to completion on whatever world remained); otherwise the first
-failing rank's code (killed ranks surface as 128+signal; an elastic
-shrink past --min-world exits the supervisor's floor code).
-tests/test_chaos.py and tests/test_elastic_chaos.py run these scenarios
-with assertions; this tool is the operator-facing way to reproduce one
-interactively.
+trained to completion on whatever world remained; for serve runs: zero
+dropped requests AND the expected post-heal topology); otherwise the
+first failing rank's code (killed ranks surface as 128+signal; an
+elastic shrink past --min-world exits the supervisor's floor code).
+tests/test_chaos.py, tests/test_elastic_chaos.py, and
+tests/test_serve_heal_server.py run these scenarios with assertions;
+this tool is the operator-facing way to reproduce one interactively.
 
 ``--list`` is the drift gate: tests/test_supervision.py pins that its
 output, the ``FAULT_POINTS`` registry, and the ``maybe_fault()`` call
@@ -66,8 +89,15 @@ vice versa) fails the suite.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import re
+import shutil
+import subprocess
 import sys
+import tempfile
+import time
+import urllib.request
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -86,11 +116,181 @@ from pytorch_distributed_mnist_tpu.runtime.supervision import (  # noqa: E402
     parse_fault_specs,
 )
 
+# serve/pool.py::SERVE_FAULT_ENV, spelled out so the chaos CLI stays
+# jax-import-free until a twin actually runs (pinned equal by
+# tests/test_serve_heal_server.py).
+SERVE_FAULT_ENV = "TPUMNIST_SERVE_FAULT"
+
 
 def list_fault_points(file=sys.stdout) -> None:
     """One line per injectable point: ``name<TAB>description``."""
     for name in sorted(FAULT_POINTS):
         print(f"{name}\t{FAULT_POINTS[name]}", file=file)
+
+
+def _parse_rejoin(spec: str):
+    """``HOST@GEN[,HOST@GEN...]`` -> [(host, generation), ...]."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            host_s, gen_s = part.split("@")
+            out.append((int(host_s), int(gen_s)))
+        except ValueError:
+            raise SystemExit(
+                f"bad --rejoin spec {part!r}: expected HOST@GENERATION "
+                f"(e.g. 1@1: host 1 announces a join while generation 1 "
+                f"runs)") from None
+    return out
+
+
+def _get_json(url: str, path: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post_json(url: str, path: str, payload: dict,
+               timeout: float = 120.0) -> dict:
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _say(msg: str) -> None:
+    print(f"chaos: {msg}", file=sys.stderr, flush=True)
+
+
+def run_serve_chaos(args) -> int:
+    """The serve-plane twins: boot a REAL serve subprocess, hammer it
+    with loadgen, and either sabotage a mesh group (``--serve-fault``:
+    the pool must quarantine, fail requests over, and regroup under the
+    live traffic) or roll the topology (``--resize``: each /resize must
+    complete with zero dropped requests). Success = every loadgen
+    request answered 200 AND the final /stats topology matches
+    ``--expect-groups``."""
+    env = dict(os.environ)
+    if args.serve_fault:
+        env[SERVE_FAULT_ENV] = args.serve_fault
+    else:
+        env.pop(SERVE_FAULT_ENV, None)
+    if args.cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            f"count={args.cpu_devices}").strip()
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    ckpt_dir = tempfile.mkdtemp(prefix="tpumnist-serve-chaos-")
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", delete=False)
+    cmd = [sys.executable, "-m", "pytorch_distributed_mnist_tpu", "serve",
+           "--checkpoint-dir", ckpt_dir, "--model", "linear",
+           "--host", "127.0.0.1", "--port", "0", "--buckets", "1,8,32",
+           "--serve-devices", str(args.serve_devices),
+           "--quarantine-after", str(args.quarantine_after),
+           "--max-wait-ms", "2", "--poll-interval", "1"]
+    _say(f"booting serve twin: {' '.join(cmd)}"
+         + (f" [{SERVE_FAULT_ENV}={args.serve_fault}]"
+            if args.serve_fault else ""))
+    server = subprocess.Popen(cmd, env=env, stdout=log,
+                              stderr=subprocess.STDOUT)
+    loadgen = None
+    url = None
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline and url is None:
+            if server.poll() is not None:
+                break
+            log.flush()
+            with open(log.name) as f:
+                m = re.search(r"serving on (http://\S+)", f.read())
+            if m:
+                url = m.group(1).rstrip("/")
+            else:
+                time.sleep(0.2)
+        if url is None:
+            with open(log.name) as f:
+                print(f.read()[-4000:], file=sys.stderr)
+            _say("server never came up")
+            return 1
+        _say(f"server up at {url}")
+
+        loadgen_cmd = [
+            sys.executable, os.path.join(_REPO, "tools", "loadgen.py"),
+            "--smoke", "--url", url, "--requests", str(args.requests),
+            "--concurrency", "8"]
+        loadgen = subprocess.Popen(loadgen_cmd, stdout=subprocess.PIPE,
+                                   stderr=subprocess.STDOUT, text=True)
+        # Roll the topology WHILE the load runs: each /resize must
+        # complete under traffic with zero dropped requests.
+        for target in args.resize_targets:
+            time.sleep(0.5)
+            reply = _post_json(url, "/resize", {"serve_devices": target})
+            _say(f"/resize -> {target} replicas: topology generation "
+                 f"{reply['new']['topology_generation']}")
+        out, _ = loadgen.communicate(timeout=args.timeout)
+        loadgen = None  # reaped; nothing left for the finally to kill
+        report_line = out.strip().splitlines()[-1] if out.strip() else "{}"
+        print(report_line)
+        report = json.loads(report_line)
+        if loadgen.returncode != 0 or report.get("ok") != args.requests:
+            _say(f"loadgen dropped/failed requests (rc="
+                 f"{loadgen.returncode}, ok={report.get('ok')}/"
+                 f"{args.requests})")
+            return 1
+        _say(f"loadgen: {args.requests}/{args.requests} answered, zero "
+             f"drops")
+
+        # Wait for the pool to finish healing (quarantine -> regroup),
+        # then assert the final topology with the loadgen smoke gate.
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            stats = _get_json(url, "/stats")
+            if not stats.get("quarantined_groups"):
+                break
+            time.sleep(0.5)
+        final = [sys.executable, os.path.join(_REPO, "tools",
+                                              "loadgen.py"),
+                 "--smoke", "--url", url, "--requests", "50",
+                 "--concurrency", "4"]
+        if args.expect_groups:
+            final += ["--expect-groups", str(args.expect_groups)]
+        proc = subprocess.run(final, capture_output=True, text=True,
+                              timeout=args.timeout)
+        print(proc.stdout.strip().splitlines()[-1]
+              if proc.stdout.strip() else "{}")
+        if proc.returncode != 0:
+            _say("post-heal topology smoke failed")
+            return 1
+        stats = _get_json(url, "/stats")
+        _say(f"final topology: generation "
+             f"{stats.get('topology_generation')}, "
+             f"{stats.get('active_groups')}/{stats.get('groups')} "
+             f"groups active, regroups={stats.get('regroups')}, "
+             f"failovers={stats.get('failovers')}")
+        if args.serve_fault and not stats.get("regroups"):
+            _say("expected at least one regroup under --serve-fault")
+            return 1
+        return 0
+    finally:
+        # A failed /resize (HTTPError) or a loadgen communicate timeout
+        # propagates through here with loadgen still running against a
+        # server this block is about to kill: reap it too, or it spins
+        # connection errors as an orphan.
+        if loadgen is not None and loadgen.poll() is None:
+            loadgen.kill()
+            loadgen.wait()
+        server.kill()
+        server.wait()
+        log.close()
+        os.unlink(log.name)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
 def main(argv=None) -> int:
@@ -113,9 +313,24 @@ def main(argv=None) -> int:
                         "world — survivors re-exec at the smaller size "
                         "and resume from the last published checkpoint "
                         "— instead of ending the run")
+    p.add_argument("--elastic-grow", action="store_true",
+                   help="elastic: run the epoch-boundary grow "
+                        "rendezvous too, so join records (--rejoin, or "
+                        "announce_join) are admitted between epochs — "
+                        "the shrink-then-GROW scenarios")
+    p.add_argument("--rejoin", type=str, default=None,
+                   metavar="HOST@GEN[,...]",
+                   help="elastic: write HOST's join record while "
+                        "generation GEN runs (the deterministic "
+                        "simulation of a returned/replacement host "
+                        "announcing itself; e.g. 1@1 for the 2->1->2 "
+                        "twin)")
     p.add_argument("--min-world", type=int, default=1, metavar="W",
                    help="elastic floor: stop shrinking below W healthy "
                         "hosts (default 1)")
+    p.add_argument("--max-world", type=int, default=0, metavar="W",
+                   help="elastic ceiling for the grow direction "
+                        "(0 = unbounded)")
     p.add_argument("--settle-timeout", type=float, default=60.0,
                    help="elastic: seconds the supervisor waits for the "
                         "remaining ranks to exit once one has failed, "
@@ -131,6 +346,42 @@ def main(argv=None) -> int:
                    help="whole-run wall clock bound before every rank "
                         "is killed (default 600s); for elastic runs, "
                         "the per-generation bound")
+    # -- the serve-plane twins (pool self-healing / rolling resize) ----
+    p.add_argument("--serve", action="store_true",
+                   help="serve-plane chaos: boot a real `tpu-mnist "
+                        "serve` subprocess (fresh-init params), hammer "
+                        "it with loadgen, and assert zero dropped "
+                        "requests through a group 'death' "
+                        "(--serve-fault) or a rolling /resize "
+                        "(--resize), plus the post-heal topology "
+                        "(--expect-groups)")
+    p.add_argument("--serve-devices", type=int, default=2,
+                   help="serve twin: replicas the server boots with")
+    p.add_argument("--serve-fault", type=str, default=None,
+                   metavar="GROUP[:AFTER]",
+                   help=f"serve twin: {SERVE_FAULT_ENV} injection — "
+                        "group GROUP's dispatch starts failing after "
+                        "AFTER successful batches (its 'chips die'); "
+                        "the pool must quarantine it, fail batches "
+                        "over, and regroup under traffic")
+    p.add_argument("--resize", type=str, default=None, metavar="N1[,N2...]",
+                   help="serve twin: roll POST /resize through these "
+                        "serve_devices targets while loadgen runs "
+                        "(the rolling-topology-change twin)")
+    p.add_argument("--expect-groups", type=int, default=0,
+                   help="serve twin: require this many ACTIVE groups "
+                        "in the final /stats (0 skips)")
+    p.add_argument("--quarantine-after", type=int, default=3,
+                   help="serve twin: consecutive-failure threshold "
+                        "handed to the server (default 3)")
+    p.add_argument("--requests", type=int, default=400,
+                   help="serve twin: loadgen request count (default "
+                        "400)")
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="serve twin: force the server onto the CPU "
+                        "backend with this many fake devices (local "
+                        "rehearsal on accelerator-less boxes; 0 = "
+                        "leave the environment alone)")
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="arguments after -- go to tpu-mnist verbatim")
     args = p.parse_args(argv)
@@ -138,6 +389,14 @@ def main(argv=None) -> int:
     if args.list:
         list_fault_points()
         return 0
+
+    if args.serve:
+        args.resize_targets = [int(t) for t in
+                               (args.resize or "").split(",") if t.strip()]
+        return run_serve_chaos(args)
+    if args.resize or args.serve_fault:
+        raise SystemExit("--serve-fault/--resize are serve-plane twins; "
+                         "add --serve")
 
     if args.fault:
         parse_fault_specs(args.fault)  # fail fast with the spec's message
@@ -157,8 +416,12 @@ def main(argv=None) -> int:
     if args.elastic:
         return supervise(
             args.nprocs, cli_args, min_world=args.min_world,
+            max_world=args.max_world, grow=args.elastic_grow,
+            rejoin=_parse_rejoin(args.rejoin) if args.rejoin else (),
             settle_timeout=args.settle_timeout,
             generation_timeout=args.timeout)
+    if args.elastic_grow or args.rejoin:
+        raise SystemExit("--elastic-grow/--rejoin require --elastic")
     return spawn_local(args.nprocs, cli_args, timeout=args.timeout)
 
 

@@ -219,6 +219,14 @@ def main(argv=None) -> int:
                         "this serve_mode (e.g. 'tensor' — the sharded "
                         "--serve-mode data plane), with the mesh-shape "
                         "fields present for sharded modes")
+    p.add_argument("--expect-groups", type=int, default=0,
+                   help="smoke: additionally require /stats to report "
+                        "exactly this many ACTIVE (non-quarantined) "
+                        "dispatch groups — the post-regroup/post-resize "
+                        "topology assertion (mirrors --expect-replicas/"
+                        "--expect-mode; the report always carries "
+                        "topology_generation when the server exposes "
+                        "it); 0 skips the check")
     args = p.parse_args(argv)
 
     url = args.url.rstrip("/")
@@ -243,7 +251,8 @@ def main(argv=None) -> int:
     # unreachable /stats) just omits them.
     def _shape_fields(stats: dict) -> None:
         for key in ("serve_mode", "serve_devices", "mesh_devices",
-                    "mesh_groups", "max_inflight"):
+                    "mesh_groups", "max_inflight", "topology_generation",
+                    "groups", "active_groups", "quarantined_groups"):
             if key in stats:
                 out[key] = stats[key]
 
@@ -296,6 +305,15 @@ def main(argv=None) -> int:
                     and (args.expect_mode == "replicated"
                          or (stats.get("mesh_devices", 0) >= 1
                              and stats.get("mesh_groups", 0) >= 1))
+                )
+            if args.expect_groups:
+                # The post-regroup/post-resize topology really landed:
+                # exactly N dispatch groups are active (quarantined ones
+                # excluded — a group mid-rebuild is not serving
+                # capacity), per the pool's own topology block.
+                smoke_ok = (
+                    smoke_ok
+                    and stats.get("active_groups") == args.expect_groups
                 )
         except Exception as exc:  # noqa: BLE001
             out["smoke_error"] = repr(exc)
