@@ -963,6 +963,16 @@ def main_serve() -> None:
     caveat: host-thread collectives say nothing about ICI, so only the
     schema and the recompile verdicts are meaningful there.
 
+    The MPMD pipeline plane (``serve/pipeline.py``) gets the
+    ``pipeline_serving`` block: one chain of per-chip stage programs
+    driven with the in-flight window >= stages vs window 1
+    (``stage_overlap_speedup``, ABBA-paired — the win of stage k
+    computing batch N while stage k+1 computes batch N-1), per-stage
+    synchronous step walls + occupancy (where the pipe's clock is set),
+    and per bucket x stage zero-recompile verdicts that fail the bench
+    loudly. Same CPU caveat discipline: host-thread transfers say
+    nothing about ICI hop costs.
+
     In CI this runs on CPU with
     ``XLA_FLAGS=--xla_force_host_platform_device_count=4``.
     """
@@ -1200,6 +1210,7 @@ def main_serve() -> None:
                 "single-device world: a serving mesh needs >= 2 chips")
         else:
             from pytorch_distributed_mnist_tpu.serve.programs import (
+                get_serve_mode,
                 registered_mode_models,
                 validate_serve_mode,
             )
@@ -1208,6 +1219,10 @@ def main_serve() -> None:
             # register_serve_mode joins the comparison and the recompile
             # verdict automatically (the server's extension contract).
             for mode, model_name in registered_mode_models():
+                if get_serve_mode(mode).engine_factory is not None:
+                    # Non-SPMD modes (MPMD pipeline) are not a mesh
+                    # lowering; they measure in their own block below.
+                    continue
                 shard_model = get_model(
                     model_name, **({} if device.platform == "tpu"
                                    else {"compute_dtype": jnp.float32}))
@@ -1317,6 +1332,79 @@ def main_serve() -> None:
                     "sharded-vs-replicated sign is not meaningful here — "
                     "only the schema and the zero-recompile verdicts are")
 
+        # -- MPMD pipeline serving (serve/pipeline.py): the stage-overlap
+        # measurement. ONE chain of per-chip stage programs, driven with
+        # the in-flight window >= stages (the pipe fills: stage k runs
+        # batch N while stage k+1 runs batch N-1) vs window 1 (strict
+        # dispatch->complete alternation: every batch pays the full
+        # chain serially). A single chain on purpose — a multi-chain
+        # pool at window>1 would conflate chain fan-out with stage
+        # overlap. ABBA-paired interleaved drives, median paired ratio
+        # (PR 4 methodology); fixed-shape 8-row requests pin batch
+        # formation. Per-stage synchronous step walls + occupancy say
+        # WHERE the pipe's clock is set (the bottleneck stage reads 1.0).
+        pipeline_block: dict = {}
+        pipeline_recompiles: list = []
+        if n_devices < 2:
+            pipeline_block["skipped"] = (
+                "single-device world: a pipeline chain needs >= 2 chips")
+        else:
+            from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+                split_vit_params,
+            )
+            from pytorch_distributed_mnist_tpu.utils.profiling import (
+                stage_occupancy,
+            )
+
+            pp_model = get_model(
+                "vit", **({} if device.platform == "tpu"
+                          else {"compute_dtype": jnp.float32}))
+            # depth must divide the stage count; the default ViT (depth
+            # 2) pins the chain at 2 stages regardless of chip count.
+            pp_stages = 2
+            pp_params = split_vit_params(
+                create_train_state(pp_model, jax.random.key(0)).params)
+            pp_pool = EnginePool(
+                pp_model.apply, pp_params,
+                devices=jax.local_devices()[:pp_stages], buckets=(1, 8),
+                serve_mode="pipeline", mesh_size=pp_stages,
+                model_name="vit", model=pp_model)
+            pp_pool.warmup()
+            before_pp = _serve_program_compiles()
+            window = pp_stages + 1
+            walls = drive_pool_interleaved(
+                pp_pool, windows=(window, 1), requests_n=pool_requests)
+            pp_pairs = [round(off / on, 3) for on, off
+                        in zip(walls[window], walls[1])]
+            ratios = sorted(pp_pairs)
+            overlap_speedup = ratios[len(ratios) // 2]
+            delta_pp = _recompile_delta(before_pp,
+                                        _serve_program_compiles())
+            if delta_pp:
+                pipeline_recompiles.append(delta_pp)
+            stage_ms = pp_pool.replicas[0].engine.stage_step_ms(8)
+            pipeline_block = {
+                "model": "vit",
+                "stages": pp_stages,
+                "chains": 1,
+                "window": window,
+                "requests": pool_requests,
+                "stage_overlap_speedup": overlap_speedup,
+                "pairs": pp_pairs,
+                "requests_per_sec": round(
+                    pool_requests / min(walls[window]), 1),
+                "stage_step_ms": stage_ms,
+                "stage_occupancy": stage_occupancy(stage_ms),
+                "zero_steady_state_recompiles": not delta_pp,
+            }
+            if device.platform != "tpu":
+                pipeline_block["caveat"] = (
+                    "CPU fallback (the BENCH_r05 convention): "
+                    "host-thread transfers say nothing about ICI, so "
+                    "the inter-stage hop cost is not the chip's — only "
+                    "the overlap schema and the zero-recompile verdicts "
+                    "are meaningful here")
+
         value = requests / wall
         out.update({
             "value": round(value, 1),
@@ -1333,6 +1421,7 @@ def main_serve() -> None:
             "zero_steady_state_recompiles": zero_recompiles,
             "replica_scaling": replica_scaling,
             "sharded": sharded_block,
+            "pipeline_serving": pipeline_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
             "pool_requests": pool_requests,
@@ -1349,7 +1438,8 @@ def main_serve() -> None:
         # completions would inflate the headline), and nothing failed.
         served_all = snap["requests"] == 2 * requests  # best-of-2 drives
         ok = (zero_recompiles and not drive_errors and served_all
-              and not recompiled_replicas and not sharded_recompiles)
+              and not recompiled_replicas and not sharded_recompiles
+              and not pipeline_recompiles)
         if not zero_recompiles:
             out["error"] = ("steady-state serving recompiled: "
                             f"{totals_after_warmup} -> {totals_after_load}")
@@ -1359,6 +1449,10 @@ def main_serve() -> None:
         elif sharded_recompiles:
             out["error"] = ("steady-state SHARDED serving recompiled "
                             f"(per bucket x mode): {sharded_recompiles}")
+        elif pipeline_recompiles:
+            out["error"] = ("steady-state MPMD pipeline serving "
+                            "recompiled (per bucket x stage): "
+                            f"{pipeline_recompiles}")
         elif drive_errors:
             out["error"] = (f"{len(drive_errors)} requests failed during "
                             f"the drive: {drive_errors[:3]}")

@@ -49,8 +49,11 @@ __all__ = [
     "split_vit_params",
     "merge_vit_params",
     "make_pipelined_vit_apply",
+    "make_stage_forward_fns",
+    "pipeline_stage_rules",
     "pipelined_state_sharding",
     "create_pipelined_vit_state",
+    "split_stage_params",
 ]
 
 
@@ -58,6 +61,12 @@ def split_vit_params(params):
     """Standard ViT flax tree -> pipelined {embed, blocks, head} layout."""
     p = params["params"]
     depth = sum(1 for k in p if k.startswith("block"))
+    if not depth:
+        # A blockless tree (wrong model family) would otherwise die in
+        # tree_map with an argument-count error; name the real problem.
+        raise ValueError(
+            f"params have no block* layers to pipeline (keys: "
+            f"{sorted(p)})")
     blocks = [p[f"block{i}"] for i in range(depth)]
     stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *blocks)
     return {
@@ -147,6 +156,113 @@ def make_pipelined_vit_apply(
         return h.astype(jnp.float32)
 
     return apply_fn
+
+
+def pipeline_stage_rules(axis: str = "stage"):
+    """Callable rule table for the serve registry (``leaf_spec`` accepts
+    callables): every leaf under ``blocks`` is ``P(axis)`` on dim 0 — the
+    stacked depth dim, which is the stage seam — everything else
+    replicated. The divisibility walk ``serve/programs.py::
+    validate_serve_mode`` runs over these reduces to exactly
+    "depth % stages == 0", the same constraint
+    ``make_pipelined_vit_apply`` enforces for training."""
+
+    def rules(path):
+        keys = [str(getattr(k, "key", getattr(k, "name", None)))
+                for k in path]
+        return P(axis) if "blocks" in keys else P()
+
+    return rules
+
+
+def split_stage_params(split, n_stages: int):
+    """Pipelined ``{embed, blocks, head}`` params -> per-stage trees.
+
+    Stage ``s`` gets blocks ``[s*k, (s+1)*k)`` (``k = depth / S`` — the
+    SAME boundaries the training pipeline's stage-axis sharding cuts, so
+    a served stage holds exactly what its training twin held); stage 0
+    additionally carries ``embed`` and the last stage ``head`` (the
+    shape-ragged ends, replicated over ``stage`` in training, belong to
+    the end stages when each stage is an independent program). Pure
+    dim-0 slicing — works on host numpy and jax arrays alike, no copy
+    beyond the slice. The MPMD serve plane (``serve/pipeline.py``)
+    splits every checkpoint through here.
+    """
+    blocks = split["blocks"]
+    depth = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if n_stages < 1 or depth % n_stages:
+        raise ValueError(
+            f"vit depth {depth} not divisible by {n_stages} pipeline "
+            f"stages"
+        )
+    k = depth // n_stages
+    stages = []
+    for s in range(n_stages):
+        tree = {"blocks": jax.tree_util.tree_map(
+            lambda a, s=s: a[s * k:(s + 1) * k], blocks)}
+        if s == 0:
+            tree["embed"] = split["embed"]
+        if s == n_stages - 1:
+            tree["head"] = split["head"]
+        stages.append(tree)
+    return stages
+
+
+def make_stage_forward_fns(model: VisionTransformer, n_stages: int):
+    """Per-stage inference forwards: ``[forward_k(stage_params, x) -> y]``.
+
+    Stage 0 maps images to embedded tokens and applies its blocks;
+    middle stages are pure block stacks ((B, T, C) in and out, the
+    uniform-activation property the GPipe schedule relies on); the last
+    stage closes with LN -> mean-pool -> head -> float32 logits. The
+    module set and application order are literally
+    ``make_pipelined_vit_apply``'s (same ``embed_mod``/``block_mod``/
+    ``ln_mod``/``head_mod`` construction, same ``lax.scan`` over the
+    stage's stacked blocks), so chaining the S forwards reproduces the
+    trained pipeline's math — each one just compiles as an INDEPENDENT
+    program on its own chip (``serve/pipeline.py``), no remat (inference
+    keeps no activations).
+    """
+    if model.depth % n_stages:
+        raise ValueError(
+            f"vit depth {model.depth} not divisible by {n_stages} "
+            f"pipeline stages"
+        )
+    cd = model.compute_dtype
+    embed_mod = nn.Dense(model.embed_dim, dtype=cd)
+    block_mod = TransformerBlock(
+        model.num_heads, model.mlp_ratio, model.attention_fn, cd
+    )
+    ln_mod = nn.LayerNorm(dtype=cd)
+    head_mod = nn.Dense(model.num_classes, dtype=cd)
+
+    def apply_blocks(stage_blocks, h):
+        def body(h, bp):
+            return block_mod.apply({"params": bp}, h), None
+
+        h, _ = lax.scan(body, h, stage_blocks)
+        return h
+
+    def make_forward(s: int):
+        def forward(stage_params, x):
+            h = x
+            if s == 0:
+                h = patchify(h, model.patch_size, cd)
+                h = embed_mod.apply(
+                    {"params": stage_params["embed"]["embed"]}, h)
+                h = h + stage_params["embed"]["pos_embed"].astype(cd)
+            h = apply_blocks(stage_params["blocks"], h)
+            if s == n_stages - 1:
+                h = ln_mod.apply({"params": stage_params["head"]["ln_f"]}, h)
+                h = jnp.mean(h, axis=1)
+                h = head_mod.apply({"params": stage_params["head"]["head"]},
+                                   h)
+                h = h.astype(jnp.float32)
+            return h
+
+        return forward
+
+    return [make_forward(s) for s in range(n_stages)]
 
 
 def create_pipelined_vit_state(

@@ -50,8 +50,18 @@ def _path_keys(path) -> Tuple[str, ...]:
     return tuple(keys)
 
 
-def leaf_spec(path, rules: Dict[Tuple[str, str], P]) -> P:
-    """PartitionSpec for one leaf: match the last two path keys, default P()."""
+def leaf_spec(path, rules) -> P:
+    """PartitionSpec for one leaf: match the last two path keys, default P().
+
+    ``rules`` may also be a CALLABLE ``rules(path) -> PartitionSpec`` for
+    layouts a two-key suffix table cannot express — the pipeline layout's
+    "every leaf under ``blocks``" rule (``parallel/pipeline_vit.py::
+    pipeline_stage_rules``) is the motivating case; the serve registry's
+    divisibility walk (``serve/programs.py::validate_serve_mode``) feeds
+    both forms through here.
+    """
+    if callable(rules):
+        return rules(path)
     keys = _path_keys(path)
     return rules.get(tuple(keys[-2:]), P())
 

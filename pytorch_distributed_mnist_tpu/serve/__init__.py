@@ -17,11 +17,16 @@ REQUESTS — the north-star's "serves heavy traffic" capability. Pieces:
   ``--max-inflight``); with a sharded ``--serve-mode`` the chips
   partition into ``--serve-mesh``-sized mesh groups instead;
 - ``programs.py``: the forward-program registry — given a model name
-  and a ``--serve-mode`` (replicated / tensor / expert, extensible),
-  builds the serving mesh, derives param/input/output shardings from
-  the training rule tables, and hands the engine a
+  and a ``--serve-mode`` (replicated / tensor / expert / pipeline,
+  extensible), builds the serving mesh, derives param/input/output
+  shardings from the training rule tables, and hands the engine a
   :class:`MeshPlacement` its bucket programs AOT-lower against, plus
   the checkpoint parallel-layout gate (``check_checkpoint_layout``);
+- ``pipeline.py``: :class:`PipelineEngine` — the MPMD plane for
+  pipeline-trained checkpoints: one INDEPENDENT program per stage chip
+  (stage params split at the training stage boundaries), micro-batches
+  streamed between stages with async device-to-device hops so stage k
+  runs batch N while stage k+1 runs batch N-1;
 - ``reload.py``: :class:`CheckpointWatcher` — polls a published
   checkpoint directory (``train/checkpoint.py`` conventions) and swaps
   params atomically between batches (fanned out per replica on a pool);
@@ -34,6 +39,7 @@ Drive it with ``tools/loadgen.py``; measure it with
 
 from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
 from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.serve.pipeline import PipelineEngine
 from pytorch_distributed_mnist_tpu.serve.pool import EnginePool, EngineReplica
 from pytorch_distributed_mnist_tpu.serve.programs import (
     SERVE_MODES,
@@ -54,6 +60,7 @@ __all__ = [
     "MeshPlacement",
     "MicroBatcher",
     "Overloaded",
+    "PipelineEngine",
     "build_group_placements",
     "build_placement",
     "check_checkpoint_layout",

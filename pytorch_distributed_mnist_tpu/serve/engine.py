@@ -69,6 +69,122 @@ from pytorch_distributed_mnist_tpu.train.steps import (
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 
+class StagingPool:
+    """Per-bucket float32 staging free-lists (the lifecycle in the module
+    docstring), factored out so every serving engine shares ONE
+    implementation: the single/pooled/sharded ``InferenceEngine`` and the
+    MPMD per-stage plane (``serve/pipeline.py``) acquire at dispatch, pin
+    until the completion fetch, and release for reuse through the same
+    code."""
+
+    def __init__(self, buckets: Sequence[int],
+                 input_shape: Tuple[int, ...]) -> None:
+        self.input_shape = tuple(input_shape)
+        self._lock = threading.Lock()
+        self._free: dict = {b: [] for b in buckets}
+        self._allocated = {b: 0 for b in buckets}
+
+    def acquire(self, bucket: int) -> np.ndarray:
+        """Pop a free staging buffer for ``bucket`` (allocate only when
+        the free-list is dry — i.e. only until the pool has grown to the
+        in-flight window's depth)."""
+        with self._lock:
+            free = self._free[bucket]
+            if free:
+                return free.pop()
+            self._allocated[bucket] += 1
+        return np.zeros((bucket,) + self.input_shape, np.float32)
+
+    def release(self, buffers: List[Tuple[int, np.ndarray]]) -> None:
+        with self._lock:
+            for bucket, buf in buffers:
+                self._free[bucket].append(buf)
+
+    def allocated(self) -> dict:
+        """Total buffers ever allocated per bucket — the steady-state
+        invariant (no per-batch allocation) is that this stops growing
+        once the in-flight window is warm; tests pin it."""
+        with self._lock:
+            return dict(self._allocated)
+
+
+def stage_batch(images: np.ndarray, bucket: int, staging: StagingPool,
+                workers: int, buffers: List) -> np.ndarray:
+    """Stage one chunk into its bucket: the exact-fit no-copy fast path,
+    or a pad-into-staging fill (multithreaded native kernel with the
+    bitwise-identical NumPy fallback — padded rows are zeros, as they
+    always were). Any buffer acquired is appended to ``buffers`` so the
+    in-flight batch pins it until completion proves the device consumed
+    the input. Shared by ``InferenceEngine`` and the per-stage MPMD
+    plane so the staging bytes can never drift between them."""
+    n = images.shape[0]
+    if (n == bucket and images.dtype == np.float32
+            and images.flags["C_CONTIGUOUS"]):
+        # Exact fit, already float32-contiguous: no pad, no copy — the
+        # array goes to the device as-is (bitwise-pinned equal to the
+        # padded path by the exactness tests).
+        return images
+    buf = staging.acquire(bucket)
+    # Anything not already f32 C-contiguous goes straight to the
+    # fallback's one converting copy — a pre-conversion just to feed
+    # the native kernel would cost a second full-batch copy.
+    filled = (images.dtype == np.float32
+              and images.flags["C_CONTIGUOUS"]
+              and native.pad_into(buf, images, workers=workers))
+    if not filled:
+        buf[:n] = images
+        if n < bucket:
+            buf[n:] = 0.0
+    buffers.append((bucket, buf))
+    return buf
+
+
+def preprocess_images(images, input_shape: Tuple[int, ...],
+                      workers: int) -> np.ndarray:
+    """Raw request pixels -> the float32 normalized layout training
+    uses. Accepts uint8 ``(N, 28, 28)`` raw images (normalized with the
+    SAME ``normalize_images`` the training loaders apply) or
+    already-normalized float32 ``(N,) + input_shape`` arrays; a single
+    example may drop its leading axis either way.
+
+    Zero Python-side array math on the dispatch path when the native
+    library is built: normalize and the f64->f32 cast run in
+    multithreaded C++ over ``workers`` threads, with the NumPy
+    expressions as the mandatory bitwise-identical fallback."""
+    arr = np.asarray(images)
+    if arr.size == 0:
+        raise ValueError("at least one image required")
+    raw_shape = input_shape[:-1]  # e.g. (28, 28): pre-channel
+    if arr.dtype == np.uint8:
+        if arr.shape == raw_shape:
+            arr = arr[None]
+        if arr.ndim == len(raw_shape) + 1 and arr.shape[1:] == raw_shape:
+            return normalize_images(arr, workers=workers)
+    elif np.issubdtype(arr.dtype, np.floating):
+        cast = native.cast_f32(arr, workers=workers) \
+            if arr.dtype == np.float64 else None
+        arr = cast if cast is not None \
+            else arr.astype(np.float32, copy=False)
+        if arr.shape == input_shape:
+            arr = arr[None]
+        if arr.ndim == len(input_shape) + 1 \
+                and arr.shape[1:] == input_shape:
+            return arr
+    raise ValueError(
+        f"expected uint8 (N, {', '.join(map(str, raw_shape))}) raw "
+        f"images or float32 (N, {', '.join(map(str, input_shape))})"
+        f" normalized images; got {arr.dtype} {arr.shape}")
+
+
+def bucket_for(buckets: Sequence[int], n: int) -> int:
+    """Smallest bucket >= n (n must not exceed the largest bucket — the
+    dispatch paths chunk oversized batches before calling this)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds largest bucket {buckets[-1]}")
+
+
 class _InFlightBatch:
     """One dispatched-but-not-fetched batch: the device arrays (futures
     under JAX async dispatch), the epoch of the params that computed
@@ -181,9 +297,7 @@ class InferenceEngine:
         self._params_epoch = params_epoch
         self._compiled = {}  # bucket -> Compiled executable
         # bucket -> free staging buffers (see module docstring lifecycle).
-        self._staging_lock = threading.Lock()
-        self._staging: dict = {b: [] for b in self.buckets}
-        self._staging_allocated = {b: 0 for b in self.buckets}
+        self._staging = StagingPool(self.buckets, self.input_shape)
 
     def _place(self, tree):
         """Commit a PARAMS tree to this engine's device(s): the mesh
@@ -276,71 +390,23 @@ class InferenceEngine:
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n (n must not exceed the largest bucket —
         ``logits`` chunks oversized batches before calling this)."""
-        for b in self.buckets:
-            if n <= b:
-                return b
-        raise ValueError(f"batch of {n} exceeds largest bucket "
-                         f"{self.max_batch}")
+        return bucket_for(self.buckets, n)
 
     def preprocess(self, images: np.ndarray) -> np.ndarray:
         """Raw request pixels -> the float32 normalized layout training
-        uses. Accepts uint8 ``(N, 28, 28)`` raw images (normalized with
-        the SAME ``normalize_images`` the training loaders apply) or
-        already-normalized float32 ``(N,) + input_shape`` arrays; a single
-        example may drop its leading axis either way.
-
-        Zero Python-side array math on the dispatch path when the native
-        library is built: normalize and the f64->f32 cast run in
-        multithreaded C++ over ``self.workers`` threads, with the NumPy
-        expressions as the mandatory bitwise-identical fallback."""
-        arr = np.asarray(images)
-        if arr.size == 0:
-            raise ValueError("at least one image required")
-        raw_shape = self.input_shape[:-1]  # e.g. (28, 28): pre-channel
-        if arr.dtype == np.uint8:
-            if arr.shape == raw_shape:
-                arr = arr[None]
-            if arr.ndim == len(raw_shape) + 1 and arr.shape[1:] == raw_shape:
-                return normalize_images(arr, workers=self.workers)
-        elif np.issubdtype(arr.dtype, np.floating):
-            cast = native.cast_f32(arr, workers=self.workers) \
-                if arr.dtype == np.float64 else None
-            arr = cast if cast is not None \
-                else arr.astype(np.float32, copy=False)
-            if arr.shape == self.input_shape:
-                arr = arr[None]
-            if arr.ndim == len(self.input_shape) + 1 \
-                    and arr.shape[1:] == self.input_shape:
-                return arr
-        raise ValueError(
-            f"expected uint8 (N, {', '.join(map(str, raw_shape))}) raw "
-            f"images or float32 (N, {', '.join(map(str, self.input_shape))})"
-            f" normalized images; got {arr.dtype} {arr.shape}")
+        uses (module-level :func:`preprocess_images`, shared with the
+        per-stage MPMD plane)."""
+        return preprocess_images(images, self.input_shape, self.workers)
 
     # -- staging-buffer lifecycle -----------------------------------------
 
-    def _acquire_staging(self, bucket: int) -> np.ndarray:
-        """Pop a free staging buffer for ``bucket`` (allocate only when
-        the free-list is dry — i.e. only until the pool has grown to the
-        in-flight window's depth)."""
-        with self._staging_lock:
-            free = self._staging[bucket]
-            if free:
-                return free.pop()
-            self._staging_allocated[bucket] += 1
-        return np.zeros((bucket,) + self.input_shape, np.float32)
-
     def _release_staging(self, buffers: List[Tuple[int, np.ndarray]]) -> None:
-        with self._staging_lock:
-            for bucket, buf in buffers:
-                self._staging[bucket].append(buf)
+        self._staging.release(buffers)
 
     def staging_allocated(self) -> dict:
-        """Total buffers ever allocated per bucket — the steady-state
-        invariant (no per-batch allocation) is that this stops growing
-        once the in-flight window is warm; tests pin it."""
-        with self._staging_lock:
-            return dict(self._staging_allocated)
+        """Total buffers ever allocated per bucket (see
+        :meth:`StagingPool.allocated`)."""
+        return self._staging.allocated()
 
     # -- dispatch / complete ----------------------------------------------
 
@@ -351,31 +417,8 @@ class InferenceEngine:
         ``buffers`` so the in-flight batch pins it until completion."""
         n = images.shape[0]
         bucket = self.bucket_for(n)
-        if (n == bucket and images.dtype == np.float32
-                and images.flags["C_CONTIGUOUS"]):
-            # Exact fit, already float32-contiguous: no pad, no copy —
-            # the array goes to the device as-is (bitwise-pinned equal to
-            # the padded path by the exactness tests).
-            staged = images
-        else:
-            buf = self._acquire_staging(bucket)
-            # The staging fill (copy + zero the padded tail) runs in
-            # multithreaded C++ when built; the NumPy fallback writes
-            # the identical bytes (padded rows are zeros, as they
-            # always were). Anything not already f32 C-contiguous goes
-            # straight to the fallback's one converting copy — a
-            # pre-conversion just to feed the native kernel would cost
-            # a second full-batch copy.
-            filled = (images.dtype == np.float32
-                      and images.flags["C_CONTIGUOUS"]
-                      and native.pad_into(buf, images,
-                                          workers=self.workers))
-            if not filled:
-                buf[:n] = images
-                if n < bucket:
-                    buf[n:] = 0.0
-            staged = buf
-            buffers.append((bucket, buf))
+        staged = stage_batch(images, bucket, self._staging, self.workers,
+                             buffers)
         compiled = self._compiled.get(bucket)
         x = self._place_input(staged)
         if compiled is not None:

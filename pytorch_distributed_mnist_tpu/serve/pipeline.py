@@ -1,0 +1,396 @@
+"""MPMD pipeline serving: independent per-stage programs, streamed
+micro-batches.
+
+The SPMD serving planes (``serve/programs.py``) lower ONE program over
+the whole mesh — which is exactly why the pipeline layout could not
+serve: a pipeline-trained checkpoint's params are stage-stacked, and a
+single spanning program would hold every stage's weights everywhere,
+forfeiting the one thing pipeline parallelism buys (params bigger than
+one chip's HBM). Following the MPMD pipeline-parallelism direction in
+PAPERS.md — and in contrast to the one-program-over-the-mesh pjit
+approach — this module compiles each stage as an INDEPENDENT program on
+its own chip:
+
+- **Stage split.** ``parallel/pipeline_vit.py::split_stage_params`` cuts
+  the checkpoint's ``{embed, blocks, head}`` tree at the SAME block
+  boundaries training's stage axis used; stage 0 carries the patch
+  embedding, the last stage the head. Each stage's params commit to that
+  stage's chip only — no chip ever holds another stage's weights.
+- **Per-stage AOT programs.** One compiled forward per batch bucket PER
+  STAGE (``CompileLog`` names ``serve_forward_b{b}@pipeline.s{k}``;
+  ``@pipeline.g{i}.s{k}`` on multi-chain pools), built through the same
+  ``precompile`` path as every other serve program — zero steady-state
+  recompiles per bucket x stage, params an ARGUMENT of every program so
+  hot-reload stays swap-only.
+- **Streaming.** ``dispatch_logits`` stages the batch onto stage 0's
+  chip and enqueues the whole chain — stage k's program, then an async
+  device-to-device hop of the activation to stage k+1 — and returns
+  without waiting (JAX async dispatch: every device runs its own
+  execution stream). With the batcher's in-flight window >= stages, the
+  chain fills like a GPipe schedule: stage k runs batch N while stage
+  k+1 runs batch N-1, and steady-state throughput approaches the
+  SLOWEST stage's clock rather than the sum of stages. Window 1
+  degenerates to strict fill-and-drain (every batch pays the full chain
+  latency serially) — the ``bench.py --mode serve``
+  ``pipeline_serving.stage_overlap_speedup`` measurement is exactly
+  window >= stages vs window 1.
+
+Hot-reload swaps are COORDINATED across stages: ``swap_params`` splits
+and places every stage's slice off-lock, then installs the whole
+per-stage list under one lock together with the epoch; dispatch captures
+the full list under the same lock once per batch — so one batch can
+never run stage 0 on epoch E and stage 1 on epoch E+1 (the no-mixed-
+epoch guarantee, now per-chain instead of per-device).
+
+The engine surface (``warmup`` / ``swap_params`` / ``dispatch_logits``
+/ ``complete`` / ``preprocess`` / ``buckets`` / ``params_epoch``)
+mirrors :class:`~pytorch_distributed_mnist_tpu.serve.engine.
+InferenceEngine`, so ``EnginePool`` treats a pipeline CHAIN as one
+replica spanning its stage chips: least-loaded dispatch across chains,
+quarantine/regroup of the WHOLE chain (a pipeline with a dead stage can
+serve nothing — the pool's group machinery is already chain-shaped),
+and the reload fan-out all work unchanged. Registered as serve mode
+``pipeline`` via ``register_serve_mode``, which is what routes the boot
+gate, the divisibility walk, ``/stats``, and the bench through it
+without special-casing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+    make_stage_forward_fns,
+    split_stage_params,
+    split_vit_params,
+)
+from pytorch_distributed_mnist_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    StagingPool,
+    _InFlightBatch,
+    bucket_for,
+    preprocess_images,
+    stage_batch,
+)
+from pytorch_distributed_mnist_tpu.train.steps import abstract_spec, precompile
+
+__all__ = ["PipelineEngine", "make_pipeline_template",
+           "pipeline_engine_factory"]
+
+
+class _StageProgram:
+    """One pipeline stage: its forward jitted for its own chip, one AOT
+    executable per batch bucket. Holds no params — the engine owns the
+    per-stage params list so the cross-stage swap stays atomic."""
+
+    __slots__ = ("index", "device", "sharding", "name", "forward", "_jit",
+                 "_compiled")
+
+    def __init__(self, index: int, forward, device, name: str) -> None:
+        self.index = index
+        self.device = device
+        self.name = name  # e.g. "pipeline.s0" / "pipeline.g1.s0"
+        self.forward = forward
+        self.sharding = jax.sharding.SingleDeviceSharding(device)
+        self._jit = jax.jit(forward, in_shardings=self.sharding,
+                            out_shardings=self.sharding)
+        self._compiled = {}  # bucket -> Compiled executable
+
+    def program_name(self, bucket: int) -> str:
+        return f"serve_forward_b{bucket}@{self.name}"
+
+    def warmup(self, params_spec, in_specs: dict) -> dict:
+        """AOT-compile every bucket's program (idempotent; measured
+        under ``program_name`` so the zero-recompile verdict stays
+        attributable per bucket x stage). Returns the bucket -> output
+        spec map — the next stage's input specs, chained by the engine
+        so no stage ever guesses an activation shape."""
+        out_specs = {}
+        for bucket, spec in in_specs.items():
+            if bucket not in self._compiled:
+                self._compiled[bucket] = precompile(
+                    self._jit, params_spec, spec,
+                    program=self.program_name(bucket))
+            out_specs[bucket] = jax.eval_shape(self.forward, params_spec,
+                                               spec)
+        return out_specs
+
+    def run(self, params, x):
+        """Enqueue this stage's program on its chip (async dispatch).
+        ``x`` must already be committed to this stage's device."""
+        compiled = self._compiled.get(x.shape[0])
+        if compiled is not None:
+            return compiled(params, x)
+        # Lazy fallback (warmup skipped or failed): same program via
+        # jit — correctness preserved; the no-recompile guarantee is
+        # what warmup buys.
+        return self._jit(params, x)
+
+
+class PipelineEngine:
+    """S independent per-stage programs behind the one-engine surface.
+
+    ``devices`` gives one chip per stage (stage k pinned to
+    ``devices[k]``); ``params`` is the FULL pipelined checkpoint tree
+    (``{embed, blocks, head}``) — the engine splits it by stage itself,
+    at construction and on every ``swap_params``, so callers (pool
+    fan-out, reload watcher, regroup) never learn the stage layout.
+    ``model`` is the :class:`VisionTransformer` config the stage
+    forwards are built from (per-stage programs cannot be derived from a
+    bare ``apply_fn``: the stage boundary cuts THROUGH it).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        devices: Sequence,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        input_shape: Tuple[int, ...] = (28, 28, 1),
+        serve_log=None,
+        params_epoch: Optional[int] = None,
+        name: str = "pipeline",
+        workers: int = 4,
+    ) -> None:
+        devices = list(devices)
+        if not devices:
+            raise ValueError("PipelineEngine needs at least one device")
+        buckets = sorted({int(b) for b in buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        self.buckets = tuple(buckets)
+        self.input_shape = tuple(input_shape)
+        self.serve_log = serve_log
+        self.workers = workers
+        self.name = name
+        self.n_stages = len(devices)
+        self.devices = tuple(devices)
+        forwards = make_stage_forward_fns(model, self.n_stages)
+        self._stages = [
+            _StageProgram(k, fwd, dev, f"{name}.s{k}")
+            for k, (fwd, dev) in enumerate(zip(forwards, devices))
+        ]
+        self._lock = threading.Lock()
+        self._stage_params = self._place_stages(params)
+        self._params_epoch = params_epoch
+        self._staging = StagingPool(self.buckets, self.input_shape)
+
+    def _place_stages(self, params) -> List:
+        """Split the full pipelined tree by stage and commit each slice
+        to its stage's chip — stage k's weights live on ``devices[k]``
+        ONLY (the HBM story: no chip holds the whole model)."""
+        split = split_stage_params(params, self.n_stages)
+        return [jax.device_put(tree, stage.sharding)
+                for tree, stage in zip(split, self._stages)]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def params_epoch(self) -> Optional[int]:
+        with self._lock:
+            return self._params_epoch
+
+    def stage_names(self) -> List[str]:
+        return [s.name for s in self._stages]
+
+    def warmup(self) -> None:
+        """AOT-compile every bucket x stage program (idempotent). Input
+        specs CHAIN: stage 0 lowers against the image buckets, each later
+        stage against the previous stage's ``eval_shape`` output — the
+        activation contract between independently-compiled programs is
+        derived, never assumed."""
+        with self._lock:
+            stage_params = list(self._stage_params)
+        specs = {
+            b: jax.ShapeDtypeStruct((b,) + self.input_shape, np.float32)
+            for b in self.buckets
+        }
+        for stage, params in zip(self._stages, stage_params):
+            specs = stage.warmup(abstract_spec(params), specs)
+
+    def swap_params(self, params, epoch: Optional[int] = None,
+                    path: Optional[str] = None) -> bool:
+        """Coordinated per-stage hot-reload swap; the signature is the
+        reload watcher's ``on_params`` callback, the return the engine
+        swap-ordering contract (False == rejected as stale).
+
+        The split + per-stage ``device_put`` run OUTSIDE the lock (the
+        slow part); the install writes the WHOLE per-stage list and the
+        epoch under one lock, and dispatch snapshots that list under the
+        same lock once per batch — so a batch either runs every stage on
+        the old epoch or every stage on the new one, never mixed.
+        """
+        del path  # provenance lives on the watcher (current_path)
+        placed = self._place_stages(params)
+        with self._lock:
+            if (epoch is not None and self._params_epoch is not None
+                    and epoch < self._params_epoch):
+                return False  # a newer checkpoint already installed
+            self._stage_params = placed
+            self._params_epoch = epoch
+            return True
+
+    # -- inference ---------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(self.buckets, n)
+
+    def preprocess(self, images) -> np.ndarray:
+        return preprocess_images(images, self.input_shape, self.workers)
+
+    def staging_allocated(self) -> dict:
+        return self._staging.allocated()
+
+    def _dispatch_bucket(self, stage_params: List, images: np.ndarray,
+                         buffers) -> Tuple:
+        """Stage one chunk onto stage 0's chip and enqueue the whole
+        chain: stage k's program, then the async device-to-device hop of
+        its activation onto stage k+1's chip. Nothing here blocks — the
+        returned logits are futures, and with several batches in flight
+        every stage chip works a different batch concurrently."""
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        staged = stage_batch(images, bucket, self._staging, self.workers,
+                             buffers)
+        x = jax.device_put(staged, self._stages[0].sharding)
+        for stage, params in zip(self._stages, stage_params):
+            if stage.index:
+                x = jax.device_put(x, stage.sharding)  # D2D hop
+            x = stage.run(params, x)
+        if self.serve_log is not None:
+            self.serve_log.record_batch(n, bucket, replica=self.name)
+        return x
+
+    def dispatch_logits(self, images) -> _InFlightBatch:
+        """Preprocess + stage + enqueue the per-stage chain WITHOUT
+        waiting (the PR 4 two-phase API): the returned batch holds
+        device futures that materialize while the caller forms the next
+        batch. The per-stage params and the epoch are captured together
+        under the lock, once per batch — the cross-stage swap-atomicity
+        boundary. Batches larger than the top bucket are chunked."""
+        x = self.preprocess(images)
+        with self._lock:
+            stage_params = list(self._stage_params)  # captured ONCE
+            epoch = self._params_epoch
+        chunks, buffers = [], []
+        try:
+            for start in range(0, x.shape[0], self.max_batch):
+                chunk = x[start:start + self.max_batch]
+                chunks.append(
+                    (self._dispatch_bucket(stage_params, chunk, buffers),
+                     chunk.shape[0]))
+        except BaseException:
+            self._staging.release(buffers)
+            raise
+        return _InFlightBatch(self, chunks, epoch, buffers)
+
+    def complete(self, inflight: _InFlightBatch) \
+            -> Tuple[np.ndarray, Optional[int]]:
+        """Block on the last stage's device results, release the staging
+        buffers, and return ``(logits (N, classes), epoch)`` — exactly
+        the single-engine contract, so pool failover and the batcher's
+        completion stage treat a chain like any replica."""
+        try:
+            out = [np.asarray(dev)[:n] for dev, n in inflight.chunks]
+        finally:
+            self._staging.release(inflight.buffers)
+            inflight.buffers = []
+        return np.concatenate(out, axis=0), inflight.epoch
+
+    def logits_with_epoch(self, images) -> Tuple[np.ndarray, Optional[int]]:
+        return self.dispatch_logits(images).complete()
+
+    def logits(self, images) -> np.ndarray:
+        return self.logits_with_epoch(images)[0]
+
+    def predict(self, images) -> np.ndarray:
+        return np.argmax(self.logits(images), axis=-1)
+
+    def predict_with_epoch(self, images) -> Tuple[np.ndarray, Optional[int]]:
+        logits, epoch = self.logits_with_epoch(images)
+        return np.argmax(logits, axis=-1), epoch
+
+    # -- bench instrumentation --------------------------------------------
+
+    def stage_step_ms(self, bucket: int, reps: int = 5) -> dict:
+        """Per-stage SYNCHRONOUS step walls (stage name -> best-of-reps
+        ms) at one bucket: each stage's program run alone on its chip
+        with a blocking fetch, zero activations in flight. This is the
+        bench's occupancy probe — under full streaming the pipe's clock
+        is the SLOWEST stage's wall, and every other stage idles the
+        difference (``utils/profiling.py::stage_occupancy`` turns these
+        into the occupancy fractions) — not a serving-path measurement.
+        """
+        import time
+
+        with self._lock:
+            stage_params = list(self._stage_params)
+        walls: dict = {}
+        x = np.zeros((bucket,) + self.input_shape, np.float32)
+        x = jax.device_put(x, self._stages[0].sharding)
+        for stage, params in zip(self._stages, stage_params):
+            if stage.index:
+                x = jax.device_put(x, stage.sharding)
+            jax.block_until_ready(stage.run(params, x))  # warm transfer
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                y = jax.block_until_ready(stage.run(params, x))
+                best = min(best, time.perf_counter() - t0)
+            walls[stage.name.rsplit(".", 1)[-1]] = round(best * 1e3, 3)
+            x = y
+        return walls
+
+
+def make_pipeline_template(model, rng):
+    """The template state a pipeline-trained checkpoint restores onto:
+    params in the PIPELINED ``{embed, blocks, head}`` layout (leaves
+    stacked on the depth dim — what training saved), optimizer moments
+    mirroring it, host-side and meshless (the serve plane splits by
+    stage itself; it never builds the training mesh). The serve boot and
+    every hot reload load through this, the same
+    ``load_checkpoint``-onto-template validation as every other mode."""
+    import jax.numpy as jnp
+
+    from pytorch_distributed_mnist_tpu.train.state import (
+        TrainState,
+        make_optimizer,
+    )
+
+    params = split_vit_params(
+        model.init(rng, jnp.zeros((1, 28, 28, 1), jnp.float32)))
+    tx = make_optimizer()
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )
+
+
+def pipeline_engine_factory(*, model, model_name, params, devices, name,
+                            buckets, input_shape, serve_log, params_epoch,
+                            workers, apply_fn=None):
+    """The registry's engine hook (``serve/programs.py`` registers mode
+    ``pipeline`` with it): one pipeline CHAIN spanning ``devices``
+    (stage k on chip k). Needs the model CONFIG, not just an apply_fn —
+    the stage boundary cuts through the forward."""
+    del apply_fn  # the chain rebuilds the forward per stage
+    if model is None:
+        raise ValueError(
+            "--serve-mode pipeline needs the model object (stage "
+            f"programs are built from --model {model_name}'s structure, "
+            "not an apply_fn); pass model= to the pool")
+    return PipelineEngine(
+        model, params, devices, buckets=buckets, input_shape=input_shape,
+        serve_log=serve_log, params_epoch=params_epoch, name=name,
+        workers=workers)

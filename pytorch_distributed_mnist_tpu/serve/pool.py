@@ -193,6 +193,7 @@ class EnginePool:
         serve_mode: str = "replicated",
         mesh_size: int = 1,
         model_name: Optional[str] = None,
+        model=None,
         quarantine_after: int = 3,
         auto_regroup: bool = True,
         regroup_retries: int = 3,
@@ -209,6 +210,10 @@ class EnginePool:
         self.serve_mode = serve_mode
         self.mesh_size = mesh_size
         self.model_name = model_name
+        # The model CONFIG (not just its apply_fn): modes with a
+        # registry engine_factory — MPMD pipeline — build per-stage
+        # programs from the model's structure; SPMD modes ignore it.
+        self.model = model
         self.input_shape = tuple(input_shape)
         self.workers = workers
         self.n_devices = len(devices)
@@ -216,6 +221,14 @@ class EnginePool:
         self.auto_regroup = auto_regroup
         self.regroup_retries = regroup_retries
         self._buckets = tuple(buckets)
+        if serve_mode != "replicated":
+            from pytorch_distributed_mnist_tpu.serve.programs import (
+                staged_mode,
+            )
+
+            self.staged = staged_mode(serve_mode)
+        else:
+            self.staged = False
         self._injected_fault = _parse_serve_fault(
             os.environ.get(SERVE_FAULT_ENV, ""))
         self._lock = threading.Lock()
@@ -244,29 +257,37 @@ class EnginePool:
         two can never drift."""
         replicas: List[EngineReplica] = []
         if self.serve_mode != "replicated":
-            # Sharded plane: partition chips into mesh groups, one
-            # spanning engine per group (serve/programs.py owns the
-            # mesh/sharding derivation and every validity check).
+            # Sharded/staged plane: partition chips into mesh groups,
+            # one spanning engine per group. serve/programs.py owns the
+            # sharding derivation, every validity check, AND the engine
+            # construction (build_group_engine routes a registered
+            # engine_factory — MPMD pipeline — or the default
+            # MeshPlacement lowering), so the pool never special-cases a
+            # mode by name.
             from pytorch_distributed_mnist_tpu.serve.programs import (
-                build_group_placements,
+                build_group_engine,
+                group_name,
+                partition_groups,
+                validate_serve_mode,
             )
 
             if self.model_name is None:
                 raise ValueError(
                     f"serve_mode {self.serve_mode!r} needs model_name= "
                     f"(the mode's rule table is per model family)")
-            placements = build_group_placements(
-                self.serve_mode, self.model_name, devices, mesh_size,
-                params)
-            for i, placement in enumerate(placements):
-                engine = InferenceEngine(
-                    self.apply_fn, params, buckets=self._buckets,
+            validate_serve_mode(self.serve_mode, self.model_name,
+                                mesh_size, params)
+            groups = partition_groups(devices, mesh_size)
+            for i, group in enumerate(groups):
+                name = group_name(self.serve_mode, i, len(groups))
+                engine = build_group_engine(
+                    self.serve_mode, self.model_name, group, params, name,
+                    apply_fn=self.apply_fn, buckets=self._buckets,
                     input_shape=self.input_shape, serve_log=self.serve_log,
-                    params_epoch=params_epoch, placement=placement,
-                    name=placement.name, workers=self.workers)
+                    params_epoch=params_epoch, workers=self.workers,
+                    model=self.model)
                 replicas.append(EngineReplica(
-                    i, placement.devices[0], engine, name=placement.name,
-                    devices=placement.devices))
+                    i, group[0], engine, name=name, devices=group))
         else:
             if mesh_size != 1:
                 raise ValueError(
@@ -289,17 +310,15 @@ class EnginePool:
         /stats row stay attributable across rebuilds)."""
         if self.serve_mode != "replicated":
             from pytorch_distributed_mnist_tpu.serve.programs import (
-                build_placement,
+                build_group_engine,
             )
 
-            placement = build_placement(
+            return build_group_engine(
                 self.serve_mode, self.model_name, list(devices), params,
-                name=name)
-            return InferenceEngine(
-                self.apply_fn, params, buckets=self._buckets,
+                name, apply_fn=self.apply_fn, buckets=self._buckets,
                 input_shape=self.input_shape, serve_log=self.serve_log,
-                params_epoch=params_epoch, placement=placement,
-                name=name, workers=self.workers)
+                params_epoch=params_epoch, workers=self.workers,
+                model=self.model)
         return InferenceEngine(
             self.apply_fn, params, buckets=self._buckets,
             input_shape=self.input_shape, serve_log=self.serve_log,
@@ -667,7 +686,7 @@ class EnginePool:
 
     def _topology_locked(self) -> dict:
         quarantined = [r.name for r in self.replicas if r.quarantined]
-        return {
+        topo = {
             "topology_generation": self._topology_generation,
             "serve_mode": self.serve_mode,
             "serve_devices": self.n_devices,
@@ -678,6 +697,12 @@ class EnginePool:
             "regroups": self._regroups,
             "failovers": self._failovers,
         }
+        if self.staged:
+            # A staged group's mesh axis is a pipeline CHAIN of this
+            # many per-chip stage programs; /stats surfaces it as
+            # pipeline_stages and loadgen --expect-stages asserts it.
+            topo["pipeline_stages"] = self.mesh_size
+        return topo
 
     def topology(self) -> dict:
         """The pool's shape + self-healing counters — the ``/stats``
@@ -704,6 +729,9 @@ class EnginePool:
                 if sharded:
                     row["mode"] = self.serve_mode
                     row["devices"] = [str(d) for d in r.devices]
+                    if self.staged:
+                        # A staged group is a CHAIN: stage k on chip k.
+                        row["stages"] = len(r.devices)
                 if r.quarantined:
                     row["quarantined"] = True
                 if r.generation:

@@ -48,6 +48,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_mnist_tpu.parallel.expert import moe_ep_rules
+from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+    pipeline_stage_rules,
+)
 from pytorch_distributed_mnist_tpu.parallel.tensor import leaf_spec, vit_tp_rules
 
 REPLICATED = "replicated"
@@ -57,13 +60,38 @@ class ServeMode:
     """One registered parallel serving mode: the mesh axis it shards
     over and, per model family, the rule table deriving every param
     leaf's ``PartitionSpec`` (the SAME table training's state sharding
-    uses — ``parallel/tensor.py`` / ``parallel/expert.py``)."""
+    uses — ``parallel/tensor.py`` / ``parallel/expert.py``).
+
+    Three optional hooks extend the registry beyond the one-pjit-over-
+    the-mesh (SPMD) lowering, so a mode whose programs are NOT one mesh
+    program — MPMD pipeline serving (``serve/pipeline.py``) compiles one
+    independent program PER chip — still rides every generic path
+    (layout gate, divisibility walk, pool groups, ``/stats``, bench)
+    without special-casing:
+
+    - ``engine_factory``: builds the group's engine instead of the
+      default ``MeshPlacement`` + ``InferenceEngine`` pair
+      (:func:`build_group_engine` routes).
+    - ``make_template(model, rng) -> TrainState``: the template state
+      checkpoints restore onto, for modes whose TRAINING param layout is
+      not the standard flax tree (pipeline's ``{embed, blocks, head}``).
+    - ``staged``: the mode's mesh axis is a PIPELINE of stages, not a
+      spanning shard — the auto in-flight window sizes per CHIP (the
+      pipe needs >= stages batches to fill) and ``/stats`` reports
+      ``pipeline_stages``.
+    """
 
     def __init__(self, name: str, axis: str,
-                 rules_by_model: Dict[str, Callable]) -> None:
+                 rules_by_model: Dict[str, Callable],
+                 engine_factory: Optional[Callable] = None,
+                 make_template: Optional[Callable] = None,
+                 staged: bool = False) -> None:
         self.name = name
         self.axis = axis
         self.rules_by_model = dict(rules_by_model)
+        self.engine_factory = engine_factory
+        self.make_template = make_template
+        self.staged = staged
 
     def rules_for(self, model_name: str):
         try:
@@ -81,13 +109,19 @@ _MODES: Dict[str, ServeMode] = {}
 
 
 def register_serve_mode(name: str, axis: str,
-                        rules_by_model: Dict[str, Callable]) -> ServeMode:
+                        rules_by_model: Dict[str, Callable],
+                        engine_factory: Optional[Callable] = None,
+                        make_template: Optional[Callable] = None,
+                        staged: bool = False) -> ServeMode:
     """Register a parallel serving mode (the extension point: a new
     parallel module's rule table becomes servable by adding one entry,
-    no engine/pool/server change)."""
+    no engine/pool/server change). See :class:`ServeMode` for the
+    optional hooks non-SPMD modes use."""
     if name == REPLICATED or name in _MODES:
         raise ValueError(f"serve mode {name!r} already registered")
-    mode = ServeMode(name, axis, rules_by_model)
+    mode = ServeMode(name, axis, rules_by_model,
+                     engine_factory=engine_factory,
+                     make_template=make_template, staged=staged)
     _MODES[name] = mode
     return mode
 
@@ -101,10 +135,38 @@ def serve_modes() -> List[str]:
     return [REPLICATED] + sorted(_MODES)
 
 
-# Import-time snapshot for docs/tests; anything validating a mode must
-# call serve_modes()/_get_mode (the live registry) so modes registered
-# after import — the extension seam — are honored.
-SERVE_MODES = serve_modes()
+def get_serve_mode(mode: str) -> ServeMode:
+    """The registered :class:`ServeMode` for ``mode`` (raises with the
+    registry's vocabulary for unknown names; ``replicated`` has no
+    ServeMode object and is rejected here too — callers branch on it
+    BEFORE reaching for mode hooks)."""
+    return _get_mode(mode)
+
+
+def staged_mode(mode: str) -> bool:
+    """Whether ``mode`` is a registered STAGED (pipeline-of-programs)
+    mode — the ``/stats`` ``pipeline_stages`` field and the per-chip
+    auto-window read this; replicated and unknown names are simply not
+    staged."""
+    spec = _MODES.get(mode)
+    return spec is not None and spec.staged
+
+
+def make_serve_template(mode: str, model, rng):
+    """The template STATE checkpoints restore onto under ``mode``.
+
+    Modes whose TRAINING param layout is not the standard flax tree
+    (pipeline's stage-stacked ``{embed, blocks, head}``) override via
+    the registry's ``make_template`` hook; everything else — replicated
+    included — uses the standard ``create_train_state`` template, byte
+    for byte the pre-registry boot path."""
+    if mode != REPLICATED:
+        spec = _get_mode(mode)
+        if spec.make_template is not None:
+            return spec.make_template(model, rng)
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+
+    return create_train_state(model, rng)
 
 
 def registered_mode_models() -> List[tuple]:
@@ -257,12 +319,10 @@ def _device_array(devices):
     return np.asarray(devices, dtype=object).reshape(len(devices))
 
 
-def build_group_placements(mode: str, model_name: str, devices: Sequence,
-                           mesh_size: int, params) -> List[MeshPlacement]:
-    """Partition ``devices`` into ``mesh_size``-chip groups, one
-    :class:`MeshPlacement` per group — the pool's sharded plane: a
-    sharded engine SPANS its mesh, so an 8-chip host at mesh 2 runs 4
-    two-chip engines, not 8 one-chip replicas."""
+def partition_groups(devices: Sequence, mesh_size: int) -> List[list]:
+    """Partition ``devices`` into ``mesh_size``-chip groups (the pool's
+    sharded/staged plane: one spanning engine per group), rejecting
+    indivisible shapes with flag language."""
     devices = list(devices)
     if mesh_size < 1:
         raise ValueError(f"mesh size must be >= 1, got {mesh_size}")
@@ -272,14 +332,57 @@ def build_group_placements(mode: str, model_name: str, devices: Sequence,
             f"{mesh_size}-device mesh groups; --serve-mesh must divide "
             f"--serve-devices"
         )
-    groups = [devices[i:i + mesh_size]
-              for i in range(0, len(devices), mesh_size)]
-    single = len(groups) == 1
+    return [devices[i:i + mesh_size]
+            for i in range(0, len(devices), mesh_size)]
+
+
+def group_name(mode: str, index: int, n_groups: int) -> str:
+    """One group's engine/CompileLog name: the bare mode when a single
+    group spans the whole pool, ``{mode}.g{i}`` otherwise — so compile
+    stats and the zero-recompile verdicts stay attributable per group
+    (and, for staged modes, per stage under ``{name}.s{k}``)."""
+    return mode if n_groups == 1 else f"{mode}.g{index}"
+
+
+def build_group_placements(mode: str, model_name: str, devices: Sequence,
+                           mesh_size: int, params) -> List[MeshPlacement]:
+    """Partition ``devices`` into ``mesh_size``-chip groups, one
+    :class:`MeshPlacement` per group — the pool's sharded plane: a
+    sharded engine SPANS its mesh, so an 8-chip host at mesh 2 runs 4
+    two-chip engines, not 8 one-chip replicas."""
+    groups = partition_groups(devices, mesh_size)
     return [
         build_placement(mode, model_name, group, params,
-                        name=mode if single else f"{mode}.g{i}")
+                        name=group_name(mode, i, len(groups)))
         for i, group in enumerate(groups)
     ]
+
+
+def build_group_engine(mode: str, model_name: str, devices: Sequence,
+                       params, name: str, *, apply_fn, buckets,
+                       input_shape, serve_log, params_epoch, workers,
+                       model=None):
+    """ONE engine spanning ``devices`` for ``mode`` — the single builder
+    the pool's boot, regroup, and resize paths all share, which is what
+    keeps a registered mode's engine construction from drifting between
+    them. SPMD modes get the default ``MeshPlacement`` +
+    ``InferenceEngine`` lowering; a mode with an ``engine_factory``
+    (MPMD pipeline) builds its own engine behind the same surface."""
+    spec = _get_mode(mode)
+    if spec.engine_factory is not None:
+        return spec.engine_factory(
+            model=model, model_name=model_name, apply_fn=apply_fn,
+            params=params, devices=list(devices), name=name,
+            buckets=buckets, input_shape=input_shape, serve_log=serve_log,
+            params_epoch=params_epoch, workers=workers)
+    from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+
+    placement = build_placement(mode, model_name, list(devices), params,
+                                name=name)
+    return InferenceEngine(
+        apply_fn, params, buckets=buckets, input_shape=input_shape,
+        serve_log=serve_log, params_epoch=params_epoch,
+        placement=placement, name=name, workers=workers)
 
 
 def check_checkpoint_layout(layout: Optional[dict], mode: str,
@@ -296,13 +399,16 @@ def check_checkpoint_layout(layout: Optional[dict], mode: str,
     passes: no provenance, nothing to contradict.
 
     Sequence parallelism is activation-only (identical params), so it
-    never constrains serving; pipeline-trained checkpoints have a
-    stage-stacked param tree no serving template matches, so they are
-    rejected by name rather than by a leaf-count load error.
+    never constrains serving. Pipeline-trained checkpoints — whose
+    stage-stacked param tree no SPMD serving template matches, and which
+    PR 8 therefore rejected by name — now name ``--serve-mode pipeline``
+    as the valid choice: the MPMD plane (``serve/pipeline.py``) restores
+    onto the pipelined template and splits by stage itself.
     """
     if not layout:
         return
-    trained_axis = {"tensor": "tensor", "expert": "expert"}
+    trained_axis = {"tensor": "tensor", "expert": "expert",
+                    "pipeline": "pipeline"}
     for key, want_mode in trained_axis.items():
         if int(layout.get(key, 1)) > 1 and mode != want_mode:
             raise ValueError(
@@ -311,9 +417,37 @@ def check_checkpoint_layout(layout: Optional[dict], mode: str,
                 f"(valid modes for --model {model_name}: "
                 f"{servable_modes(model_name)})"
             )
-    if int(layout.get("pipeline", 1)) > 1:
-        raise ValueError(
-            "checkpoint was trained with pipeline parallelism; no serve "
-            f"mode lowers a stage-stacked param tree (valid modes for "
-            f"--model {model_name}: {servable_modes(model_name)})"
-        )
+
+
+# MODE: pipeline (MPMD, serve/pipeline.py). Registered HERE like every
+# built-in mode so the registry is complete whenever it is importable —
+# regardless of whether anything imported serve.pipeline first — with
+# the heavy hooks imported lazily on first USE (an engine build / a
+# template make), not at registry import.
+def _pipeline_factory(**kwargs):
+    from pytorch_distributed_mnist_tpu.serve.pipeline import (
+        pipeline_engine_factory,
+    )
+
+    return pipeline_engine_factory(**kwargs)
+
+
+def _pipeline_template(model, rng):
+    from pytorch_distributed_mnist_tpu.serve.pipeline import (
+        make_pipeline_template,
+    )
+
+    return make_pipeline_template(model, rng)
+
+
+register_serve_mode(
+    "pipeline", "stage", {"vit": pipeline_stage_rules},
+    engine_factory=_pipeline_factory,
+    make_template=_pipeline_template,
+    staged=True,
+)
+
+# Import-time snapshot for docs/tests; anything validating a mode must
+# call serve_modes()/_get_mode (the live registry) so modes registered
+# after import — the extension seam — are honored.
+SERVE_MODES = serve_modes()

@@ -12,10 +12,14 @@ checkpoint directory with no coordination channel beyond the filesystem.
 The watcher polls on its own daemon thread, loads through the SAME
 ``load_checkpoint``-onto-template path resume uses (shape/leaf-count
 validation included — a checkpoint from a different model aborts the
-reload, not the server), and installs params via
-``engine.swap_params``-style callback: an atomic reference swap, so the
-in-flight batch finishes on the old params and the next batch sees the
-new ones. Failures are contained: a corrupt or vanished checkpoint is
+reload, not the server; the template itself is per serve mode, so a
+pipeline server restores onto the stage-stacked tree), and installs
+params via ``engine.swap_params``-style callback: an atomic reference
+swap, so the in-flight batch finishes on the old params and the next
+batch sees the new ones. The callback owns whatever fan-out the data
+plane needs — per replica on a pool, per STAGE inside an MPMD pipeline
+chain (``serve/pipeline.py`` splits and installs all stages under one
+lock, so a batch never spans two epochs across stages). Failures are contained: a corrupt or vanished checkpoint is
 recorded (``serve_reload_failed`` in the stats/JSONL stream) and the
 server keeps answering on the params it has — serving availability never
 depends on the newest file being readable.

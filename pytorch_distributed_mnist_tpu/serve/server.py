@@ -106,15 +106,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "the whole model per chip (default, every model); "
                         "'tensor' Megatron-shards the ViT weights over a "
                         "mesh (parallel/tensor.py rules); 'expert' shards "
-                        "moe_mlp experts (parallel/expert.py). Sharded "
-                        "modes lower one pjit program per bucket over the "
-                        "mesh — same AOT/zero-recompile/hot-reload "
-                        "contract (serve/programs.py)")
+                        "moe_mlp experts (parallel/expert.py); 'pipeline' "
+                        "compiles one INDEPENDENT program per stage chip "
+                        "and streams batches between them (MPMD, "
+                        "serve/pipeline.py — the mode pipeline-trained "
+                        "checkpoints serve under). All share the "
+                        "AOT/zero-recompile/hot-reload contract")
     p.add_argument("--serve-mesh", type=int, default=0,
-                   help="devices per serving mesh for sharded modes (0 = "
-                        "all --serve-devices chips in ONE mesh). Must "
-                        "divide --serve-devices; the pool then runs one "
-                        "spanning engine per mesh group. Ignored (must be "
+                   help="devices per serving mesh group for sharded "
+                        "modes — for --serve-mode pipeline, the STAGE "
+                        "count per chain — (0 = all --serve-devices "
+                        "chips in ONE group). Must divide "
+                        "--serve-devices; the pool then runs one "
+                        "spanning engine per group. Ignored (must be "
                         "left 0) in replicated mode")
     p.add_argument("--quarantine-after", type=int, default=3,
                    help="serve-pool self-healing threshold: this many "
@@ -296,6 +300,10 @@ class _Handler(BaseHTTPRequestHandler):
                     # these.
                     stats["mesh_devices"] = ctx.pool.mesh_size
                     stats["mesh_groups"] = ctx.pool.n_replicas
+                if "pipeline_stages" in topo:
+                    # Staged (pipeline) modes: chips per chain — what
+                    # loadgen --expect-stages asserts.
+                    stats["pipeline_stages"] = topo["pipeline_stages"]
             self._reply(200, stats)
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
@@ -444,7 +452,6 @@ def create_server(args) -> ThreadingHTTPServer:
     from pytorch_distributed_mnist_tpu.train.checkpoint import (
         _epoch_checkpoints,
     )
-    from pytorch_distributed_mnist_tpu.train.state import create_train_state
     from pytorch_distributed_mnist_tpu.utils import compile_cache
 
     if args.model not in list_models():
@@ -461,18 +468,21 @@ def create_server(args) -> ThreadingHTTPServer:
         model_kwargs["compute_dtype"] = {
             "bf16": jnp.bfloat16, "f32": jnp.float32}[args.dtype]
     model = get_model(args.model, **model_kwargs)
-    template = create_train_state(model, jax.random.key(args.seed))
 
     # Data-plane shape: --serve-devices chips (0 = all local devices),
     # --serve-mode deciding how a forward spans them (replicated per
-    # chip, or tensor/expert-sharded over --serve-mesh-chip groups),
-    # with a --max-inflight pipelined dispatch window (0 = auto). The
-    # default (replicated, 1 device, window 1) is the single-device
-    # plane, built exactly as it always was. Resolved BEFORE the boot
-    # restore so the checkpoint walk can apply the layout gate per
-    # candidate.
+    # chip, tensor/expert-sharded over --serve-mesh-chip groups, or a
+    # pipeline of per-chip stage programs), with a --max-inflight
+    # pipelined dispatch window (0 = auto). The default (replicated, 1
+    # device, window 1) is the single-device plane, built exactly as it
+    # always was. Resolved BEFORE the template and the boot restore: the
+    # template's param LAYOUT is per mode (pipeline restores onto the
+    # stage-stacked tree), and the checkpoint walk applies the layout
+    # gate per candidate.
     from pytorch_distributed_mnist_tpu.serve.programs import (
         check_checkpoint_layout,
+        make_serve_template,
+        staged_mode,
         validate_serve_mode,
     )
     from pytorch_distributed_mnist_tpu.train.checkpoint import (
@@ -491,6 +501,19 @@ def create_server(args) -> ThreadingHTTPServer:
     serve_mode = getattr(args, "serve_mode", "replicated")
     serve_mesh = getattr(args, "serve_mesh", 0)
     sharded = serve_mode != "replicated"
+    if sharded:
+        try:
+            # The mode/model PAIR check (mode registered, rule table for
+            # this model) must precede the template build: a mode's
+            # make_template hook assumes its model family (pipeline
+            # splits block layers), so an unservable pair has to die
+            # with flag language HERE, not a traceback in there. The
+            # full check with the real mesh and params runs below.
+            validate_serve_mode(serve_mode, args.model, 1)
+        except ValueError as exc:
+            raise SystemExit(str(exc)) from None
+    template = make_serve_template(serve_mode, model,
+                                   jax.random.key(args.seed))
     mesh_size = 1
     if sharded:
         mesh_size = serve_mesh or n_devices
@@ -589,8 +612,13 @@ def create_server(args) -> ThreadingHTTPServer:
     if max_inflight == 0:
         # Auto window: one in-flight batch per engine plus one forming.
         # A single sharded group still defaults to 2 — host staging of
-        # batch N+1 overlaps the mesh executing batch N.
-        if sharded:
+        # batch N+1 overlaps the mesh executing batch N. A STAGED mode's
+        # group is a pipeline of per-chip programs, so its window sizes
+        # per CHIP (stages x groups + 1): the pipe needs >= stages
+        # batches in flight before every stage chip is busy.
+        if sharded and staged_mode(serve_mode):
+            max_inflight = n_devices + 1
+        elif sharded:
             max_inflight = n_groups + 1
         else:
             max_inflight = n_devices + 1 if n_devices > 1 else 1
@@ -615,7 +643,7 @@ def create_server(args) -> ThreadingHTTPServer:
             buckets=_parse_buckets(args.buckets), serve_log=serve_log,
             params_epoch=epoch, workers=getattr(args, "workers", 4),
             serve_mode=serve_mode, mesh_size=mesh_size,
-            model_name=args.model,
+            model_name=args.model, model=model,
             quarantine_after=getattr(args, "quarantine_after", 3),
         )
         engine = pool
@@ -647,7 +675,12 @@ def create_server(args) -> ThreadingHTTPServer:
     stats = compile_log.stats()["programs"]
     compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
                       if name.startswith("serve_forward_"))
-    if sharded:
+    if sharded and staged_mode(serve_mode):
+        plane = (f"MPMD {serve_mode}: {n_groups} chain(s) x "
+                 f"{mesh_size} per-chip stage programs x "
+                 f"{len(engine.buckets)} buckets, in-flight window "
+                 f"{max_inflight}")
+    elif sharded:
         plane = (f"{serve_mode}-sharded: {n_groups} mesh group(s) x "
                  f"{mesh_size} chips x {len(engine.buckets)} buckets, "
                  f"in-flight window {max_inflight}")

@@ -198,6 +198,29 @@ def comm_overlap_fraction(step_ms: float, compute_ms: float,
     return round(max(0.0, min(1.0, 1.0 - exposed / float(comm_ms))), 4)
 
 
+def stage_occupancy(stage_step_ms: dict) -> dict:
+    """Per-stage occupancy of a streamed pipeline under full overlap:
+    each stage's synchronous step wall over the BOTTLENECK stage's.
+
+    A filled pipe retires one micro-batch per bottleneck-stage wall, so
+    the slowest stage reads 1.0 (always busy) and every other stage is
+    busy exactly its own wall's share of that clock and idles the rest —
+    the imbalance this reports is the capacity a stage re-balancer
+    (ROADMAP item 3) would recover. Empty/zero inputs return ``{}``:
+    occupancy of a pipe that does no work is not 1.0.
+
+    Used by ``bench.py --mode serve``'s ``pipeline_serving`` block;
+    unit-pinned in ``tests/test_serve_mpmd.py``.
+    """
+    if not stage_step_ms:
+        return {}
+    slowest = max(float(v) for v in stage_step_ms.values())
+    if slowest <= 0:
+        return {}
+    return {name: round(float(ms) / slowest, 4)
+            for name, ms in stage_step_ms.items()}
+
+
 class CompileLog:
     """Per-program compile observability: wall ms, XLA backend compiles,
     and persistent-cache hit/miss, attributed to named programs.

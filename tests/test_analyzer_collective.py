@@ -558,3 +558,41 @@ def note_rebuilt_world(old_members, new_members):
     return record_world_shrunk(old_members, new_members, 1)
 """
     assert _findings(src) == []
+
+
+# -- MPMD pipeline-serving shapes (serve/pipeline.py, ISSUE 12) --------------
+
+
+def test_fires_on_stage_split_agreement_under_process_index():
+    """FIRING: a per-stage param split done only on one host, with the
+    layout agreement inside the branch — every other host skips the
+    collective and the split worlds hang."""
+    src = """
+from pytorch_distributed_mnist_tpu.runtime import supervision
+from pytorch_distributed_mnist_tpu.parallel.distributed import process_index
+
+def install_stage_params(params, n_stages):
+    if process_index() == 0:
+        stages = [slice_stage(params, s) for s in range(n_stages)]
+        supervision.agree("stage_split_ok")
+        return stages
+"""
+    findings = _findings(src)
+    assert findings and any("host-dependent" in f.message
+                            for f in findings)
+
+
+def test_silent_on_symmetric_stage_split_then_agreement():
+    """NON-FIRING twin: every host splits identically (host-local array
+    slicing, no rank in sight) and the agreement runs unconditionally —
+    the shipped serve-plane shape, where the split is per-chip work and
+    nothing is process_index-conditioned."""
+    src = """
+from pytorch_distributed_mnist_tpu.runtime import supervision
+
+def install_stage_params(params, n_stages):
+    stages = [slice_stage(params, s) for s in range(n_stages)]
+    supervision.agree("stage_split_ok")
+    return stages
+"""
+    assert _findings(src) == []

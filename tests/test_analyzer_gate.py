@@ -141,8 +141,10 @@ def test_cli_entry_point_exits_zero_and_emits_schema_json():
     # (ISSUE 5 acceptance).
     graph = payload["reports"]["lock-discipline"]["lock_graph"]
     engine = graph["pytorch_distributed_mnist_tpu/serve/engine.py"]
+    # The staging free-list lock lives on the shared StagingPool since
+    # ISSUE 12 (the MPMD plane reuses the same lifecycle).
     assert set(engine["locks"]) == {"InferenceEngine._lock",
-                                    "InferenceEngine._staging_lock"}
+                                    "StagingPool._lock"}
     pool = graph["pytorch_distributed_mnist_tpu/serve/pool.py"]
     assert pool["locks"] == ["EnginePool._lock"]
 

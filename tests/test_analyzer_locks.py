@@ -431,9 +431,12 @@ def test_reports_engine_and_pool_lock_graph():
     assert result.findings == []  # the serve plane is lock-clean
     graph = result.reports["lock-discipline"]["lock_graph"]
     engine = graph["pytorch_distributed_mnist_tpu/serve/engine.py"]
+    # The staging free-list lock moved into the shared StagingPool
+    # (ISSUE 12: the MPMD plane reuses the same lifecycle); the params
+    # lock stays on the engine.
     assert set(engine["locks"]) == {"InferenceEngine._lock",
-                                    "InferenceEngine._staging_lock"}
-    # The two engine locks are never nested — that IS the discipline.
+                                    "StagingPool._lock"}
+    # The two locks are never nested — that IS the discipline.
     assert engine["order_edges"] == []
     pool = graph["pytorch_distributed_mnist_tpu/serve/pool.py"]
     assert pool["locks"] == ["EnginePool._lock"]
@@ -707,3 +710,67 @@ def test_elastic_module_clean_and_lock_free():
     elastic_graph = graph.get(
         "pytorch_distributed_mnist_tpu/runtime/elastic.py", {})
     assert elastic_graph.get("locks", []) == []
+
+
+# -- MPMD pipeline-serving shapes (serve/pipeline.py, ISSUE 12) --------------
+
+
+def test_fires_on_stage_stream_dispatch_under_engine_lock():
+    """FIRING: streaming a micro-batch to the next stage (the D2D
+    device_put hop + the stage program call) while still holding the
+    engine lock — the whole chain's device work would serialize behind
+    every params capture and swap."""
+    src = """
+import threading, jax
+
+class PipelineEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def dispatch(self, x):
+        with self._lock:
+            for stage in self._stages:
+                x = jax.device_put(x, stage.sharding)
+                x = stage.run(self._stage_params[stage.index], x)
+        return x
+"""
+    findings = _findings(src)
+    assert findings and any("device_put" in f.message
+                            and "PipelineEngine._lock" in f.message
+                            for f in findings)
+
+
+def test_silent_on_stage_params_snapshot_then_stream():
+    """NON-FIRING twin: the shipped shape — capture the per-stage params
+    list (the cross-stage swap-atomicity boundary) under the lock, then
+    stream the chain entirely outside it."""
+    src = """
+import threading, jax
+
+class PipelineEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def dispatch(self, x):
+        with self._lock:
+            stage_params = list(self._stage_params)
+        for stage, params in zip(self._stages, stage_params):
+            x = jax.device_put(x, stage.sharding)
+            x = stage.run(params, x)
+        return x
+"""
+    assert _findings(src) == []
+
+
+def test_pipeline_module_clean_and_in_lock_graph():
+    """serve/pipeline.py itself: its engine lock shows up in the lock
+    graph (it IS a lock-holding module) with zero findings — the
+    snapshot-then-stream discipline the fixtures above pin."""
+    path = os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve",
+                        "pipeline.py")
+    result = run_analysis([path], checkers=["lock-discipline"],
+                          baseline=None)
+    assert result.findings == []
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    module = graph["pytorch_distributed_mnist_tpu/serve/pipeline.py"]
+    assert "PipelineEngine._lock" in module["locks"]

@@ -86,3 +86,28 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
     # Per bucket x mode compile rows landed under the @{mode} names.
     assert any("@tensor" in name for name in programs)
     assert any("@expert" in name for name in programs)
+
+    # The MPMD pipeline block (ISSUE 12): one chain of per-chip stage
+    # programs, the window>=stages vs window-1 stage-overlap speedup
+    # (ABBA pairs), per-stage step walls + occupancy with the bottleneck
+    # stage at 1.0, and the per bucket x stage zero-recompile verdict.
+    # The engine-factory mode must NOT appear in the SPMD sharded block.
+    assert "pipeline" not in sharded
+    pp = report["pipeline_serving"]
+    assert pp["model"] == "vit" and pp["stages"] == 2
+    assert pp["window"] == 3 and pp["chains"] == 1
+    assert isinstance(pp["stage_overlap_speedup"], (int, float))
+    assert pp["stage_overlap_speedup"] > 0
+    assert len(pp["pairs"]) == 5
+    assert pp["requests_per_sec"] > 0
+    assert sorted(pp["stage_step_ms"]) == ["s0", "s1"]
+    occ = pp["stage_occupancy"]
+    assert sorted(occ) == ["s0", "s1"] and max(occ.values()) == 1.0
+    assert pp["zero_steady_state_recompiles"] is True
+    # This CPU run must carry the BENCH_r05-style fallback caveat:
+    # host-thread transfers say nothing about ICI.
+    assert "CPU fallback" in pp["caveat"]
+    assert "nothing about ICI" in pp["caveat"]
+    # Per bucket x stage compile rows landed under the @pipeline names.
+    assert any("@pipeline.s0" in name for name in programs)
+    assert any("@pipeline.s1" in name for name in programs)

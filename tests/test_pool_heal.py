@@ -364,3 +364,119 @@ def test_injected_fault_fires_quarantines_and_heals(
         _serve_ok(pool, model, state, images)
     assert pool.replicas[0].dispatched > dispatched
     assert pool.topology()["quarantined_groups"] == []
+
+
+# -- MPMD pipeline chains (ISSUE 12): a dead stage condemns the chain --------
+
+
+@pytest.fixture(scope="module")
+def pipeline_setup():
+    from pytorch_distributed_mnist_tpu.serve.pipeline import (
+        make_pipeline_template,
+    )
+
+    model = get_model("vit", compute_dtype=jnp.float32)
+    template = make_pipeline_template(model, jax.random.key(0))
+    images, _ = synthetic_dataset(64, seed=6)
+    return model, template, images
+
+
+def _pipeline_pool(model, template, **kwargs):
+    pool = EnginePool(model.apply, template.params,
+                      devices=jax.local_devices()[:4], buckets=(8,),
+                      params_epoch=1, serve_mode="pipeline", mesh_size=2,
+                      model_name="vit", model=model, **kwargs)
+    pool.warmup()
+    return pool
+
+
+def _pipeline_serve_ok(pool, model, template, images):
+    from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+        merge_vit_params,
+    )
+
+    labels, _ = pool.predict_complete(pool.dispatch(
+        pool.preprocess(images[:8])))
+    want = np.argmax(np.asarray(model.apply(
+        merge_vit_params(template.params),
+        jnp.asarray(normalize_images(images[:8])), train=False)), axis=-1)
+    np.testing.assert_array_equal(labels, want)
+
+
+def test_dead_stage_quarantines_whole_pipeline_chain(pipeline_setup):
+    """FIRING: one stage dying mid-chain fails the whole chain's
+    dispatch — a pipeline with a missing stage can serve nothing, so
+    the quarantine takes ALL of the chain's chips out of dispatch at
+    once (both stage chips idle, not just the dead one), while requests
+    fail over whole to the healthy chain."""
+    model, template, images = pipeline_setup
+    pool = _pipeline_pool(model, template, quarantine_after=2,
+                          auto_regroup=False)
+    g0 = pool.replicas[0]
+    assert len(g0.devices) == 2  # the chain spans both stage chips
+    g0.engine = _SabotagedEngine(g0.engine, fail_dispatch=True)
+    for _ in range(4):
+        _pipeline_serve_ok(pool, model, template, images)
+    topo = pool.topology()
+    assert topo["quarantined_groups"] == ["pipeline.g0"]
+    assert topo["active_groups"] == 1 and topo["pipeline_stages"] == 2
+    # The WHOLE chain is out: no dispatch touches either of its chips.
+    dispatched_before = g0.dispatched
+    _pipeline_serve_ok(pool, model, template, images)
+    assert g0.dispatched == dispatched_before
+    snap = pool.snapshot()
+    assert snap["pipeline.g0"]["quarantined"] is True
+    assert snap["pipeline.g0"]["stages"] == 2
+    assert "quarantined" not in snap["pipeline.g1"]
+
+
+def test_input_error_does_not_quarantine_pipeline_chain(pipeline_setup):
+    """NON-FIRING twin: request-shaped errors (ValueError off a
+    malformed stack) are the request's fault — they neither count
+    toward the chain's quarantine threshold nor fail over."""
+    model, template, images = pipeline_setup
+    pool = _pipeline_pool(model, template, quarantine_after=1,
+                          auto_regroup=False)
+    for _ in range(3):
+        with pytest.raises(ValueError):
+            pool.dispatch(np.zeros((4, 3, 3, 1), np.float32))
+    topo = pool.topology()
+    assert topo["quarantined_groups"] == [] and topo["active_groups"] == 2
+    assert all(r.failures == 0 for r in pool.replicas)
+    _pipeline_serve_ok(pool, model, template, images)
+
+
+def test_regroup_rebuilds_all_stages_of_pipeline_chain(pipeline_setup):
+    """The heal path end to end on the MPMD plane: the quarantined
+    chain's background regroup rebuilds EVERY stage program from the
+    chain's own chips (a fresh PipelineEngine, generation bumped), the
+    rebuilt chain rejoins dispatch serving exact answers, and a reload
+    that landed mid-rebuild is caught up on every stage."""
+    from pytorch_distributed_mnist_tpu.serve.pipeline import (
+        PipelineEngine,
+        make_pipeline_template,
+    )
+
+    model, template, images = pipeline_setup
+    newer = make_pipeline_template(model, jax.random.key(9))
+    pool = _pipeline_pool(model, template, quarantine_after=1)
+    g0 = pool.replicas[0]
+    g0.engine = _SabotagedEngine(g0.engine, fail_dispatch=True)
+    _pipeline_serve_ok(pool, model, template, images)  # -> quarantine
+    # A fleet reload lands while the chain rebuilds (skips quarantined).
+    assert pool.swap_params(newer.params, epoch=5) == 1
+    _wait_healed(pool)
+    assert g0.generation == 1
+    assert isinstance(g0.engine, PipelineEngine)  # a real all-stage rebuild
+    assert g0.engine.stage_names() == ["pipeline.g0.s0", "pipeline.g0.s1"]
+    # The mid-rebuild reload catches up AFTER the install (the regroup's
+    # stale-rejecting swap runs post-install, so topology reads healed a
+    # beat before the epoch lands): poll, don't race it.
+    deadline = time.monotonic() + 30.0
+    while g0.engine.params_epoch != 5 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert g0.engine.params_epoch == 5  # the mid-rebuild reload caught up
+    labels, epoch = pool.predict_complete(pool.dispatch(
+        pool.preprocess(images[:8])))
+    assert epoch == 5
+    _pipeline_serve_ok(pool, model, newer, images)

@@ -398,3 +398,135 @@ def test_layout_mismatched_newest_falls_back_to_older_epoch(tmp_path):
         assert reply["predictions"] == [int(v) for v in want]
     finally:
         srv.close()
+
+
+# -- MPMD pipeline serving (ISSUE 12) ----------------------------------------
+
+
+def _publish_pipeline(ckpt_dir, epoch, seed, stages=2):
+    """A pipeline-trained checkpoint: the stage-stacked {embed, blocks,
+    head} param layout plus the pipeline parallel_layout stamp — what a
+    --pipeline-stages training run publishes."""
+    from pytorch_distributed_mnist_tpu.serve.pipeline import (
+        make_pipeline_template,
+    )
+
+    model = get_model("vit", compute_dtype=jnp.float32)
+    state = make_pipeline_template(model, jax.random.key(seed))
+    save_checkpoint(state, epoch=epoch, best_acc=0.5, is_best=False,
+                    directory=str(ckpt_dir), process_index=0,
+                    parallel_layout={"pipeline": stages})
+    return model, state
+
+
+def _pipeline_direct_labels(model, state, images):
+    from pytorch_distributed_mnist_tpu.parallel.pipeline_vit import (
+        merge_vit_params,
+    )
+
+    return np.argmax(np.asarray(model.apply(
+        merge_vit_params(state.params),
+        jnp.asarray(normalize_images(images)), train=False)), axis=-1)
+
+
+def test_pipeline_server_loadgen_smoke_expect_stages(tmp_path):
+    """The ISSUE 12 acceptance run: a pipeline-trained ViT checkpoint
+    boots under ``serve --serve-mode pipeline`` (2 per-chip stage
+    programs), answers /predict with predictions pinned to the
+    single-device forward, /stats carries pipeline_stages, and loadgen's
+    ``--smoke --expect-mode pipeline --expect-stages 2`` gate passes
+    with zero steady-state recompiles per bucket x stage."""
+    ckpt = tmp_path / "ckpt"
+    model, state = _publish_pipeline(ckpt, epoch=0, seed=10)
+    srv = _Server(_serve_args(ckpt, model="vit", buckets="1,8",
+                              serve_devices=2, serve_mode="pipeline",
+                              serve_mesh=2))
+    try:
+        images, _ = synthetic_dataset(5, seed=0)
+        reply = srv.post("/predict", {"images": images.tolist()})
+        want = _pipeline_direct_labels(model, state, images)
+        assert reply["predictions"] == [int(v) for v in want]
+        assert reply["model_epoch"] == 0
+
+        stats = srv.get("/stats")
+        assert stats["serve_mode"] == "pipeline"
+        assert stats["serve_devices"] == 2
+        assert stats["mesh_devices"] == 2 and stats["mesh_groups"] == 1
+        assert stats["pipeline_stages"] == 2
+        row = stats["replicas"]["pipeline"]
+        assert row["mode"] == "pipeline" and row["stages"] == 2
+
+        programs = compile_log.stats()["programs"]
+        names = {f"serve_forward_b{b}@pipeline.s{k}"
+                 for b in (1, 8) for k in (0, 1)}
+        assert names <= set(programs)
+        before = {n: programs[n]["backend_compiles"] for n in names}
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+             "--smoke", "--url", srv.url, "--requests", "200",
+             "--concurrency", "8", "--expect-mode", "pipeline",
+             "--expect-stages", "2"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["smoke_ok"] and report["ok"] == 200
+        # The loadgen report names WHAT it measured (sourced from /stats).
+        assert report["serve_mode"] == "pipeline"
+        assert report["pipeline_stages"] == 2
+        after = compile_log.stats()["programs"]
+        assert {n: after[n]["backend_compiles"] for n in names} == before
+    finally:
+        srv.close()
+
+
+def test_pipeline_server_hot_reload_under_traffic(tmp_path):
+    """Hot reload on the MPMD plane: a newer pipeline checkpoint
+    published under live traffic swaps EVERY stage of the chain
+    together; replies after the swap carry the new epoch and its exact
+    predictions."""
+    ckpt = tmp_path / "ckpt"
+    model, _ = _publish_pipeline(ckpt, epoch=0, seed=10)
+    srv = _Server(_serve_args(ckpt, model="vit", buckets="1,8",
+                              serve_devices=2, serve_mode="pipeline",
+                              serve_mesh=2))
+    try:
+        images, _ = synthetic_dataset(6, seed=2)
+        srv.post("/predict", {"images": images.tolist()})
+        _, new_state = _publish_pipeline(ckpt, epoch=3, seed=77)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if srv.get("/healthz")["model_epoch"] == 3:
+                break
+            srv.post("/predict", {"images": images.tolist()})
+            time.sleep(0.05)
+        reply = srv.post("/predict", {"images": images.tolist()})
+        assert reply["model_epoch"] == 3
+        want = _pipeline_direct_labels(model, new_state, images)
+        assert reply["predictions"] == [int(v) for v in want]
+        assert srv.get("/stats")["reloads"] == 1
+    finally:
+        srv.close()
+
+
+def test_pipeline_layout_gate_both_directions(tmp_path):
+    """The flipped boot gate: a pipeline-stamped checkpoint under
+    replicated serving dies naming --serve-mode pipeline as the valid
+    choice, and the SAME checkpoint boots under it. A model WITHOUT a
+    pipeline rule table dies with flag language BEFORE the template
+    build (the mode's template hook assumes its model family)."""
+    ckpt = tmp_path / "ckpt"
+    _publish_pipeline(ckpt, epoch=0, seed=3)
+    with pytest.raises(SystemExit, match="--serve-mode pipeline"):
+        create_server(_serve_args(ckpt, model="vit", buckets="1,8"))
+    with pytest.raises(SystemExit, match="no sharding rule table"):
+        create_server(_serve_args(ckpt, model="linear", buckets="1,8",
+                                  serve_devices=2, serve_mode="pipeline"))
+    srv = _Server(_serve_args(ckpt, model="vit", buckets="1,8",
+                              serve_devices=2, serve_mode="pipeline"))
+    try:
+        stats = srv.get("/stats")
+        assert stats["serve_mode"] == "pipeline"
+        assert stats["pipeline_stages"] == 2
+    finally:
+        srv.close()

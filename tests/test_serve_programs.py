@@ -71,16 +71,17 @@ def _direct_labels(model, state, raw_images):
 
 
 def test_servable_modes_per_model():
-    assert servable_modes("vit") == ["replicated", "tensor"]
+    assert servable_modes("vit") == ["replicated", "pipeline", "tensor"]
     assert servable_modes("moe_mlp") == ["replicated", "expert"]
     assert servable_modes("cnn") == ["replicated"]
-    assert SERVE_MODES == ["replicated", "expert", "tensor"]
+    assert SERVE_MODES == ["replicated", "expert", "pipeline", "tensor"]
 
 
 def test_unservable_model_rejected_with_modes_named(vit_setup):
     with pytest.raises(ValueError, match=r"no sharding rule table.*cnn"):
         validate_serve_mode("tensor", "cnn", 2)
-    with pytest.raises(ValueError, match=r"\['replicated', 'tensor'\]"):
+    with pytest.raises(ValueError,
+                       match=r"\['replicated', 'pipeline', 'tensor'\]"):
         validate_serve_mode("expert", "vit", 2)
     with pytest.raises(ValueError, match="unknown serve mode"):
         validate_serve_mode("ring", "vit", 2)
@@ -356,7 +357,11 @@ def test_check_checkpoint_layout_rules():
         check_checkpoint_layout({"tensor": 2}, "replicated", "vit")
     with pytest.raises(ValueError, match="--serve-mode tensor"):
         check_checkpoint_layout({"tensor": 2}, "expert", "vit")
-    with pytest.raises(ValueError, match="pipeline"):
+    # The FLIPPED pipeline arm (ISSUE 12): a pipeline-trained checkpoint
+    # names --serve-mode pipeline as the valid choice instead of being
+    # rejected by name, and serves under it.
+    check_checkpoint_layout({"pipeline": 2}, "pipeline", "vit")
+    with pytest.raises(ValueError, match="--serve-mode pipeline"):
         check_checkpoint_layout({"pipeline": 2}, "replicated", "vit")
 
 

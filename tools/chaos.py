@@ -190,11 +190,14 @@ def run_serve_chaos(args) -> int:
     log = tempfile.NamedTemporaryFile(
         mode="w+", suffix=".log", delete=False)
     cmd = [sys.executable, "-m", "pytorch_distributed_mnist_tpu", "serve",
-           "--checkpoint-dir", ckpt_dir, "--model", "linear",
+           "--checkpoint-dir", ckpt_dir, "--model", args.serve_model,
            "--host", "127.0.0.1", "--port", "0", "--buckets", "1,8,32",
            "--serve-devices", str(args.serve_devices),
+           "--serve-mode", args.serve_mode,
            "--quarantine-after", str(args.quarantine_after),
            "--max-wait-ms", "2", "--poll-interval", "1"]
+    if args.serve_mesh:
+        cmd += ["--serve-mesh", str(args.serve_mesh)]
     _say(f"booting serve twin: {' '.join(cmd)}"
          + (f" [{SERVE_FAULT_ENV}={args.serve_fault}]"
             if args.serve_fault else ""))
@@ -235,13 +238,14 @@ def run_serve_chaos(args) -> int:
             _say(f"/resize -> {target} replicas: topology generation "
                  f"{reply['new']['topology_generation']}")
         out, _ = loadgen.communicate(timeout=args.timeout)
+        loadgen_rc = loadgen.returncode
         loadgen = None  # reaped; nothing left for the finally to kill
         report_line = out.strip().splitlines()[-1] if out.strip() else "{}"
         print(report_line)
         report = json.loads(report_line)
-        if loadgen.returncode != 0 or report.get("ok") != args.requests:
+        if loadgen_rc != 0 or report.get("ok") != args.requests:
             _say(f"loadgen dropped/failed requests (rc="
-                 f"{loadgen.returncode}, ok={report.get('ok')}/"
+                 f"{loadgen_rc}, ok={report.get('ok')}/"
                  f"{args.requests})")
             return 1
         _say(f"loadgen: {args.requests}/{args.requests} answered, zero "
@@ -357,6 +361,18 @@ def main(argv=None) -> int:
                         "(--expect-groups)")
     p.add_argument("--serve-devices", type=int, default=2,
                    help="serve twin: replicas the server boots with")
+    p.add_argument("--serve-mode", type=str, default="replicated",
+                   help="serve twin: the data plane to chaos "
+                        "(replicated / tensor / expert / pipeline — a "
+                        "pipeline group death is a whole-CHAIN "
+                        "quarantine + all-stage regroup)")
+    p.add_argument("--serve-mesh", type=int, default=0,
+                   help="serve twin: chips per mesh group / stages per "
+                        "pipeline chain (0 = server default)")
+    p.add_argument("--serve-model", type=str, default="linear",
+                   help="serve twin: --model for the server (sharded/"
+                        "staged modes need their model family, e.g. "
+                        "vit for pipeline)")
     p.add_argument("--serve-fault", type=str, default=None,
                    metavar="GROUP[:AFTER]",
                    help=f"serve twin: {SERVE_FAULT_ENV} injection — "

@@ -219,6 +219,13 @@ def main(argv=None) -> int:
                         "this serve_mode (e.g. 'tensor' — the sharded "
                         "--serve-mode data plane), with the mesh-shape "
                         "fields present for sharded modes")
+    p.add_argument("--expect-stages", type=int, default=0,
+                   help="smoke: additionally require /stats to report "
+                        "this many pipeline stages per chain "
+                        "(pipeline_stages — the --serve-mode pipeline "
+                        "MPMD plane; mirrors --expect-groups); the "
+                        "report always carries pipeline_stages when the "
+                        "server serves a staged mode; 0 skips the check")
     p.add_argument("--expect-groups", type=int, default=0,
                    help="smoke: additionally require /stats to report "
                         "exactly this many ACTIVE (non-quarantined) "
@@ -251,8 +258,9 @@ def main(argv=None) -> int:
     # unreachable /stats) just omits them.
     def _shape_fields(stats: dict) -> None:
         for key in ("serve_mode", "serve_devices", "mesh_devices",
-                    "mesh_groups", "max_inflight", "topology_generation",
-                    "groups", "active_groups", "quarantined_groups"):
+                    "mesh_groups", "pipeline_stages", "max_inflight",
+                    "topology_generation", "groups", "active_groups",
+                    "quarantined_groups"):
             if key in stats:
                 out[key] = stats[key]
 
@@ -305,6 +313,13 @@ def main(argv=None) -> int:
                     and (args.expect_mode == "replicated"
                          or (stats.get("mesh_devices", 0) >= 1
                              and stats.get("mesh_groups", 0) >= 1))
+                )
+            if args.expect_stages:
+                # The MPMD plane really is staged: /stats says how many
+                # per-chip stage programs each chain runs.
+                smoke_ok = (
+                    smoke_ok
+                    and stats.get("pipeline_stages") == args.expect_stages
                 )
             if args.expect_groups:
                 # The post-regroup/post-resize topology really landed:
