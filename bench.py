@@ -1859,6 +1859,16 @@ def main_zero() -> None:
       so any backend compile during the steady-state window attributes
       to the path that triggered it, and a nonzero count fails the
       bench loudly (exit 1).
+    - ``two_tier``: the hierarchical (DCN x ICI) schedule measured per
+      tier — real slice topology when the runtime reports one, else the
+      emulated 2-slice map (labelled ``dcn_emulated``). Per-tier
+      compute-free comm twins (the ici-only RS+AG chain, the dcn-only
+      shard all-reduces), per-tier overlap fractions
+      (``per_tier_overlap_fractions``), an ABBA-paired two-tier-vs-flat
+      speedup, and per-drive recompile verdicts (the two-tier step AND
+      each tier twin) that fail the bench exactly like the flat ones.
+      ``BENCH_ZERO_INJECT_RECOMPILE=two_tier`` poisons the hier drive
+      specifically.
 
     A CPU run is honestly labelled (``cpu_fallback`` + caveat: XLA:CPU
     has no async communication stream, so overlap cannot manifest and
@@ -1894,7 +1904,12 @@ def main_zero() -> None:
             synthetic_dataset,
         )
         from pytorch_distributed_mnist_tpu.models import get_model
-        from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+        from pytorch_distributed_mnist_tpu.parallel.mesh import (
+            device_slice_index,
+            infer_dcn_slices,
+            make_hier_mesh,
+            make_mesh,
+        )
         from pytorch_distributed_mnist_tpu.parallel.zero import (
             shard_state_zero,
         )
@@ -1910,6 +1925,7 @@ def main_zero() -> None:
         from pytorch_distributed_mnist_tpu.utils.profiling import (
             comm_overlap_fraction,
             compile_log,
+            per_tier_overlap_fractions,
         )
 
         device = jax.devices()[0]
@@ -1925,7 +1941,13 @@ def main_zero() -> None:
         batch = int(os.environ.get("BENCH_ZERO_BATCH",
                                    "1024" if on_tpu else "256"))
         batch = max(batch - batch % n_chips, n_chips)  # exact row split
-        inject = bool(os.environ.get("BENCH_ZERO_INJECT_RECOMPILE"))
+        # Test-only recompile injections: "1" (any truthy value except
+        # "two_tier") poisons the flat overlap drive, "two_tier" the
+        # hierarchical drive — so both fails-loudly paths are testable
+        # with per-path attribution.
+        inject_env = os.environ.get("BENCH_ZERO_INJECT_RECOMPILE", "")
+        inject = bool(inject_env) and inject_env != "two_tier"
+        inject_two_tier = inject_env == "two_tier"
 
         mesh = make_mesh(("data",))
         # Same backend policy as the training bench: bf16 MXU path on
@@ -2082,6 +2104,97 @@ def main_zero() -> None:
         overlap_frac = comm_overlap_fraction(
             step_ms_overlap, compute_ms, comm_ms)
 
+        # -- two-tier (DCN x ICI) twin: the hierarchical-mesh schedule
+        # with a PER-TIER comm breakdown — real slice topology when the
+        # runtime reports one, else the emulated slice map (2 slices by
+        # default on an even chip count), honestly labelled. Each
+        # tier's comm cost comes from its own compute-free twin (the
+        # ici-only RS+AG chain / the dcn-only shard all-reduces), and
+        # each measured drive runs under its own CompileLog measure so
+        # a steady-state recompile attributes to — and fails — exactly
+        # the program that triggered it.
+        two_tier = None
+        two_tier_verdicts = {}
+        dcn_slices = infer_dcn_slices()
+        if dcn_slices < 2 and n_chips >= 2 and n_chips % 2 == 0:
+            dcn_slices = 2  # emulated default: the smallest hierarchy
+        dcn_emulated = any(
+            device_slice_index(d) is None for d in jax.devices())
+        if dcn_slices < 2 or n_chips % dcn_slices:
+            two_tier = {"skipped": (
+                f"{n_chips} chip(s) do not split into {dcn_slices} "
+                f"equal DCN slices — nothing hierarchical to measure")}
+        else:
+            bucket_mb_dcn = float(os.environ.get(
+                "BENCH_ZERO_BUCKET_MB_DCN", str(bucket_mb)))
+            hier_mesh = make_hier_mesh(dcn_slices)
+            h_state, _ = shard_state_zero(
+                create_train_state(model, jax.random.key(0)), hier_mesh,
+                level=level)
+            h_jit = make_overlap_train_step(
+                h_state, hier_mesh, level=level, bucket_mb=bucket_mb,
+                bucket_mb_dcn=bucket_mb_dcn)
+            h_gather = make_param_gather(hier_mesh)
+            h_gathered = h_gather(h_state.params) if level == 3 else None
+            with compile_log.measure("zero_step_two_tier"):
+                h_step = (h_jit.lower(h_state, h_gathered, one).compile()
+                          if level == 3
+                          else h_jit.lower(h_state, one).compile())
+            state_of["two_tier"] = (h_state, h_gathered)
+            step_of["two_tier"] = h_step
+            # Per-tier compute-free twins on the SAME hier mesh/state.
+            # h_full is a SEPARATE gather on purpose (not h_gathered):
+            # the two-tier step donates its gathered carry, so the tier
+            # twins need a buffer the drives can never invalidate.
+            h_full = h_gather(h_state.params)
+            tier_progs = {}
+            for tier in ("ici", "dcn"):
+                t_jit = make_comm_only_program(
+                    h_state, hier_mesh, bucket_mb=bucket_mb,
+                    bucket_mb_dcn=bucket_mb_dcn, tier=tier)
+                with compile_log.measure(f"zero_comm_tier_{tier}"):
+                    tier_progs[tier] = t_jit.lower(h_full).compile()
+            drive("two_tier", 2)  # warm end to end
+            for tier in ("ici", "dcn"):
+                for _ in range(3):
+                    float(tier_progs[tier](h_full))
+            # Measured ABBA pairs: two-tier vs the flat overlapped path
+            # (same chips, same batches — the "what does the hierarchy
+            # cost/buy on this box" ratio).
+            walls_tt, walls_fo = [], []
+            for rep in range(reps):
+                order = (("two_tier", "overlap") if rep % 2 == 0
+                         else ("overlap", "two_tier"))
+                for key in order:
+                    with compile_log.measure(f"zero_drive_{key}"):
+                        if inject_two_tier and key == "two_tier":
+                            injected["n"] += 1
+                            jax.jit(lambda v, _k=injected["n"]:
+                                    v * (_k + 2))(
+                                jnp.ones((3,), jnp.float32)
+                            ).block_until_ready()
+                        w = drive(key, steps)
+                    (walls_tt if key == "two_tier"
+                     else walls_fo).append(w)
+            pairs_tt = [round(f / t, 3)
+                        for t, f in zip(walls_tt, walls_fo)]
+            step_ms_tt = statistics.median(walls_tt) / steps * 1e3
+            tier_ms = {}
+            for tier in ("ici", "dcn"):
+                tws = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    with compile_log.measure(f"zero_drive_tier_{tier}"):
+                        for _ in range(steps):
+                            r = tier_progs[tier](h_full)
+                        float(r)
+                    tws.append(time.perf_counter() - t0)
+                tier_ms[tier] = min(tws) / steps * 1e3
+            # The 1-device compute twin above is tier-free (collectives
+            # degenerate either way), so it serves both decompositions.
+            tier_fracs = per_tier_overlap_fractions(
+                step_ms_tt, compute_ms, tier_ms)
+
         steps_per_sec = steps / min(walls["overlap"])
         peak = _peak_flops(device.device_kind)
         mfu = (flops_per_step * steps_per_sec / n_chips / peak) if peak \
@@ -2099,6 +2212,40 @@ def main_zero() -> None:
 
         verdicts = {key: _drive_compiles(key) == 0
                     for key in ("overlap", "propagation")}
+        if two_tier is None or "skipped" not in two_tier:
+            two_tier_verdicts = {
+                key: _drive_compiles(key) == 0
+                for key in ("two_tier", "tier_ici", "tier_dcn")}
+            two_tier = {
+                "dcn_slices": dcn_slices,
+                "chips_per_slice": n_chips // dcn_slices,
+                "dcn_emulated": dcn_emulated,
+                "bucket_mb": bucket_mb,
+                "bucket_mb_dcn": bucket_mb_dcn,
+                "step_ms_two_tier": round(step_ms_tt, 3),
+                "vs_flat_overlap_speedup": round(
+                    statistics.median(pairs_tt), 3),
+                "pairs": pairs_tt,
+                "tiers": {
+                    tier: {
+                        "comm_ms_per_step": round(tier_ms[tier], 3),
+                        "overlap_fraction": tier_fracs[tier],
+                        "zero_steady_state_recompiles":
+                            two_tier_verdicts[f"tier_{tier}"],
+                    }
+                    for tier in ("ici", "dcn")
+                },
+                "zero_steady_state_recompiles_two_tier":
+                    two_tier_verdicts["two_tier"],
+            }
+            if dcn_emulated:
+                two_tier["caveat"] = (
+                    "emulated DCN slices: host-thread collectives say "
+                    "nothing about real cross-slice DCN latency, so "
+                    "the per-tier split shows the schedule's traffic "
+                    "shape, not DCN cost, and the vs-flat sign is not "
+                    "accelerator evidence (BENCH_r05 CPU-fallback "
+                    "precedent)")
 
         value = batch * steps / min(walls["overlap"]) / n_chips
         block = {
@@ -2122,6 +2269,7 @@ def main_zero() -> None:
                 verdicts["propagation"],
             "cpu_devices_forced": world["cpu_devices_forced"],
             "cpu_compute_isolated": world["cpu_compute_isolated"],
+            "two_tier": two_tier,
         }
         if not on_tpu:
             block["cpu_fallback"] = True
@@ -2145,13 +2293,18 @@ def main_zero() -> None:
             "n_chips": n_chips,
             "compile_stats": compile_log.stats(),
         })
-        ok = verdicts["overlap"] and verdicts["propagation"]
+        ok = (verdicts["overlap"] and verdicts["propagation"]
+              and all(two_tier_verdicts.values()))
         if not ok:
+            tier_counts = "".join(
+                f", {key}={_drive_compiles(key)}"
+                for key in sorted(two_tier_verdicts))
             out["error"] = (
                 "steady-state recompiles during the measured zero "
                 "drives: overlap="
                 f"{_drive_compiles('overlap')}, propagation="
-                f"{_drive_compiles('propagation')} backend compile(s) "
+                f"{_drive_compiles('propagation')}{tier_counts} "
+                "backend compile(s) "
                 "(the AOT executables must be shape-stable)")
     except Exception as exc:  # noqa: BLE001 - bench must always emit JSON
         out.update({"value": 0.0, "vs_baseline": 0.0, "error": repr(exc)})

@@ -258,6 +258,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "communication-issue group (smaller = earlier "
                         "first reduce-scatter, larger = fewer, "
                         "better-utilized collectives)")
+    p.add_argument("--zero-bucket-mb-dcn", type=float, default=0.0,
+                   metavar="MB",
+                   help="cross-slice (DCN-tier) bucket budget for "
+                        "--zero-overlap on a hierarchical mesh: the "
+                        "owner shards (1/ici_size of each gradient) "
+                        "all-reduce across slices in buckets of at most "
+                        "this many MiB — sized independently of "
+                        "--zero-bucket-mb because DCN is 10-100x slower "
+                        "than ICI (bigger buckets amortize its latency). "
+                        "0 (default) = same as --zero-bucket-mb; no-op "
+                        "on a flat (single-slice) mesh")
+    p.add_argument("--dcn-slices", type=int, default=0, metavar="N",
+                   help="build the hierarchical ('dcn', 'ici') mesh over "
+                        "N slices instead of the flat single-slice mesh: "
+                        "batch rows shard over the composed pair, ZeRO "
+                        "shards within the slice (weight-update "
+                        "collectives ride ICI; only 1/ici_size owner "
+                        "shards cross DCN), and model axes (TP/EP) nest "
+                        "inside one slice. 0 (default) = auto: the "
+                        "TPUMNIST_DCN_SLICES env (emulated slice map — "
+                        "how CPU worlds and tests exercise the "
+                        "hierarchy), else real device.slice_index "
+                        "topology, else flat. N must divide the device "
+                        "count")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans: every jitted step re-runs "
                         "un-jitted on a NaN/Inf result and raises at the "
@@ -1098,6 +1122,118 @@ def _run_body(args, epoch_callback=None) -> dict:
             raise SystemExit(
                 f"--zero-bucket-mb must be > 0, got {zero_bucket_mb:g}"
             )
+    zero_bucket_mb_dcn = getattr(args, "zero_bucket_mb_dcn", 0.0)
+    if zero_bucket_mb_dcn < 0:
+        raise SystemExit(
+            f"--zero-bucket-mb-dcn must be >= 0 (0 = same as "
+            f"--zero-bucket-mb), got {zero_bucket_mb_dcn:g}"
+        )
+    if zero_bucket_mb_dcn and not zero_overlap:
+        raise SystemExit(
+            "--zero-bucket-mb-dcn sizes the --zero-overlap schedule's "
+            "cross-slice buckets; pass --zero-overlap (and a "
+            "hierarchical mesh via --dcn-slices) with it"
+        )
+    # Hierarchical (DCN x ICI) mesh resolution: flag > TPUMNIST_DCN_SLICES
+    # env > real device.slice_index topology > flat. Validated here with
+    # flag language, BEFORE model/state construction.
+    from pytorch_distributed_mnist_tpu.parallel.mesh import (
+        infer_dcn_slices,
+        make_hier_mesh,
+        validate_dcn_slices,
+    )
+
+    dcn = getattr(args, "dcn_slices", 0) or 0
+    if dcn < 0:
+        raise SystemExit(f"--dcn-slices must be >= 0, got {dcn}")
+    if not dcn:
+        try:
+            dcn = infer_dcn_slices()
+        except ValueError as exc:
+            raise SystemExit(str(exc))
+    if dcn > 1:
+        # The FULL slice-topology validation (count divisibility AND,
+        # on real multi-slice hardware, slice-count match and equal
+        # sizes) — the same checks make_hier_mesh runs, so the later
+        # construction cannot fail for slice reasons.
+        try:
+            validate_dcn_slices(dcn)
+        except ValueError as exc:
+            if elastic.generation() > 0:
+                # An elastic rebuild (slice loss) can leave a world the
+                # configured slice count no longer fits — e.g. the
+                # surviving slice alone. Landing FLAT there is the
+                # designed outcome (the reshard matrix covers the
+                # layout change); aborting would turn a survived slice
+                # loss into an outage.
+                failure_events.record(
+                    "dcn_flat_fallback",
+                    f"{dcn} DCN slices no longer fit the rebuilt "
+                    f"{jax.device_count()}-device world ({exc}); "
+                    f"continuing on the flat mesh")
+                log0(f"=> elastic rebuild: {dcn} DCN slices do not fit "
+                     f"the surviving {jax.device_count()}-device world "
+                     f"({exc}); continuing on the flat mesh")
+                dcn = 1
+            else:
+                raise SystemExit(f"--dcn-slices {dcn}: {exc}")
+    if dcn > 1:
+        # The paths that own the mesh's data axis BY NAME inside a
+        # shard_map (ring/Ulysses attention, the GPipe stage program,
+        # the explicit-DP step, the fused loss kernel, the capacity
+        # dispatch) predate the composed ('dcn', 'ici') axis; each is
+        # rejected with flag language rather than discovered as a trace
+        # error. TP/EP rule tables are pure GSPMD shardings and compose
+        # — pinned to the ICI tier by make_hier_mesh.
+        if pp > 1:
+            raise SystemExit(
+                "--dcn-slices does not compose with --pipeline-stages "
+                "(the GPipe shard_map owns the mesh's data axis by "
+                "name); pipeline stages stay on the flat single-slice "
+                "mesh"
+            )
+        if sp > 1:
+            raise SystemExit(
+                "--dcn-slices does not compose with --sequence-parallel "
+                "(the ring/Ulysses shard_map owns the mesh's data axis "
+                "by name); sequence parallelism stays on the flat "
+                "single-slice mesh"
+            )
+        if args.trainer_mode == "explicit":
+            raise SystemExit(
+                "--dcn-slices does not compose with --trainer-mode "
+                "explicit (the explicit shard_map owns the whole mesh "
+                "as one flat data axis); use scan or stepwise"
+            )
+        if getattr(args, "loss", "xla") == "fused":
+            raise SystemExit(
+                "--dcn-slices does not compose with --loss fused (the "
+                "kernel's nested shard_map names the flat data axis); "
+                "use the default --loss xla"
+            )
+        if ep > 1 and getattr(args, "moe_dispatch", "dense") == "capacity":
+            raise SystemExit(
+                "--dcn-slices does not compose with --moe-dispatch "
+                "capacity (the dispatch shard_map crosses every mesh "
+                "axis by name); use --moe-dispatch dense"
+            )
+        if tp > 1 and getattr(args, "attention", "dense") == "flash":
+            raise SystemExit(
+                "--dcn-slices with --tensor-parallel does not compose "
+                "with --attention flash (the kernel's shard_map names "
+                "the flat data axis); use --attention dense"
+            )
+        per_slice = jax.device_count() // dcn
+        model_width = tp * sp * ep
+        if per_slice % model_width:
+            raise SystemExit(
+                f"model parallelism (width {model_width}) would "
+                f"straddle the DCN boundary: --dcn-slices {dcn} leaves "
+                f"{per_slice} chip(s) per slice, and TP/EP groups must "
+                f"nest inside one slice's ICI domain (every layer "
+                f"collective would otherwise ride the 10-100x slower "
+                f"cross-slice axis)"
+            )
     if pp > 1 and sp > 1:
         raise SystemExit(
             "--pipeline-stages does not compose with --sequence-parallel: "
@@ -1207,15 +1343,37 @@ def _run_body(args, epoch_callback=None) -> dict:
                         f"{num_heads} heads over the seq axis; "
                         f"--sequence-parallel {sp} must divide {num_heads}"
                     )
-        mesh = make_mesh(("data", "model", "seq"),
-                         shape=(jax.device_count() // (tp * sp), tp, sp))
+        # sp > 1 with dcn > 1 was rejected above, so the hierarchical
+        # branch only ever carries the (GSPMD-pure) model axis.
+        if dcn > 1:
+            mesh = make_hier_mesh(dcn, extra_axes=("model", "seq"),
+                                  extra_shape=(tp, sp))
+        else:
+            mesh = make_mesh(("data", "model", "seq"),
+                             shape=(jax.device_count() // (tp * sp), tp, sp))
     elif ep > 1:
-        mesh = make_mesh(("data", "expert"),
-                         shape=(jax.device_count() // ep, ep))
+        if dcn > 1:
+            mesh = make_hier_mesh(dcn, extra_axes=("expert",),
+                                  extra_shape=(ep,))
+        else:
+            mesh = make_mesh(("data", "expert"),
+                             shape=(jax.device_count() // ep, ep))
+    elif dcn > 1:
+        mesh = make_hier_mesh(dcn)
     else:
         mesh = make_mesh(("data",))
     log0(f"devices: {jax.device_count()} ({jax.devices()[0].platform}), "
          f"processes: {process_count()}, mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    if dcn > 1:
+        from pytorch_distributed_mnist_tpu.parallel.mesh import (
+            device_slice_index,
+        )
+
+        emulated = any(device_slice_index(d) is None for d in jax.devices())
+        log0(f"hierarchical mesh: {dcn} DCN slice(s) x "
+             f"{jax.device_count() // dcn} chip(s)/slice"
+             + (" (emulated slice map — host-thread collectives say "
+                "nothing about real DCN latency)" if emulated else ""))
     if args.workers:
         from pytorch_distributed_mnist_tpu.data import native as _native
 
@@ -1486,7 +1644,8 @@ def _run_body(args, epoch_callback=None) -> dict:
                       staging_log=staging_log,
                       zero_overlap=zero_overlap,
                       zero_level=3 if zero == "zero3" else 1,
-                      zero_bucket_mb=zero_bucket_mb)
+                      zero_bucket_mb=zero_bucket_mb,
+                      zero_bucket_mb_dcn=zero_bucket_mb_dcn)
     lr_of = step_decay_schedule(args.lr)
 
     # Per-run compile/staging accounting (surfaced in the summary/logs

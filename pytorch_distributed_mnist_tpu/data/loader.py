@@ -210,6 +210,13 @@ def make_global_batch(
     if mesh is None:
         return {k: jax.device_put(v) for k, v in batch.items()}
     if spec is None:
+        from pytorch_distributed_mnist_tpu.parallel.mesh import (
+            resolve_data_axis,
+        )
+
+        # Hierarchical meshes shard rows over the composed ('dcn',
+        # 'ici') pair — same rows per composed coordinate either way.
+        axis = resolve_data_axis(mesh, axis)
         spec = P(None, axis) if leading_replicated else P(axis)
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:

@@ -29,6 +29,7 @@ from typing import Dict, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pytorch_distributed_mnist_tpu.parallel.mesh import is_hier_mesh
 from pytorch_distributed_mnist_tpu.parallel.tensor import leaf_spec, _path_keys
 
 
@@ -96,6 +97,15 @@ def zero_state_sharding(
     """
     if level not in (1, 3):
         raise ValueError(f"zero level must be 1 or 3, got {level}")
+    if data_axis == "data" and "data" not in mesh.axis_names \
+            and is_hier_mesh(mesh):
+        # Hierarchical (DCN x ICI) mesh: ZeRO shards WITHIN the slice
+        # only (the arXiv:2004.13336 multi-pod partition — shard degree
+        # = slice size, replicated across slices), so the weight-update
+        # collectives it implies ride the fast ICI tier and only the
+        # 1/ici_size owner shards ever cross DCN
+        # (parallel/zero_overlap.py writes that schedule explicitly).
+        data_axis = "ici"
     if rules and base_sharding is not None:
         raise ValueError("pass rules or base_sharding, not both")
     if level == 3 and base_sharding is not None:

@@ -43,9 +43,26 @@ global-batch masked-mean gradient for any mask distribution — the same
 quantity the propagation path's autodiff computes, equal up to float
 reduction order.
 
-Scope: the pure data-parallel mesh (``data`` axis only). TP/EP rule
-tables and pipeline base shardings stay on the propagation path, which
-remains the default (``cli.py`` gates the compositions).
+- **Two-tier (DCN x ICI) schedule** on hierarchical meshes
+  (``parallel/mesh.py make_hier_mesh``): the arXiv:2004.13336 multi-pod
+  form. Gradients **reduce-scatter within the slice over ``ici``**
+  (fast tier, full gradient bytes), then **only the owner's 1/ici_size
+  shard all-reduces across slices over ``dcn``** (slow tier — DCN
+  traffic shrinks by the slice width), the optimizer updates the shard
+  (replicated across slices, deterministically identical), and the
+  updated shards **allgather back over ``ici``** — DCN never carries a
+  full parameter. Each tier gets its own bucket budget (``bucket_mb``
+  for ICI, ``bucket_mb_dcn`` for the shard-sized DCN buckets) and both
+  tiers thread through the SAME ``optimization_barrier`` fence chain,
+  one ordered communication stream. The state layout is
+  ``zero_state_sharding``'s hierarchical resolution (shards over
+  ``ici``, replicated over ``dcn``), so checkpoints interop through the
+  world-agnostic reshard path exactly like any other layout change.
+
+Scope: the pure data-parallel mesh (``data`` axis only, flat or
+hierarchical). TP/EP rule tables and pipeline base shardings stay on
+the propagation path, which remains the default (``cli.py`` gates the
+compositions).
 """
 
 from __future__ import annotations
@@ -61,6 +78,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_mnist_tpu.ops.loss import cross_entropy
 from pytorch_distributed_mnist_tpu.ops.metrics import MetricState, metrics_init
+from pytorch_distributed_mnist_tpu.parallel.mesh import (
+    HIER_DATA_AXES,
+    is_hier_mesh,
+)
 from pytorch_distributed_mnist_tpu.parallel.zero import _zero_spec, zero_state_sharding
 from pytorch_distributed_mnist_tpu.train.steps import accumulate_metrics
 
@@ -120,6 +141,42 @@ def _shard_dims(param_leaves, axis_size: int, axis: str) -> List[Optional[int]]:
                 break
         dims.append(dim)
     return dims
+
+
+class _ShardView:
+    """Shape/dtype stand-in for one leaf's post-reduce-scatter shard —
+    what the DCN tier actually moves, so its bucket plan budgets shard
+    bytes, not full-leaf bytes."""
+
+    def __init__(self, leaf, dim: Optional[int], axis_size: int):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if dim is not None:
+            shape = (shape[:dim] + (shape[dim] // axis_size,)
+                     + shape[dim + 1:])
+        self.shape = shape
+        self.dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+
+
+def _dcn_bucket_plan(param_leaves, dims, axis_size: int,
+                     bucket_mb: float) -> List[List[int]]:
+    """The DCN tier's bucket plan: the same deterministic packing as
+    ``bucket_plan``, but over SHARD-sized views (1/axis_size of each
+    sharded leaf) — the cross-slice all-reduce only ever carries the
+    owner shards, so its buckets budget those bytes independently of
+    the ICI tier's full-gradient buckets (``--zero-bucket-mb-dcn``)."""
+    views = [_ShardView(leaf, d, axis_size)
+             for leaf, d in zip(param_leaves, dims)]
+    return bucket_plan(views, bucket_mb)
+
+
+def _tier_axes(mesh: Mesh, axis):
+    """(shard_axis, outer_axis, all_axes) for the mesh: on a flat mesh
+    the shard axis IS the whole data axis and there is no outer tier;
+    on a hierarchical mesh ZeRO shards over ``ici`` and the owner
+    shards cross slices over ``dcn``."""
+    if axis == "data" and is_hier_mesh(mesh):
+        return "ici", "dcn", HIER_DATA_AXES
+    return axis, None, axis
 
 
 def _fenced(values: Tuple, token):
@@ -191,7 +248,8 @@ def _local_grads_and_metrics(state, full_params, batch, grad_accum: int):
 
 
 def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
-                       bucket_mb: float, grad_accum: int):
+                       bucket_mb: float, grad_accum: int,
+                       bucket_mb_dcn: Optional[float] = None):
     """The per-device step body + its shard_map specs.
 
     Returns ``(sharded_step, state_specs)`` where ``sharded_step(state,
@@ -200,13 +258,22 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
     factory jits it. For ``level=1`` the ``gathered`` argument carries
     the replicated params redundantly (identical to ``state.params``) so
     both levels share one body; the level-1 public wrappers hide it.
+
+    On a hierarchical mesh the body runs the two-tier schedule: RS over
+    ``ici``, the owner shards all-reduced over ``dcn`` in their own
+    ``bucket_mb_dcn``-budgeted buckets, AG over ``ici`` — all through
+    the one fence chain.
     """
     if level not in (1, 3):
         raise ValueError(f"zero level must be 1 or 3, got {level}")
-    axis_size = mesh.shape[axis]
+    shard_axis, outer_axis, all_axes = _tier_axes(mesh, axis)
+    axis_size = mesh.shape[shard_axis]
     param_leaves, ptree = jax.tree_util.tree_flatten(state.params)
-    dims = _shard_dims(param_leaves, axis_size, axis)
+    dims = _shard_dims(param_leaves, axis_size, shard_axis)
     plan = bucket_plan(param_leaves, bucket_mb)
+    dcn_plan = (_dcn_bucket_plan(param_leaves, dims, axis_size,
+                                 bucket_mb_dcn or bucket_mb)
+                if outer_axis is not None else None)
     sharding = zero_state_sharding(state, mesh, data_axis=axis, level=level)
     state_specs = jax.tree_util.tree_map(lambda ns: ns.spec, sharding)
     repl_params = jax.tree_util.tree_map(lambda _: P(), state.params)
@@ -217,13 +284,14 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
         full_params = gathered if level == 3 else st.params
         g_sum, local_m = _local_grads_and_metrics(
             st, full_params, batch, grad_accum)
-        n_global = lax.psum(local_m.count, axis)
+        n_global = lax.psum(local_m.count, all_axes)
         inv_n = 1.0 / jnp.maximum(n_global, 1.0)
 
-        # Bucketized reduce-scatter: bucket k's collectives consume only
-        # bucket k's gradient leaves (plus the chain token), so they can
-        # issue while the backward's other buckets are still computing;
-        # the chain keeps one ordered communication stream.
+        # Bucketized reduce-scatter over the shard (ICI) tier: bucket
+        # k's collectives consume only bucket k's gradient leaves (plus
+        # the chain token), so they can issue while the backward's other
+        # buckets are still computing; the chain keeps one ordered
+        # communication stream.
         g_flat = jax.tree_util.tree_flatten(g_sum)[0]
         g_shards: List = [None] * len(g_flat)
         token = jnp.zeros((), jnp.float32)
@@ -232,20 +300,36 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
             for leaf, i in zip(fenced, bucket):
                 d = dims[i]
                 if d is None:
-                    red = lax.psum(leaf, axis)
+                    red = lax.psum(leaf, shard_axis)
                 else:
                     red = lax.psum_scatter(
-                        leaf, axis, scatter_dimension=d, tiled=True)
+                        leaf, shard_axis, scatter_dimension=d, tiled=True)
                 g_shards[i] = red * inv_n.astype(red.dtype)
             token = _chain(token, jnp.sum(g_shards[bucket[0]]))
+
+        if outer_axis is not None:
+            # DCN tier: each intra-slice reduce-scatter left every
+            # (slice, ici-rank) holding its slice's PARTIAL sum of shard
+            # i; one all-reduce across slices of just that 1/ici_size
+            # shard completes the global sum — DCN moves shard bytes,
+            # never full gradients. Shard-sized buckets, same chain.
+            for bucket in dcn_plan:
+                fenced, token = _fenced(
+                    tuple(g_shards[i] for i in bucket), token)
+                for leaf, i in zip(fenced, bucket):
+                    g_shards[i] = lax.psum(leaf, outer_axis)
+                token = _chain(token, jnp.sum(g_shards[bucket[0]]))
         grad_shards = jax.tree_util.tree_unflatten(ptree, g_shards)
 
         # Owner-shard optimizer update: mu/nu arrive as local shards (the
         # shard_map in_specs ARE the ZeRO layout) and Adam is elementwise,
         # so tx.update on the shard view computes exactly the owned slice
         # of the full update. ZeRO-1 slices its shard out of the
-        # replicated params; ZeRO-3 params already are the shards.
-        idx = lax.axis_index(axis)
+        # replicated params; ZeRO-3 params already are the shards. On the
+        # hierarchical mesh the shard index is the ICI coordinate alone:
+        # every slice's rank i runs the identical update on identical
+        # globally-summed gradients (replicated over dcn by construction).
+        idx = lax.axis_index(shard_axis)
 
         def param_shard(p, d):
             if d is None or level == 3:
@@ -263,7 +347,9 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
         # Bucketized allgather of the updated shards, same fence chain:
         # sitting at the step's tail, each bucket's gather may overlap
         # the remaining buckets' updates and — through the carry — the
-        # next step's forward up to the first use of its leaves.
+        # next step's forward up to the first use of its leaves. Over
+        # the shard (ICI) tier only: cross-slice copies of the gathered
+        # params are already identical, so DCN carries nothing here.
         np_flat = jax.tree_util.tree_flatten(new_p_shards)[0]
         full: List = [None] * len(np_flat)
         for bucket in plan:
@@ -271,7 +357,7 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
             for leaf, i in zip(fenced, bucket):
                 d = dims[i]
                 full[i] = leaf if d is None else lax.all_gather(
-                    leaf, axis, axis=d, tiled=True)
+                    leaf, shard_axis, axis=d, tiled=True)
             token = _chain(token, jnp.sum(full[bucket[0]]))
         new_full = jax.tree_util.tree_unflatten(ptree, full)
 
@@ -281,15 +367,15 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
             opt_state=new_opt,
         )
         metrics = MetricState(
-            loss_sum=lax.psum(local_m.loss_sum, axis),
-            correct=lax.psum(local_m.correct, axis),
+            loss_sum=lax.psum(local_m.loss_sum, all_axes),
+            correct=lax.psum(local_m.correct, all_axes),
             count=n_global,
         )
         return new_state, new_full, metrics
 
     sharded = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(state_specs, repl_params, P(axis)),
+        in_specs=(state_specs, repl_params, P(all_axes)),
         out_specs=(state_specs, repl_params, P()),
         check_vma=False,
     )
@@ -298,7 +384,8 @@ def _make_sharded_body(state, mesh: Mesh, axis: str, level: int,
 
 def make_overlap_train_step(state, mesh: Mesh, axis: str = "data",
                             level: int = 1, bucket_mb: float = 4.0,
-                            grad_accum: int = 1):
+                            grad_accum: int = 1,
+                            bucket_mb_dcn: Optional[float] = None):
     """Jitted overlapped-ZeRO train step.
 
     ``level=1``: ``step(state, batch) -> (state, MetricState)`` — the
@@ -312,10 +399,13 @@ def make_overlap_train_step(state, mesh: Mesh, axis: str = "data",
     shapes/dtypes, ``tx``, and ``apply_fn`` are read. The state layout
     (in/out shardings) is ``zero_state_sharding(state, mesh, level)``,
     identical to the propagation path's, so the same placed state drives
-    either step.
+    either step. On a hierarchical mesh the step runs the two-tier
+    schedule; ``bucket_mb_dcn`` budgets the cross-slice shard buckets
+    (defaults to ``bucket_mb``, ignored on flat meshes).
     """
     sharded, _specs = _make_sharded_body(
-        state, mesh, axis, level, bucket_mb, grad_accum)
+        state, mesh, axis, level, bucket_mb, grad_accum,
+        bucket_mb_dcn=bucket_mb_dcn)
     if level == 3:
         return jax.jit(sharded, donate_argnums=(0, 1))
 
@@ -328,7 +418,8 @@ def make_overlap_train_step(state, mesh: Mesh, axis: str = "data",
 
 def make_overlap_train_epoch(state, mesh: Mesh, axis: str = "data",
                              level: int = 1, bucket_mb: float = 4.0,
-                             grad_accum: int = 1):
+                             grad_accum: int = 1,
+                             bucket_mb_dcn: Optional[float] = None):
     """Jitted overlapped-ZeRO scan epoch (``lax.scan`` over pre-staged
     batches, the ``make_train_epoch`` shape).
 
@@ -339,7 +430,8 @@ def make_overlap_train_epoch(state, mesh: Mesh, axis: str = "data",
     no barrier between them: the overlap the carry exists to enable.
     """
     sharded, _specs = _make_sharded_body(
-        state, mesh, axis, level, bucket_mb, grad_accum)
+        state, mesh, axis, level, bucket_mb, grad_accum,
+        bucket_mb_dcn=bucket_mb_dcn)
 
     if level == 3:
         def epoch(st, gathered, batches):
@@ -376,38 +468,91 @@ def make_param_gather(mesh: Mesh):
 
 
 def make_comm_only_program(state, mesh: Mesh, axis: str = "data",
-                           bucket_mb: float = 4.0):
+                           bucket_mb: float = 4.0,
+                           bucket_mb_dcn: Optional[float] = None,
+                           tier: Optional[str] = None):
     """Jitted ``params -> scalar`` running EXACTLY the step's collective
-    sequence — the bucket-fenced gradient reduce-scatters followed by the
-    bucket-fenced shard allgathers, on param-shaped values — with no
-    model compute in between. ``bench.py --mode zero`` times this as the
-    step's communication cost; the returned scalar folds every result in
-    so nothing is dead-code-eliminated."""
-    axis_size = mesh.shape[axis]
+    sequence — the bucket-fenced gradient reduce-scatters (ICI tier),
+    on a hierarchical mesh the bucket-fenced cross-slice shard
+    all-reduces (DCN tier), and the bucket-fenced shard allgathers — on
+    param-shaped values with no model compute in between. ``bench.py
+    --mode zero`` times this as the step's communication cost; the
+    returned scalar folds every result in so nothing is
+    dead-code-eliminated.
+
+    ``tier`` isolates ONE tier of a hierarchical mesh for the bench's
+    per-tier breakdown: ``'ici'`` runs only the intra-slice RS + AG,
+    ``'dcn'`` only the cross-slice shard all-reduces (the shard slice
+    itself is a local copy, not communication). ``tier`` on a flat mesh
+    is an error — a flat mesh has no tiers to isolate.
+    """
+    shard_axis, outer_axis, _all_axes = _tier_axes(mesh, axis)
+    if tier not in (None, "ici", "dcn"):
+        raise ValueError(f"tier must be None, 'ici' or 'dcn', got {tier!r}")
+    if tier is not None and outer_axis is None:
+        raise ValueError(
+            f"tier={tier!r} needs a hierarchical ('dcn', 'ici') mesh; "
+            f"this flat mesh has no tiers")
+    axis_size = mesh.shape[shard_axis]
     param_leaves, ptree = jax.tree_util.tree_flatten(state.params)
     del ptree
-    dims = _shard_dims(param_leaves, axis_size, axis)
+    dims = _shard_dims(param_leaves, axis_size, shard_axis)
     plan = bucket_plan(param_leaves, bucket_mb)
+    dcn_plan = (_dcn_bucket_plan(param_leaves, dims, axis_size,
+                                 bucket_mb_dcn or bucket_mb)
+                if outer_axis is not None else None)
 
     def body(params):
         flat = jax.tree_util.tree_flatten(params)[0]
         shards: List = [None] * len(flat)
         token = jnp.zeros((), jnp.float32)
-        for bucket in plan:
-            fenced, token = _fenced(tuple(flat[i] for i in bucket), token)
-            for leaf, i in zip(fenced, bucket):
+        if tier == "dcn":
+            # The DCN tier alone: slice each leaf down to this rank's
+            # shard locally (a copy, not communication) so the timed
+            # collectives move exactly the shard bytes the real
+            # schedule sends across slices.
+            idx = lax.axis_index(shard_axis)
+            for i, leaf in enumerate(flat):
                 d = dims[i]
-                shards[i] = lax.psum(leaf, axis) if d is None else \
-                    lax.psum_scatter(leaf, axis, scatter_dimension=d,
-                                     tiled=True)
-            token = _chain(token, jnp.sum(shards[bucket[0]]))
+                if d is None:
+                    shards[i] = leaf
+                else:
+                    size = leaf.shape[d] // axis_size
+                    shards[i] = lax.dynamic_slice_in_dim(
+                        leaf, idx * size, size, axis=d)
+        else:
+            for bucket in plan:
+                fenced, token = _fenced(
+                    tuple(flat[i] for i in bucket), token)
+                for leaf, i in zip(fenced, bucket):
+                    d = dims[i]
+                    shards[i] = lax.psum(leaf, shard_axis) if d is None \
+                        else lax.psum_scatter(
+                            leaf, shard_axis, scatter_dimension=d,
+                            tiled=True)
+                token = _chain(token, jnp.sum(shards[bucket[0]]))
+        if outer_axis is not None and tier != "ici":
+            for bucket in dcn_plan:
+                fenced, token = _fenced(
+                    tuple(shards[i] for i in bucket), token)
+                for leaf, i in zip(fenced, bucket):
+                    shards[i] = lax.psum(leaf, outer_axis)
+                token = _chain(token, jnp.sum(shards[bucket[0]]))
         acc = jnp.zeros((), jnp.float32)
+        if tier == "dcn":
+            # No allgather on this tier — fold the reduced shards. The
+            # per-rank folds differ across ici shards, so one scalar
+            # psum makes the P() output well-defined (negligible next
+            # to the timed shard all-reduces).
+            for s in shards:
+                acc = acc + jnp.sum(s).astype(jnp.float32)
+            return lax.psum(acc, shard_axis)
         for bucket in plan:
             fenced, token = _fenced(tuple(shards[i] for i in bucket), token)
             for leaf, i in zip(fenced, bucket):
                 d = dims[i]
                 full = leaf if d is None else lax.all_gather(
-                    leaf, axis, axis=d, tiled=True)
+                    leaf, shard_axis, axis=d, tiled=True)
                 acc = acc + jnp.sum(full).astype(jnp.float32)
             token = _chain(token, acc)
         return acc

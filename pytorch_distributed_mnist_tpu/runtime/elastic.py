@@ -153,6 +153,16 @@ MAX_WORLD_ENV = "TPUMNIST_ELASTIC_MAX_WORLD"
 # shrank past the floor you set" from "the job failed".
 EXIT_FLOOR = 78
 
+
+def generation() -> int:
+    """This worker's elastic generation: 0 for the first launch (and for
+    every non-elastic run), >= 1 inside a rebuilt world. Callers use it
+    to tell "the operator asked for this topology" (reject bad flags
+    loudly) from "the supervisor rebuilt us into it" (degrade
+    gracefully — e.g. cli.py's flat-mesh fallback when a slice loss
+    leaves a world the configured DCN slice count no longer divides)."""
+    return int(os.environ.get(GEN_ENV, "0") or 0)
+
 # Worker exit code for the planned grow rendezvous: every rank of a
 # generation that agreed pending joiners exist yields with this code
 # (plus a YIELD record — either alone proves the rank is healthy), so
@@ -334,11 +344,11 @@ def write_survivor_record(error: BaseException) -> Optional[str]:
     # supervisor's settle deadline). Either way the rebuild completes.
     supervision.maybe_fault("elastic_rebuild")
     members = _members_from_env()
-    generation = int(os.environ.get(GEN_ENV, "0") or 0)
+    gen = generation()
     rank = supervision.process_index()
     dead_ranks = sorted(getattr(error, "hosts", []) or []) if peer else []
     record = {
-        "generation": generation,
+        "generation": gen,
         "rank": rank,
         "host": members[rank] if rank < len(members) else rank,
         "dead_ranks": dead_ranks,
@@ -348,7 +358,7 @@ def write_survivor_record(error: BaseException) -> Optional[str]:
         "reason": repr(error)[:500],
         "wall": round(time.time(), 3),
     }
-    path = record_path(directory, generation, rank)
+    path = record_path(directory, gen, rank)
     try:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -378,10 +388,10 @@ def write_yield_record(join_hosts: Sequence[int]) -> Optional[str]:
     if not directory:
         return None
     members = _members_from_env()
-    generation = int(os.environ.get(GEN_ENV, "0") or 0)
+    gen = generation()
     rank = supervision.process_index()
     record = {
-        "generation": generation,
+        "generation": gen,
         "rank": rank,
         "host": members[rank] if rank < len(members) else rank,
         "yield": True,
@@ -393,7 +403,7 @@ def write_yield_record(join_hosts: Sequence[int]) -> Optional[str]:
                   f"{sorted(int(h) for h in join_hosts)}",
         "wall": round(time.time(), 3),
     }
-    path = record_path(directory, generation, rank)
+    path = record_path(directory, gen, rank)
     try:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -502,11 +512,11 @@ def note_rebuilt_world() -> None:
     new_members = _members_from_env()
     if new_members == old_members:
         return  # a same-membership relaunch changed no topology
-    generation = int(os.environ.get(GEN_ENV, "0") or 0)
+    gen = generation()
     if len(new_members) < len(old_members):
-        record_world_shrunk(old_members, new_members, generation)
+        record_world_shrunk(old_members, new_members, gen)
     else:
-        record_world_grown(old_members, new_members, generation)
+        record_world_grown(old_members, new_members, gen)
 
 
 # ---------------------------------------------------------------------------

@@ -702,6 +702,27 @@ class EnginePool:
             # many per-chip stage programs; /stats surfaces it as
             # pipeline_stages and loadgen --expect-stages asserts it.
             topo["pipeline_stages"] = self.mesh_size
+        if self.serve_mode != "replicated":
+            # Slice-alignment warning (field present only when a DCN
+            # slice topology exists): groups whose chips straddle
+            # slices run every intra-mesh collective over the slow
+            # cross-slice axis — partition_groups prefers one slice
+            # per group, so a non-empty list means the mesh size
+            # cannot fit in a slice. loadgen --smoke carries it.
+            from pytorch_distributed_mnist_tpu.parallel.mesh import (
+                device_slice_map,
+            )
+
+            straddling = None
+            for r in self.replicas:
+                smap = device_slice_map(r.devices)
+                if smap is None:
+                    continue
+                straddling = [] if straddling is None else straddling
+                if len(set(smap)) > 1:
+                    straddling.append(r.name)
+            if straddling is not None:
+                topo["slice_straddling_groups"] = straddling
         return topo
 
     def topology(self) -> dict:

@@ -322,7 +322,19 @@ def _device_array(devices):
 def partition_groups(devices: Sequence, mesh_size: int) -> List[list]:
     """Partition ``devices`` into ``mesh_size``-chip groups (the pool's
     sharded/staged plane: one spanning engine per group), rejecting
-    indivisible shapes with flag language."""
+    indivisible shapes with flag language.
+
+    Slice-aligned: when a DCN slice topology exists (real
+    ``device.slice_index`` or the emulated ``TPUMNIST_DCN_SLICES``
+    map), chips are ordered slice-major before chunking, so each
+    group's intra-mesh collectives ride one slice's ICI whenever the
+    mesh size fits in a slice — a group straddles slices only when it
+    cannot fit, and the pool's ``/stats`` topology flags exactly those
+    groups (``slice_straddling_groups``)."""
+    from pytorch_distributed_mnist_tpu.parallel.mesh import (
+        device_slice_map,
+    )
+
     devices = list(devices)
     if mesh_size < 1:
         raise ValueError(f"mesh size must be >= 1, got {mesh_size}")
@@ -332,6 +344,10 @@ def partition_groups(devices: Sequence, mesh_size: int) -> List[list]:
             f"{mesh_size}-device mesh groups; --serve-mesh must divide "
             f"--serve-devices"
         )
+    smap = device_slice_map(devices)
+    if smap is not None:
+        order = sorted(range(len(devices)), key=lambda i: (smap[i], i))
+        devices = [devices[i] for i in order]
     return [devices[i:i + mesh_size]
             for i in range(0, len(devices), mesh_size)]
 

@@ -304,6 +304,14 @@ class _Handler(BaseHTTPRequestHandler):
                     # Staged (pipeline) modes: chips per chain — what
                     # loadgen --expect-stages asserts.
                     stats["pipeline_stages"] = topo["pipeline_stages"]
+                if "slice_straddling_groups" in topo:
+                    # Slice-alignment warning (present only when a DCN
+                    # slice topology exists): mesh groups whose chips
+                    # straddle slices — their intra-group collectives
+                    # ride the slow cross-slice axis. loadgen reports
+                    # carry it.
+                    stats["slice_straddling_groups"] = \
+                        topo["slice_straddling_groups"]
             self._reply(200, stats)
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})

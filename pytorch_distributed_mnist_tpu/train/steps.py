@@ -189,6 +189,11 @@ def _eval_step(state, batch):
 def _shardings(mesh: Optional[Mesh], axis: str):
     if mesh is None:
         return None, None
+    from pytorch_distributed_mnist_tpu.parallel.mesh import resolve_data_axis
+
+    # Hierarchical (DCN x ICI) meshes have no literal 'data' axis: the
+    # batch shards over the composed ('dcn', 'ici') pair instead.
+    axis = resolve_data_axis(mesh, axis)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P(axis))
     return repl, data
@@ -306,8 +311,11 @@ def _make_epoch(mesh, axis, state_sharding, step_fn, train, indexed):
     donate = (0,) if train else ()
     if mesh is None:
         return jax.jit(epoch, donate_argnums=donate)
+    from pytorch_distributed_mnist_tpu.parallel.mesh import resolve_data_axis
+
     state_sh = repl if state_sharding is None else state_sharding
-    xs_shard = NamedSharding(mesh, P(None, axis))  # (steps, batch) prefix
+    xs_shard = NamedSharding(
+        mesh, P(None, resolve_data_axis(mesh, axis)))  # (steps, batch) prefix
     in_sh = ((state_sh, repl, xs_shard) if indexed
              else (state_sh, xs_shard))
     out_sh = (state_sh, repl) if train else repl

@@ -92,6 +92,7 @@ class Trainer:
         zero_overlap: bool = False,
         zero_level: int = 1,
         zero_bucket_mb: float = 4.0,
+        zero_bucket_mb_dcn: float = 0.0,
     ) -> None:
         if mode not in ("scan", "stepwise", "explicit"):
             raise ValueError(f"unknown trainer mode {mode!r}")
@@ -183,7 +184,8 @@ class Trainer:
             self._train_step = (
                 make_overlap_train_step(
                     state, mesh, level=zero_level,
-                    bucket_mb=zero_bucket_mb, grad_accum=grad_accum)
+                    bucket_mb=zero_bucket_mb, grad_accum=grad_accum,
+                    bucket_mb_dcn=zero_bucket_mb_dcn or None)
                 if mode != "scan" else None
             )
             if zero_level == 3:
@@ -207,7 +209,8 @@ class Trainer:
 
             self._train_epoch = make_overlap_train_epoch(
                 state, mesh, level=zero_level, bucket_mb=zero_bucket_mb,
-                grad_accum=grad_accum)
+                grad_accum=grad_accum,
+                bucket_mb_dcn=zero_bucket_mb_dcn or None)
         else:
             self._train_epoch = (
                 make_train_epoch(mesh, state_sharding=state_sharding,

@@ -198,6 +198,26 @@ def comm_overlap_fraction(step_ms: float, compute_ms: float,
     return round(max(0.0, min(1.0, 1.0 - exposed / float(comm_ms))), 4)
 
 
+def per_tier_overlap_fractions(step_ms: float, compute_ms: float,
+                               comm_ms_by_tier: dict) -> dict:
+    """Per-tier overlap fractions for a multi-tier communication
+    schedule (the DCN x ICI two-tier ZeRO step, ``bench.py --mode
+    zero``): tier t's fraction is ``comm_overlap_fraction(step,
+    compute, comm_t)`` — the step's WHOLE exposed time charged against
+    that tier alone. Wall measurements cannot say WHICH tier's
+    milliseconds the step hid, so each entry is the guaranteed-hidden
+    lower bound: a tier scores above 0 only when the exposure is
+    smaller than its own comm (some of it must have been hidden no
+    matter how the exposure is attributed), and 1.0 only when the step
+    costs no more than its compute.
+
+    ``None`` entries propagate per tier (a zero-comm tier has nothing
+    to overlap). Unit-pinned in ``tests/test_bench_zero.py``.
+    """
+    return {tier: comm_overlap_fraction(step_ms, compute_ms, comm)
+            for tier, comm in comm_ms_by_tier.items()}
+
+
 def stage_occupancy(stage_step_ms: dict) -> dict:
     """Per-stage occupancy of a streamed pipeline under full overlap:
     each stage's synchronous step wall over the BOTTLENECK stage's.

@@ -596,3 +596,66 @@ def install_stage_params(params, n_stages):
     return stages
 """
     assert _findings(src) == []
+
+
+# -- hierarchical (DCN x ICI) collective shapes (PR 13) ----------------------
+
+
+def test_fires_on_tier_agreement_gated_to_slice_leaders():
+    """FIRING: the tempting two-tier shape — run the cross-slice (DCN)
+    agreement on 'slice leaders' only. Host-side agreements are
+    fixed-width allgathers over EVERY rank; a tier-conditioned call
+    strands the non-leader hosts exactly like any process_index gate."""
+    src = """
+from pytorch_distributed_mnist_tpu.runtime import supervision
+
+def dcn_tier_publish(ok, hosts_per_slice):
+    if process_index() % hosts_per_slice == 0:
+        supervision.allgather_records("dcn_publish", ok)
+"""
+    (f,) = _findings(src)
+    assert "host-dependent" in f.message
+
+
+def test_fires_on_slice_index_early_return_before_tier_agreement():
+    """FIRING: slice 0's hosts bail out before the DCN-tier agreement —
+    the early-return form of the same strand (the hazard is the
+    collective AFTER the branch)."""
+    src = """
+def cross_slice_reduce(ok, hosts_per_slice):
+    my_slice = process_index() // hosts_per_slice
+    if my_slice == 0:
+        return None
+    return allgather_records("dcn_reduce", ok)
+"""
+    (f,) = _findings(src)
+    assert "early return/raise" in f.message
+
+
+def test_silent_on_symmetric_two_tier_schedule():
+    """NON-FIRING: the shipped shape (parallel/zero_overlap.py's host
+    twin) — every rank runs the ICI-tier agreement then the DCN-tier
+    agreement, in order, unconditionally. Tiers change what each
+    collective carries, never who runs it."""
+    src = """
+from pytorch_distributed_mnist_tpu.runtime import supervision
+
+def two_tier_update(ok):
+    supervision.allgather_records("ici_reduce_scatter", ok)
+    supervision.allgather_records("dcn_shard_allreduce", ok)
+    supervision.allgather_records("ici_allgather", ok)
+"""
+    assert _findings(src) == []
+
+
+def test_silent_on_world_size_guarded_tier_agreement():
+    """NON-FIRING: the sanctioned symmetric guard — a single-process
+    (or single-slice) world skips the tier agreement on EVERY host via
+    process_count(), which cannot diverge across hosts."""
+    src = """
+def maybe_dcn_agree(ok, n_slices):
+    if process_count() <= 1 or n_slices <= 1:
+        return []
+    return allgather_records("dcn_shard_allreduce", ok)
+"""
+    assert _findings(src) == []

@@ -36,6 +36,30 @@ def test_comm_overlap_fraction_math():
     assert comm_overlap_fraction(None, 100.0, 40.0) is None
 
 
+def test_per_tier_overlap_fractions_math():
+    """The two-tier decomposition helper: each tier's entry is the
+    guaranteed-hidden LOWER bound (the whole exposure charged against
+    that tier alone), None propagating per tier."""
+    from pytorch_distributed_mnist_tpu.utils.profiling import (
+        per_tier_overlap_fractions,
+    )
+
+    # 30 ms exposed: at least 10 of ici's 40 must have been hidden
+    # (0.25) no matter the attribution; dcn's 30 could all be exposed.
+    fr = per_tier_overlap_fractions(130.0, 100.0, {"ici": 40.0, "dcn": 30.0})
+    assert fr["ici"] == 0.25
+    assert fr["dcn"] == 0.0
+    # step == compute: every tier fully hidden.
+    fr = per_tier_overlap_fractions(100.0, 100.0, {"ici": 40.0, "dcn": 30.0})
+    assert fr == {"ici": 1.0, "dcn": 1.0}
+    # a zero-comm tier has nothing to overlap; the other still scores.
+    fr = per_tier_overlap_fractions(100.0, 100.0, {"ici": 40.0, "dcn": 0.0})
+    assert fr["ici"] == 1.0 and fr["dcn"] is None
+    # unknown compute: nothing can be attributed.
+    fr = per_tier_overlap_fractions(100.0, None, {"ici": 40.0})
+    assert fr["ici"] is None
+
+
 def _run_zero_bench(env_extra, timeout=540):
     env = os.environ.copy()
     env.update({
@@ -103,6 +127,25 @@ def test_bench_zero_reports_overlap_block():
     assert z["cpu_fallback"] is True
     assert "not" in z["caveat"] and "accelerator" in z["caveat"]
 
+    # The two-tier (DCN x ICI) block: the forced 4-chip CPU world
+    # emulates 2 slices by default, honestly labelled, with a per-tier
+    # comm breakdown and per-drive recompile verdicts.
+    tt = z["two_tier"]
+    assert tt["dcn_slices"] == 2 and tt["chips_per_slice"] == 2
+    assert tt["dcn_emulated"] is True
+    assert "DCN" in tt["caveat"]
+    assert tt["bucket_mb_dcn"] == tt["bucket_mb"] == 4.0
+    assert tt["step_ms_two_tier"] > 0
+    assert len(tt["pairs"]) == 3 and tt["vs_flat_overlap_speedup"] > 0
+    assert set(tt["tiers"]) == {"ici", "dcn"}
+    for tier in ("ici", "dcn"):
+        row = tt["tiers"][tier]
+        assert row["comm_ms_per_step"] > 0
+        assert row["overlap_fraction"] is None \
+            or 0.0 <= row["overlap_fraction"] <= 1.0
+        assert row["zero_steady_state_recompiles"] is True
+    assert tt["zero_steady_state_recompiles_two_tier"] is True
+
 
 @pytest.mark.slow
 def test_bench_zero_fails_loudly_on_steady_state_recompiles():
@@ -118,3 +161,22 @@ def test_bench_zero_fails_loudly_on_steady_state_recompiles():
     # The uninjected path's verdict stays clean: attribution is per path.
     assert report["zero_overlap"][
         "zero_steady_state_recompiles_propagation"] is True
+
+
+@pytest.mark.slow
+def test_bench_zero_fails_loudly_on_hier_mesh_recompiles():
+    """The fails-loudly contract re-pinned on the HIERARCHICAL mesh: a
+    compile injected into the two-tier drive flips that verdict and
+    exits 1 while the flat paths — and the per-tier comm twins — stay
+    clean, so attribution survives the hierarchy."""
+    proc, report = _run_zero_bench(
+        {"BENCH_ZERO_INJECT_RECOMPILE": "two_tier"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "recompile" in report["error"] and "two_tier" in report["error"]
+    z = report["zero_overlap"]
+    assert z["two_tier"]["zero_steady_state_recompiles_two_tier"] is False
+    for tier in ("ici", "dcn"):
+        assert z["two_tier"]["tiers"][tier][
+            "zero_steady_state_recompiles"] is True
+    assert z["zero_steady_state_recompiles_overlap"] is True
+    assert z["zero_steady_state_recompiles_propagation"] is True

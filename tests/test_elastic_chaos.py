@@ -155,6 +155,48 @@ def test_elastic_survives_midepoch_kill_and_matches_direct_small_world(
         assert _strip_timing(elastic_row) == _strip_timing(direct_row)
 
 
+def test_slice_loss_shrinks_to_surviving_slice_flat_world(
+        tmp_path, monkeypatch):
+    """The slice-loss twin (PR 13 satellite): the 2-host world runs on
+    the emulated hierarchical mesh (TPUMNIST_DCN_SLICES=2 — one host
+    per slice, exactly the chaos ``--kill-slice`` composition), and
+    EVERY host of slice 1 (= host 1) is SIGKILLed mid-epoch. The
+    existing elastic machinery must handle it unchanged: the survivor
+    votes, the supervisor rebuilds a 1-host world — which the
+    configured slice count no longer divides, so cli.py's elastic
+    fallback lands it on the surviving slice's FLAT mesh (recorded as
+    ``dcn_flat_fallback``) — and the hier-written zero1 checkpoint
+    reshards through the ordinary (W, W') matrix to completion, rc 0,
+    no new elastic machinery."""
+    ckpt, metrics = tmp_path / "ckpts", tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUMNIST_AGREEMENT_TIMEOUT", _DEADLINE)
+    monkeypatch.setenv("TPUMNIST_DCN_SLICES", "2")
+    # Slice 1 = host 1 (one host per emulated slice); epoch 0's four
+    # steps run whole so its checkpoint publishes, the kill lands
+    # inside epoch 1's step loop — the --kill-slice spec shape.
+    monkeypatch.setenv("TPUMNIST_FAULT", "train_step:1:kill:5")
+    rc = supervise(2, _flags(ckpt, metrics,
+                             extra=["--optimizer-sharding", "zero1"]),
+                   settle_timeout=60, generation_timeout=240)
+    assert rc == 0, f"slice-loss elastic run failed (rc={rc})"
+
+    rows = _rows(metrics)
+    shrunk = _events(rows, "world_shrunk")
+    assert len(shrunk) == 1
+    assert shrunk[0]["old_members"] == [0, 1]
+    assert shrunk[0]["new_members"] == [0]
+    # The rebuilt world could not host 2 DCN slices and said so — the
+    # designed degradation, not a silent relayout.
+    fallback = _events(rows, "dcn_flat_fallback")
+    assert fallback and "flat" in fallback[0]["detail"]
+    # The hier-written checkpoint resharded onto the flat small world
+    # through the ordinary path, and the job trained to completion.
+    reshard = _events(rows, "checkpoint_reshard")
+    assert reshard and reshard[0]["saved"]["processes"] == 2
+    resumed = _epoch_rows_after_shrink(rows)
+    assert [r["epoch"] for r in resumed] == [1, 2]
+
+
 def test_shrink_then_grow_matches_direct_large_world(
         tmp_path, monkeypatch):
     """THE grow acceptance twin (tier-1): the 2 -> 1 -> 2 round trip.

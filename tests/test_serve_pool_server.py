@@ -313,6 +313,28 @@ def test_sharded_server_loadgen_smoke_expect_mode(tmp_path):
         srv.close()
 
 
+def test_sharded_server_stats_forward_slice_straddling(tmp_path,
+                                                       monkeypatch):
+    """The /stats handler FORWARDS the pool's slice-alignment warning
+    (a field present only when a DCN slice topology exists): 8 emulated
+    1-chip slices make every 2-chip tensor group straddle, and the
+    served stats — the surface loadgen reports copy — name both."""
+    from pytorch_distributed_mnist_tpu.parallel.mesh import DCN_SLICES_ENV
+
+    monkeypatch.setenv(DCN_SLICES_ENV, "8")
+    ckpt = tmp_path / "ckpt"
+    _publish_model(ckpt, "vit", epoch=0, seed=10)
+    srv = _Server(_serve_args(ckpt, model="vit", buckets="8",
+                              serve_devices=4, serve_mode="tensor",
+                              serve_mesh=2))
+    try:
+        stats = srv.get("/stats")
+        assert sorted(stats["slice_straddling_groups"]) \
+            == ["tensor.g0", "tensor.g1"]
+    finally:
+        srv.close()
+
+
 def test_sharded_server_hot_reload_under_traffic(tmp_path):
     """Fleet-wide hot reload on the mesh plane: a newer checkpoint
     published under live traffic swaps every mesh group; replies after

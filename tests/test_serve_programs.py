@@ -438,3 +438,77 @@ def test_programs_module_clean_under_analyzer():
                   "recompile-hazard", "lock-discipline"],
         baseline=None)
     assert result.findings == []
+
+
+# -- serve-mesh slice alignment (PR 13) --------------------------------------
+
+
+def test_partition_groups_orders_slice_major(monkeypatch):
+    """With a slice topology, chips are ordered slice-major before
+    chunking: a shuffled device list still yields one-slice groups
+    whenever the mesh size fits in a slice."""
+    from pytorch_distributed_mnist_tpu.parallel.mesh import DCN_SLICES_ENV
+    from pytorch_distributed_mnist_tpu.serve.programs import (
+        partition_groups,
+    )
+
+    devs = jax.devices()  # ids 0..7
+    monkeypatch.setenv(DCN_SLICES_ENV, "2")  # slices: {0..3}, {4..7}
+    shuffled = [devs[i] for i in (5, 0, 7, 2, 4, 1, 6, 3)]
+    groups = partition_groups(shuffled, 2)
+    for group in groups:
+        slices = {d.id // 4 for d in group}
+        assert len(slices) == 1, [d.id for d in group]
+    # Without a topology, the given order is preserved untouched.
+    monkeypatch.delenv(DCN_SLICES_ENV)
+    groups = partition_groups(shuffled, 2)
+    assert [d.id for d in groups[0]] == [5, 0]
+
+
+def test_pool_topology_flags_slice_straddling_groups(moe_setup,
+                                                     monkeypatch):
+    """The stats-field warning: a mesh size that cannot fit in a slice
+    produces groups spanning slices, and the pool names exactly those
+    in ``slice_straddling_groups``; aligned layouts report an empty
+    list, and the field vanishes with the topology."""
+    from pytorch_distributed_mnist_tpu.parallel.mesh import DCN_SLICES_ENV
+
+    model, state, _ = moe_setup
+
+    def build():
+        return EnginePool(model.apply, state.params,
+                          devices=jax.local_devices()[:4], buckets=(4,),
+                          serve_mode="expert", mesh_size=2,
+                          model_name="moe_mlp")
+
+    # 8 emulated slices of 1 chip: every 2-chip group must straddle.
+    monkeypatch.setenv(DCN_SLICES_ENV, "8")
+    topo = build().topology()
+    assert sorted(topo["slice_straddling_groups"]) \
+        == ["expert.g0", "expert.g1"]
+    # 2 slices of 4: chips 0-3 share slice 0 — aligned, empty list.
+    monkeypatch.setenv(DCN_SLICES_ENV, "2")
+    topo = build().topology()
+    assert topo["slice_straddling_groups"] == []
+    # No topology: the field is absent (schema untouched for the
+    # single-slice worlds every existing test runs in).
+    monkeypatch.delenv(DCN_SLICES_ENV)
+    topo = build().topology()
+    assert "slice_straddling_groups" not in topo
+
+
+def test_loadgen_shape_fields_carry_slice_straddling(tmp_path):
+    """The loadgen report's shape-field list includes the slice
+    warning, so a --smoke report carries it whenever /stats does (the
+    field rides the same best-effort copy as the other topology
+    fields)."""
+    import ast
+    import inspect
+
+    import tools.loadgen as loadgen
+
+    src = inspect.getsource(loadgen)
+    tree = ast.parse(src)
+    consts = {n.value for n in ast.walk(tree)
+              if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+    assert "slice_straddling_groups" in consts

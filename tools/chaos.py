@@ -47,6 +47,15 @@ deaths instead of monkeypatches:
         --dataset synthetic --model linear --epochs 3 \\
         --optimizer-sharding zero1 --trainer-mode stepwise
 
+    # SLICE LOSS on the emulated hierarchical mesh: the 2-host world
+    # runs as 2 DCN slices x 1 host; killing every host of slice 1
+    # shrinks it to the surviving slice, whose 1-host world the slice
+    # count no longer divides — it lands on the FLAT mesh (cli.py's
+    # elastic fallback) and resumes via the ordinary (W, W') reshard
+    python tools/chaos.py --elastic --dcn-slices 2 --kill-slice 1 \\
+        --nprocs 2 -- --dataset synthetic --model linear --epochs 3 \\
+        --optimizer-sharding zero1 --trainer-mode stepwise
+
     # SERVE-POOL self-healing: boot a real 4-replica server, 'kill'
     # group 1 after 5 batches (TPUMNIST_SERVE_FAULT injection), hammer
     # it with loadgen — every request must answer 200 (failover, never
@@ -120,6 +129,10 @@ from pytorch_distributed_mnist_tpu.runtime.supervision import (  # noqa: E402
 # jax-import-free until a twin actually runs (pinned equal by
 # tests/test_serve_heal_server.py).
 SERVE_FAULT_ENV = "TPUMNIST_SERVE_FAULT"
+
+# parallel/mesh.py::DCN_SLICES_ENV, spelled out for the same
+# jax-import-free reason (pinned equal by tests/test_hier_mesh.py).
+DCN_SLICES_ENV = "TPUMNIST_DCN_SLICES"
 
 
 def list_fault_points(file=sys.stdout) -> None:
@@ -329,6 +342,22 @@ def main(argv=None) -> int:
                         "simulation of a returned/replacement host "
                         "announcing itself; e.g. 1@1 for the 2->1->2 "
                         "twin)")
+    p.add_argument("--dcn-slices", type=int, default=0, metavar="N",
+                   help="run the world on the emulated hierarchical "
+                        f"(DCN x ICI) mesh: sets {DCN_SLICES_ENV}=N for "
+                        "every rank (N must divide --nprocs; each "
+                        "slice is a contiguous block of ranks). The "
+                        "slice-loss twins compose this with "
+                        "--kill-slice")
+    p.add_argument("--kill-slice", type=int, default=None, metavar="S",
+                   help="elastic slice-loss twin: SIGKILL EVERY host of "
+                        "emulated slice S (mid-epoch, the train_step "
+                        "point, skip 5) — the survivors shrink to the "
+                        "remaining slice(s), and a world the slice "
+                        "count no longer divides lands on the FLAT "
+                        "mesh (cli.py's elastic fallback) and resumes "
+                        "through the ordinary (W, W') reshard. "
+                        "Requires --elastic and --dcn-slices")
     p.add_argument("--min-world", type=int, default=1, metavar="W",
                    help="elastic floor: stop shrinking below W healthy "
                         "hosts (default 1)")
@@ -414,6 +443,29 @@ def main(argv=None) -> int:
         raise SystemExit("--serve-fault/--resize are serve-plane twins; "
                          "add --serve")
 
+    if args.dcn_slices:
+        if args.dcn_slices < 2 or args.nprocs % args.dcn_slices:
+            raise SystemExit(
+                f"--dcn-slices {args.dcn_slices} must divide --nprocs "
+                f"{args.nprocs} into equal slices (>= 2)")
+        os.environ[DCN_SLICES_ENV] = str(args.dcn_slices)
+    # No flag: an exported TPUMNIST_DCN_SLICES is the documented env
+    # contract and stays in force for the workers (unlike FAULT_ENV,
+    # which is chaos's own channel and is cleared below when unused).
+    if args.kill_slice is not None:
+        if not args.elastic or not args.dcn_slices:
+            raise SystemExit(
+                "--kill-slice is the elastic slice-loss twin; it "
+                "requires --elastic and --dcn-slices")
+        per = args.nprocs // args.dcn_slices
+        if not 0 <= args.kill_slice < args.dcn_slices:
+            raise SystemExit(
+                f"--kill-slice {args.kill_slice} is not one of the "
+                f"{args.dcn_slices} slices")
+        specs = [f"train_step:{h}:kill:5"
+                 for h in range(args.kill_slice * per,
+                                (args.kill_slice + 1) * per)]
+        args.fault = ",".join(specs + ([args.fault] if args.fault else []))
     if args.fault:
         parse_fault_specs(args.fault)  # fail fast with the spec's message
         os.environ[FAULT_ENV] = args.fault

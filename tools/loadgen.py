@@ -260,7 +260,7 @@ def main(argv=None) -> int:
         for key in ("serve_mode", "serve_devices", "mesh_devices",
                     "mesh_groups", "pipeline_stages", "max_inflight",
                     "topology_generation", "groups", "active_groups",
-                    "quarantined_groups"):
+                    "quarantined_groups", "slice_straddling_groups"):
             if key in stats:
                 out[key] = stats[key]
 
