@@ -1405,6 +1405,149 @@ def main_serve() -> None:
                     "the overlap schema and the zero-recompile verdicts "
                     "are meaningful here")
 
+        # -- precision sweep (serve/programs.py precision plane): for
+        # each registered quantized precision, the ABBA-paired
+        # throughput ratio vs f32 at the SAME chip (PR 4 pairing:
+        # adjacent pairs see the same neighbor load, median paired
+        # ratio), the eval-batch argmax-agreement + accuracy delta vs
+        # f32, and per bucket x mode x precision zero-recompile
+        # verdicts that fail the whole bench line (exit 1). The eval
+        # batch is the synthetic stand-in (CI has no MNIST files on
+        # disk); with a real checkpoint the same fields measure the
+        # real test set via the serving stack.
+        import numpy as np
+
+        from pytorch_distributed_mnist_tpu.serve.programs import (
+            get_serve_mode,
+            registered_mode_models,
+            serve_precisions,
+            validate_serve_mode,
+        )
+
+        precision_requests = int(os.environ.get(
+            "BENCH_SERVE_PRECISION_REQUESTS", max(200, pool_requests // 2)))
+        precision_block: dict = {"requests": precision_requests,
+                                 "eval_set": "synthetic(512)"}
+        precision_recompiles: list = []
+        quantized = [p for p in serve_precisions() if p != "f32"]
+        eval_images, eval_labels = synthetic_dataset(512, seed=1)
+        ref_logits = engine.logits(eval_images)
+        ref_pred = np.argmax(ref_logits, axis=-1)
+        acc_f32 = float((ref_pred == eval_labels).mean())
+        precision_block["f32_accuracy"] = round(acc_f32, 4)
+
+        def drive_engine(eng, requests_n: int) -> float:
+            """One fixed-shape closed-loop drive through a fresh
+            batcher (8-row exact-bucket requests, max_batch=8 — the
+            pool blocks' reasoning: pin batch formation so the ratio
+            measures the forward programs, not packing)."""
+            with MicroBatcher(eng.predict, max_batch=8,
+                              max_wait_s=0.002,
+                              max_queue=4 * concurrency) as b:
+                drive(b, max(32, requests_n // 10), pool_stacks)  # warm
+                return drive(b, requests_n, pool_stacks)
+
+        for prec in quantized:
+            prec_engine = InferenceEngine(
+                model.apply, state.params, precision=prec, name=prec)
+            prec_engine.warmup()
+            before_prec = _serve_program_compiles()
+            lo = prec_engine.logits(eval_images)
+            pred = np.argmax(lo, axis=-1)
+            walls_p = {"prec": [], "f32": []}
+            for rep in range(4):
+                order = (("prec", "f32") if rep % 2 == 0
+                         else ("f32", "prec"))
+                for leg in order:
+                    eng = prec_engine if leg == "prec" else engine
+                    walls_p[leg].append(
+                        drive_engine(eng, precision_requests))
+            pairs_p = [round(f / p, 3) for p, f in
+                       zip(walls_p["prec"], walls_p["f32"])]
+            ratio = sorted(pairs_p)[len(pairs_p) // 2]
+            delta_prec = _recompile_delta(before_prec,
+                                          _serve_program_compiles())
+            if delta_prec:
+                precision_recompiles.append({prec: delta_prec})
+            acc_p = float((pred == eval_labels).mean())
+            precision_block[prec] = {
+                "vs_f32": ratio,
+                "pairs": pairs_p,
+                "requests_per_sec": round(
+                    precision_requests / min(walls_p["prec"]), 1),
+                "argmax_agreement_vs_f32": round(
+                    float((pred == ref_pred).mean()), 4),
+                "accuracy": round(acc_p, 4),
+                "accuracy_delta_vs_f32": round(acc_p - acc_f32, 4),
+                "max_logit_delta_vs_f32": round(
+                    float(np.abs(lo - ref_logits).max()), 5),
+                "zero_steady_state_recompiles": not delta_prec,
+            }
+
+        # Per bucket x MODE x precision recompile verdicts: every
+        # registered mode (the LIVE registry, SPMD and engine-factory
+        # alike) x every quantized precision gets a small pool drive on
+        # 2 chips; any steady-state compile fails the bench. Skipped
+        # combos are labeled, never silently dropped.
+        mode_verdicts: dict = {}
+        if n_devices >= 2:
+            from pytorch_distributed_mnist_tpu.serve.programs import (
+                make_serve_template,
+            )
+
+            for mode, model_name in registered_mode_models():
+                vmodel = get_model(
+                    model_name, **({} if device.platform == "tpu"
+                                   else {"compute_dtype": jnp.float32}))
+                # The registry's template hook owns the mode's param
+                # LAYOUT (pipeline restores onto the stage-stacked
+                # tree) — never a hardcoded per-mode transform here.
+                vparams = make_serve_template(
+                    mode, vmodel, jax.random.key(0)).params
+                for prec in quantized:
+                    key = f"{mode}.{prec}"
+                    try:
+                        if get_serve_mode(mode).engine_factory is None:
+                            validate_serve_mode(mode, model_name, 2,
+                                                vparams)
+                        vpool = EnginePool(
+                            vmodel.apply, vparams,
+                            devices=jax.local_devices()[:2],
+                            buckets=(1, 8), serve_mode=mode, mesh_size=2,
+                            model_name=model_name, model=vmodel,
+                            precision=prec)
+                        vpool.warmup()
+                    except ValueError as exc:
+                        # An unservable combo (e.g. an extension mode a
+                        # 2-chip mesh can't host) is a labeled skip,
+                        # never a traceback that loses the bench line.
+                        mode_verdicts[key] = {"model": model_name,
+                                              "skipped": str(exc)}
+                        continue
+                    before_mv = _serve_program_compiles()
+                    drive_pool(vpool, window=2, requests_n=64, reps=1,
+                               fixed_shape=True)
+                    delta_mv = _recompile_delta(
+                        before_mv, _serve_program_compiles())
+                    if delta_mv:
+                        precision_recompiles.append({key: delta_mv})
+                    mode_verdicts[key] = {
+                        "model": model_name,
+                        "zero_steady_state_recompiles": not delta_mv,
+                    }
+        else:
+            mode_verdicts["skipped"] = (
+                "single-device world: mode x precision pools need >= 2 "
+                "chips")
+        precision_block["modes"] = mode_verdicts
+        if device.platform != "tpu":
+            precision_block["caveat"] = (
+                "CPU fallback (the BENCH_r05 convention): host int8/bf16 "
+                "arithmetic says little about the TPU MXU or ICI, so "
+                "the per-precision throughput sign is not the chip's — "
+                "only the schema, the accuracy/agreement deltas, and "
+                "the zero-recompile verdicts are meaningful here")
+
         value = requests / wall
         out.update({
             "value": round(value, 1),
@@ -1422,6 +1565,7 @@ def main_serve() -> None:
             "replica_scaling": replica_scaling,
             "sharded": sharded_block,
             "pipeline_serving": pipeline_block,
+            "precision_sweep": precision_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
             "pool_requests": pool_requests,
@@ -1439,7 +1583,7 @@ def main_serve() -> None:
         served_all = snap["requests"] == 2 * requests  # best-of-2 drives
         ok = (zero_recompiles and not drive_errors and served_all
               and not recompiled_replicas and not sharded_recompiles
-              and not pipeline_recompiles)
+              and not pipeline_recompiles and not precision_recompiles)
         if not zero_recompiles:
             out["error"] = ("steady-state serving recompiled: "
                             f"{totals_after_warmup} -> {totals_after_load}")
@@ -1453,6 +1597,10 @@ def main_serve() -> None:
             out["error"] = ("steady-state MPMD pipeline serving "
                             "recompiled (per bucket x stage): "
                             f"{pipeline_recompiles}")
+        elif precision_recompiles:
+            out["error"] = ("steady-state QUANTIZED serving recompiled "
+                            "(per bucket x mode x precision): "
+                            f"{precision_recompiles}")
         elif drive_errors:
             out["error"] = (f"{len(drive_errors)} requests failed during "
                             f"the drive: {drive_errors[:3]}")

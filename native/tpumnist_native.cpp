@@ -12,6 +12,7 @@
 // must release with tm_free.
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -211,6 +212,62 @@ int tm_pad_copy(const float* src, int64_t rows, int64_t row, float* dst,
   return 0;
 }
 
+struct QuantCtx {
+  const float* in;
+  int8_t* out;
+  float inv_scale;
+};
+
+// float32 -> int8 symmetric quantization: q = clip(rne(x / scale), -127, 127),
+// multithreaded. nearbyintf under the default FE_TONEAREST mode rounds to
+// nearest EVEN — exactly NumPy's np.rint — so the fallback equivalence is
+// bitwise (pinned by tests/test_native.py). This is the int8-activation
+// serve staging hot path: the host quantizes the normalized batch before
+// the H2D transfer, quartering the staged bytes.
+int tm_quant_i8(const float* in, int8_t* out, int64_t n, float scale,
+                int workers) {
+  if (scale <= 0.0f) return -1;
+  QuantCtx ctx{in, out, 1.0f / scale};
+  parallel_for(
+      n, workers,
+      [](int64_t start, int64_t end, void* p) {
+        auto* c = static_cast<QuantCtx*>(p);
+        for (int64_t i = start; i < end; ++i) {
+          float q = nearbyintf(c->in[i] * c->inv_scale);
+          if (q != q) q = 0.0f;  // NaN -> 0 (static_cast of NaN is UB;
+                                 // the NumPy fallback pins the same 0)
+          if (q > 127.0f) q = 127.0f;   // +inf clips here
+          if (q < -127.0f) q = -127.0f; // -inf clips here
+          c->out[i] = static_cast<int8_t>(q);
+        }
+      },
+      &ctx);
+  return 0;
+}
+
+struct DequantCtx {
+  const int8_t* in;
+  float* out;
+  float scale;
+};
+
+// int8 -> float32 dequantization: x = float(q) * scale, multithreaded —
+// one f32 multiply per element, the same op sequence as the NumPy
+// fallback (astype(float32) * scale), so the equivalence is bitwise.
+int tm_dequant_f32(const int8_t* in, float* out, int64_t n, float scale,
+                   int workers) {
+  DequantCtx ctx{in, out, scale};
+  parallel_for(
+      n, workers,
+      [](int64_t start, int64_t end, void* p) {
+        auto* c = static_cast<DequantCtx*>(p);
+        for (int64_t i = start; i < end; ++i)
+          c->out[i] = static_cast<float>(c->in[i]) * c->scale;
+      },
+      &ctx);
+  return 0;
+}
+
 struct CastCtx {
   const double* in;
   float* out;
@@ -232,6 +289,6 @@ int tm_cast_f32(const double* in, float* out, int64_t n, int workers) {
   return 0;
 }
 
-int tm_version() { return 3; }
+int tm_version() { return 4; }
 
 }  // extern "C"

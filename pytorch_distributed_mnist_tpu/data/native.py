@@ -86,12 +86,15 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
     ]
     lib.tm_version.restype = ctypes.c_int
-    if lib.tm_version() < 3:
-        # A stale pre-v3 library is rejected WHOLE, not just its missing
+    if lib.tm_version() < 4:
+        # A stale library is rejected WHOLE, not just its missing
         # symbols: v3 rewrote tm_normalize to the fallback's exact f32 op
-        # sequence, so the old fused kernel is ~1ulp off the bits every
-        # trajectory/equivalence pin now asserts. Stale (pre-v3) ->
-        # fallback, per DESIGN.md 4b's matrix.
+        # sequence (the old fused kernel is ~1ulp off the bits every
+        # trajectory/equivalence pin asserts), and v4 added the
+        # quant/dequant entry points the int8 serving plane stages
+        # through — a partial surface would silently mix native and
+        # fallback behavior per call site. Stale (pre-v4) -> fallback,
+        # per DESIGN.md 4b's matrix.
         _lib = _MISSING
         return None
     # v3 entry points (serve dispatch path) — guaranteed present past
@@ -108,6 +111,19 @@ def _load():
     lib.tm_cast_f32.restype = ctypes.c_int
     lib.tm_cast_f32.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+    ]
+    # v4 entry points (int8 serving plane): activation quantization runs
+    # PER DISPATCHED BATCH on the serve hot path — same raw-pointer
+    # argtypes rationale as pad_copy/cast_f32 above.
+    lib.tm_quant_i8.restype = ctypes.c_int
+    lib.tm_quant_i8.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+        ctypes.c_int,
+    ]
+    lib.tm_dequant_f32.restype = ctypes.c_int
+    lib.tm_dequant_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+        ctypes.c_int,
     ]
     _lib = lib
     return lib
@@ -209,6 +225,43 @@ def pad_into(dst: np.ndarray, src: np.ndarray, workers: int = 4) -> bool:
     rc = lib.tm_pad_copy(src.ctypes.data, src.shape[0], row,
                          dst.ctypes.data, dst.shape[0], workers)
     return rc == 0
+
+
+def quant_i8(arr: np.ndarray, scale: float,
+             workers: int = 4) -> Optional[np.ndarray]:
+    """Native float32 -> int8 symmetric quantization:
+    ``clip(rint(x * (1/scale)), -127, 127)`` with round-to-nearest-even —
+    BITWISE-identical to the NumPy fallback (which must multiply by the
+    same precomputed f32 reciprocal, not divide; ``serve/programs.py``
+    does). None when the library is absent/old, the dtype/layout is
+    wrong, or the scale is not positive."""
+    lib = _load()
+    if lib is None:  # absent, unloadable, or pre-v4 (rejected whole)
+        return None
+    if arr.dtype != np.float32 or not arr.flags["C_CONTIGUOUS"]:
+        return None
+    if not (scale > 0.0):
+        return None
+    out = np.empty(arr.shape, np.int8)
+    rc = lib.tm_quant_i8(arr.ctypes.data, out.ctypes.data, arr.size,
+                         scale, workers)
+    return out if rc == 0 else None
+
+
+def dequant_f32(arr: np.ndarray, scale: float,
+                workers: int = 4) -> Optional[np.ndarray]:
+    """Native int8 -> float32 dequantization (``float(q) * scale``, the
+    NumPy fallback's exact op — bitwise-identical); None when the
+    library is absent/old or the dtype/layout is wrong."""
+    lib = _load()
+    if lib is None:  # absent, unloadable, or pre-v4 (rejected whole)
+        return None
+    if arr.dtype != np.int8 or not arr.flags["C_CONTIGUOUS"]:
+        return None
+    out = np.empty(arr.shape, np.float32)
+    rc = lib.tm_dequant_f32(arr.ctypes.data, out.ctypes.data, arr.size,
+                            scale, workers)
+    return out if rc == 0 else None
 
 
 def cast_f32(arr: np.ndarray, workers: int = 4) -> Optional[np.ndarray]:

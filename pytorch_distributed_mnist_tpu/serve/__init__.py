@@ -21,7 +21,15 @@ REQUESTS — the north-star's "serves heavy traffic" capability. Pieces:
   extensible), builds the serving mesh, derives param/input/output
   shardings from the training rule tables, and hands the engine a
   :class:`MeshPlacement` its bucket programs AOT-lower against, plus
-  the checkpoint parallel-layout gate (``check_checkpoint_layout``);
+  the checkpoint parallel-layout gate (``check_checkpoint_layout``)
+  and the PRECISION plane (``--serve-precision``: f32 / bf16 / int8w /
+  int8, extensible — install-time quantization with per-leaf scales as
+  program arguments, so hot reload stays an atomic swap);
+- ``canary.py``: :class:`ShadowCanary` — the shadow-traffic accuracy
+  canary gating a quantized precision: the f32 baseline answers while
+  a fraction of live batches shadows the quantized plane; promote
+  after clean rows, auto-rollback past the disagreement budget,
+  per-publish reset through the reload watcher;
 - ``pipeline.py``: :class:`PipelineEngine` — the MPMD plane for
   pipeline-trained checkpoints: one INDEPENDENT program per stage chip
   (stage params split at the training stage boundaries), micro-batches
@@ -38,21 +46,26 @@ Drive it with ``tools/loadgen.py``; measure it with
 """
 
 from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
+from pytorch_distributed_mnist_tpu.serve.canary import ShadowCanary
 from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
 from pytorch_distributed_mnist_tpu.serve.pipeline import PipelineEngine
 from pytorch_distributed_mnist_tpu.serve.pool import EnginePool, EngineReplica
 from pytorch_distributed_mnist_tpu.serve.programs import (
     SERVE_MODES,
+    SERVE_PRECISIONS,
     MeshPlacement,
+    ServePrecision,
     build_group_placements,
     build_placement,
     check_checkpoint_layout,
     servable_modes,
+    serve_precisions,
 )
 from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
 
 __all__ = [
     "SERVE_MODES",
+    "SERVE_PRECISIONS",
     "CheckpointWatcher",
     "EnginePool",
     "EngineReplica",
@@ -61,8 +74,11 @@ __all__ = [
     "MicroBatcher",
     "Overloaded",
     "PipelineEngine",
+    "ServePrecision",
+    "ShadowCanary",
     "build_group_placements",
     "build_placement",
     "check_checkpoint_layout",
     "servable_modes",
+    "serve_precisions",
 ]

@@ -39,6 +39,16 @@ Two data-plane mechanisms serve the multi-chip pool (``serve/pool.py``):
   ``logits_with_epoch`` is just dispatch immediately followed by
   complete, so the synchronous path cannot drift from the pipelined one.
 
+The ``precision=`` plane (``serve/programs.py``): a quantized precision
+wraps the forward (on-chip dequant/cast, pure jnp), turns ``_place``
+into quantize-then-commit (per-leaf symmetric scales computed once per
+install, OUTSIDE the lock, riding the quantized tree as ARGUMENTS of
+the compiled programs — hot reload still swaps a reference and
+recompiles nothing), and sets the staging dtype (the int8 plane stages
+and transfers int8, a quarter of the f32 bytes). ``f32`` — the default
+— resolves to the identity spec: every path below is byte-identical to
+the pre-precision engine.
+
 Staging-buffer lifecycle: padding a batch up to its bucket reuses a
 per-bucket float32 buffer from a free-list instead of allocating per
 batch. A buffer is acquired at dispatch, referenced by the in-flight
@@ -78,8 +88,13 @@ class StagingPool:
     code."""
 
     def __init__(self, buckets: Sequence[int],
-                 input_shape: Tuple[int, ...]) -> None:
+                 input_shape: Tuple[int, ...],
+                 dtype=np.float32) -> None:
         self.input_shape = tuple(input_shape)
+        # float32 everywhere except the int8-activation serving plane,
+        # whose staged batches (and H2D transfers) are int8 — a quarter
+        # of the bytes. The lifecycle is dtype-oblivious.
+        self.dtype = np.dtype(dtype)
         self._lock = threading.Lock()
         self._free: dict = {b: [] for b in buckets}
         self._allocated = {b: 0 for b in buckets}
@@ -93,7 +108,7 @@ class StagingPool:
             if free:
                 return free.pop()
             self._allocated[bucket] += 1
-        return np.zeros((bucket,) + self.input_shape, np.float32)
+        return np.zeros((bucket,) + self.input_shape, self.dtype)
 
     def release(self, buffers: List[Tuple[int, np.ndarray]]) -> None:
         with self._lock:
@@ -118,17 +133,21 @@ def stage_batch(images: np.ndarray, bucket: int, staging: StagingPool,
     the input. Shared by ``InferenceEngine`` and the per-stage MPMD
     plane so the staging bytes can never drift between them."""
     n = images.shape[0]
-    if (n == bucket and images.dtype == np.float32
+    if (n == bucket and images.dtype == staging.dtype
             and images.flags["C_CONTIGUOUS"]):
-        # Exact fit, already float32-contiguous: no pad, no copy — the
-        # array goes to the device as-is (bitwise-pinned equal to the
-        # padded path by the exactness tests).
+        # Exact fit, already contiguous at the staging dtype: no pad, no
+        # copy — the array goes to the device as-is (bitwise-pinned
+        # equal to the padded path by the exactness tests).
         return images
     buf = staging.acquire(bucket)
-    # Anything not already f32 C-contiguous goes straight to the
-    # fallback's one converting copy — a pre-conversion just to feed
-    # the native kernel would cost a second full-batch copy.
-    filled = (images.dtype == np.float32
+    # Anything not already C-contiguous at the staging dtype goes
+    # straight to the fallback's one converting copy — a pre-conversion
+    # just to feed the native kernel would cost a second full-batch
+    # copy. (The native pad kernel is f32-only; int8 staging pads via
+    # NumPy — a quarter of the bytes, so the copy it skips is smaller
+    # than the one the f32 kernel earns its keep on.)
+    filled = (staging.dtype == np.float32
+              and images.dtype == np.float32
               and images.flags["C_CONTIGUOUS"]
               and native.pad_into(buf, images, workers=workers))
     if not filled:
@@ -255,6 +274,7 @@ class InferenceEngine:
         name: Optional[str] = None,
         workers: int = 4,
         placement=None,
+        precision: Optional[str] = None,
     ) -> None:
         buckets = sorted({int(b) for b in buckets})
         if not buckets or buckets[0] < 1:
@@ -270,7 +290,18 @@ class InferenceEngine:
         self.device = device
         self.placement = placement
         self.name = name
-        self._forward = make_forward_program(apply_fn)
+        # The precision plane (serve/programs.py): f32 — the default —
+        # resolves to the identity spec and every path below stays
+        # byte-identical to the pre-precision engine. A quantized
+        # precision wraps the forward (dequant/cast in-program), turns
+        # _place into quantize-then-device_put, and sets the staging
+        # dtype (int8 activations stage as int8).
+        from pytorch_distributed_mnist_tpu.serve.programs import get_precision
+
+        self._precision_spec = get_precision(precision)
+        self.precision = self._precision_spec.name
+        self._forward = self._precision_spec.wrap_forward(
+            make_forward_program(apply_fn))
         if placement is not None:
             if device is not None:
                 raise ValueError(
@@ -297,13 +328,23 @@ class InferenceEngine:
         self._params_epoch = params_epoch
         self._compiled = {}  # bucket -> Compiled executable
         # bucket -> free staging buffers (see module docstring lifecycle).
-        self._staging = StagingPool(self.buckets, self.input_shape)
+        self._staging = StagingPool(self.buckets, self.input_shape,
+                                    dtype=self._precision_spec.input_dtype)
 
     def _place(self, tree):
         """Commit a PARAMS tree to this engine's device(s): the mesh
         placement's sharding tree on the sharded plane, the pinned
         device's ``SingleDeviceSharding`` on the pooled one, default
-        placement when unpinned."""
+        placement when unpinned.
+
+        On a quantized precision the tree is QUANTIZED first (per-leaf
+        symmetric scales, computed once per install, host-side) — this
+        runs from ``__init__`` and from ``swap_params`` BEFORE the lock
+        is taken, so quantization rides the same slow-part-outside-the-
+        lock discipline as the ``device_put`` it precedes, and the
+        installed reference swap stays what in-flight batches race
+        against."""
+        tree = self._precision_spec.quantize(tree, workers=self.workers)
         if self.placement is not None:
             return self.placement.place_params(tree)
         if self._sharding is not None:
@@ -353,7 +394,8 @@ class InferenceEngine:
             if bucket in self._compiled:
                 continue
             image_spec = jax.ShapeDtypeStruct(
-                (bucket,) + self.input_shape, np.float32)
+                (bucket,) + self.input_shape,
+                self._precision_spec.input_dtype)
             self._compiled[bucket] = precompile(
                 self._jit, params_spec, image_spec,
                 program=self.program_name(bucket))
@@ -441,6 +483,11 @@ class InferenceEngine:
         swap-atomicity boundary the synchronous path has. Batches larger
         than the top bucket are chunked through it."""
         x = self.preprocess(images)
+        # Host-side activation transform (int8 plane: quantize the whole
+        # normalized batch once with the fixed scale — native v4 kernel,
+        # bitwise NumPy fallback — BEFORE chunking/staging, so the
+        # staged buffers and the H2D transfers are int8).
+        x = self._precision_spec.stage_host(x, workers=self.workers)
         with self._lock:
             params = self._params  # captured ONCE: swap-atomicity boundary
             epoch = self._params_epoch

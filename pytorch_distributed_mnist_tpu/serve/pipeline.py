@@ -155,6 +155,7 @@ class PipelineEngine:
         params_epoch: Optional[int] = None,
         name: str = "pipeline",
         workers: int = 4,
+        precision: Optional[str] = None,
     ) -> None:
         devices = list(devices)
         if not devices:
@@ -169,7 +170,23 @@ class PipelineEngine:
         self.name = name
         self.n_stages = len(devices)
         self.devices = tuple(devices)
-        forwards = make_stage_forward_fns(model, self.n_stages)
+        # The precision plane, per stage: each stage's param slice
+        # quantizes independently (its own per-leaf scales), the FIRST
+        # stage consumes the host-staged input dtype (int8 activations),
+        # inter-stage D2D hops ride the precision's hop dtype (bf16
+        # stays bf16 — half the hop bytes), and only the LAST stage
+        # casts logits back to f32. f32 resolves to the identity spec:
+        # every path below is byte-identical to the pre-precision chain.
+        from pytorch_distributed_mnist_tpu.serve.programs import get_precision
+
+        self._precision_spec = get_precision(precision)
+        self.precision = self._precision_spec.name
+        forwards = [
+            self._precision_spec.wrap_stage_forward(
+                fwd, first=(k == 0), last=(k == self.n_stages - 1))
+            for k, fwd in enumerate(
+                make_stage_forward_fns(model, self.n_stages))
+        ]
         self._stages = [
             _StageProgram(k, fwd, dev, f"{name}.s{k}")
             for k, (fwd, dev) in enumerate(zip(forwards, devices))
@@ -177,14 +194,19 @@ class PipelineEngine:
         self._lock = threading.Lock()
         self._stage_params = self._place_stages(params)
         self._params_epoch = params_epoch
-        self._staging = StagingPool(self.buckets, self.input_shape)
+        self._staging = StagingPool(self.buckets, self.input_shape,
+                                    dtype=self._precision_spec.input_dtype)
 
     def _place_stages(self, params) -> List:
-        """Split the full pipelined tree by stage and commit each slice
-        to its stage's chip — stage k's weights live on ``devices[k]``
-        ONLY (the HBM story: no chip holds the whole model)."""
+        """Split the full pipelined tree by stage, quantize each slice
+        (per-stage scales — the split runs on the f32 tree the stage
+        boundaries are defined over), and commit each slice to its
+        stage's chip — stage k's weights live on ``devices[k]`` ONLY
+        (the HBM story: no chip holds the whole model)."""
         split = split_stage_params(params, self.n_stages)
-        return [jax.device_put(tree, stage.sharding)
+        return [jax.device_put(
+                    self._precision_spec.quantize(tree, workers=self.workers),
+                    stage.sharding)
                 for tree, stage in zip(split, self._stages)]
 
     # -- lifecycle ---------------------------------------------------------
@@ -210,7 +232,8 @@ class PipelineEngine:
         with self._lock:
             stage_params = list(self._stage_params)
         specs = {
-            b: jax.ShapeDtypeStruct((b,) + self.input_shape, np.float32)
+            b: jax.ShapeDtypeStruct((b,) + self.input_shape,
+                                    self._precision_spec.input_dtype)
             for b in self.buckets
         }
         for stage, params in zip(self._stages, stage_params):
@@ -277,6 +300,10 @@ class PipelineEngine:
         under the lock, once per batch — the cross-stage swap-atomicity
         boundary. Batches larger than the top bucket are chunked."""
         x = self.preprocess(images)
+        # Host-side activation transform (int8 plane: quantize once with
+        # the fixed scale before chunking — the staged buffers and the
+        # stage-0 H2D transfer are int8).
+        x = self._precision_spec.stage_host(x, workers=self.workers)
         with self._lock:
             stage_params = list(self._stage_params)  # captured ONCE
             epoch = self._params_epoch
@@ -334,7 +361,8 @@ class PipelineEngine:
         with self._lock:
             stage_params = list(self._stage_params)
         walls: dict = {}
-        x = np.zeros((bucket,) + self.input_shape, np.float32)
+        x = np.zeros((bucket,) + self.input_shape,
+                     self._precision_spec.input_dtype)
         x = jax.device_put(x, self._stages[0].sharding)
         for stage, params in zip(self._stages, stage_params):
             if stage.index:
@@ -379,7 +407,7 @@ def make_pipeline_template(model, rng):
 
 def pipeline_engine_factory(*, model, model_name, params, devices, name,
                             buckets, input_shape, serve_log, params_epoch,
-                            workers, apply_fn=None):
+                            workers, apply_fn=None, precision=None):
     """The registry's engine hook (``serve/programs.py`` registers mode
     ``pipeline`` with it): one pipeline CHAIN spanning ``devices``
     (stage k on chip k). Needs the model CONFIG, not just an apply_fn —
@@ -393,4 +421,4 @@ def pipeline_engine_factory(*, model, model_name, params, devices, name,
     return PipelineEngine(
         model, params, devices, buckets=buckets, input_shape=input_shape,
         serve_log=serve_log, params_epoch=params_epoch, name=name,
-        workers=workers)
+        workers=workers, precision=precision)

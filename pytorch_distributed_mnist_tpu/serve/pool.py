@@ -197,6 +197,7 @@ class EnginePool:
         quarantine_after: int = 3,
         auto_regroup: bool = True,
         regroup_retries: int = 3,
+        precision: Optional[str] = None,
     ) -> None:
         devices = list(devices) if devices is not None \
             else list(jax.local_devices())
@@ -221,6 +222,18 @@ class EnginePool:
         self.auto_regroup = auto_regroup
         self.regroup_retries = regroup_retries
         self._buckets = tuple(buckets)
+        # The precision plane (serve/programs.py): one precision per
+        # pool — every replica/group lowers its bucket programs at it,
+        # and the reload fan-out quantizes per engine from the ONE
+        # host-side f32 load (install-time quantization; _params_host
+        # stays the raw tree a regroup/resize rebuilds from). f32 (the
+        # default) resolves to the identity spec and changes nothing.
+        from pytorch_distributed_mnist_tpu.serve.programs import (
+            get_precision,
+        )
+
+        self._precision_spec = get_precision(precision)
+        self.precision = self._precision_spec.name
         if serve_mode != "replicated":
             from pytorch_distributed_mnist_tpu.serve.programs import (
                 staged_mode,
@@ -268,6 +281,7 @@ class EnginePool:
                 build_group_engine,
                 group_name,
                 partition_groups,
+                precision_engine_name,
                 validate_serve_mode,
             )
 
@@ -279,28 +293,35 @@ class EnginePool:
                                 mesh_size, params)
             groups = partition_groups(devices, mesh_size)
             for i, group in enumerate(groups):
-                name = group_name(self.serve_mode, i, len(groups))
+                name = precision_engine_name(
+                    group_name(self.serve_mode, i, len(groups)),
+                    self.precision)
                 engine = build_group_engine(
                     self.serve_mode, self.model_name, group, params, name,
                     apply_fn=self.apply_fn, buckets=self._buckets,
                     input_shape=self.input_shape, serve_log=self.serve_log,
                     params_epoch=params_epoch, workers=self.workers,
-                    model=self.model)
+                    model=self.model, precision=self.precision)
                 replicas.append(EngineReplica(
                     i, group[0], engine, name=name, devices=group))
         else:
+            from pytorch_distributed_mnist_tpu.serve.programs import (
+                precision_engine_name,
+            )
+
             if mesh_size != 1:
                 raise ValueError(
                     "replicated serving runs one engine per chip; a "
                     f"{mesh_size}-device mesh needs a sharded serve_mode")
             for i, device in enumerate(devices):
-                name = f"r{i}"
+                name = precision_engine_name(f"r{i}", self.precision)
                 engine = InferenceEngine(
                     self.apply_fn, params, buckets=self._buckets,
                     input_shape=self.input_shape, serve_log=self.serve_log,
                     params_epoch=params_epoch, device=device, name=name,
-                    workers=self.workers)
-                replicas.append(EngineReplica(i, device, engine))
+                    workers=self.workers, precision=self.precision)
+                replicas.append(EngineReplica(
+                    i, device, engine, name=name))
         return replicas
 
     def _build_group_engine(self, devices: Tuple, name: str, params,
@@ -318,12 +339,12 @@ class EnginePool:
                 name, apply_fn=self.apply_fn, buckets=self._buckets,
                 input_shape=self.input_shape, serve_log=self.serve_log,
                 params_epoch=params_epoch, workers=self.workers,
-                model=self.model)
+                model=self.model, precision=self.precision)
         return InferenceEngine(
             self.apply_fn, params, buckets=self._buckets,
             input_shape=self.input_shape, serve_log=self.serve_log,
             params_epoch=params_epoch, device=devices[0], name=name,
-            workers=self.workers)
+            workers=self.workers, precision=self.precision)
 
     # -- engine-compatible surface ----------------------------------------
 
@@ -395,6 +416,23 @@ class EnginePool:
                 self._params_host = params
                 self._params_host_epoch = epoch
             replicas = [r for r in self.replicas if not r.quarantined]
+        if stale:
+            # Every replica serves (at least) the host epoch already and
+            # would refuse this install per its own ordering rule — skip
+            # the fan-out AND the quantization pass it would pay for.
+            return 0
+        # Quantize ONCE per publish, not once per replica: the engines'
+        # install-time quantize is idempotent (QuantLeaf nodes pass
+        # through), so fanning the pre-quantized tree out saves
+        # (replicas - 1) full host-side quantization passes per reload.
+        # Engine-factory (staged) modes are exempt — their engines
+        # quantize PER STAGE SLICE after splitting, and the split runs
+        # on the f32 tree the stage boundaries are defined over.
+        # _params_host stays the RAW tree: regroup/resize rebuild paths
+        # derive placements from it, which speaks the f32 layout.
+        if not self.staged:
+            params = self._precision_spec.quantize(params,
+                                                   workers=self.workers)
         installed = 0
         for replica in replicas:
             if replica.engine.swap_params(params, epoch=epoch, path=path):
@@ -689,6 +727,7 @@ class EnginePool:
         topo = {
             "topology_generation": self._topology_generation,
             "serve_mode": self.serve_mode,
+            "serve_precision": self.precision,
             "serve_devices": self.n_devices,
             "mesh_devices": self.mesh_size,
             "groups": len(self.replicas),

@@ -38,13 +38,34 @@ A sharded engine SPANS its mesh devices, so the pool partitions local
 chips into mesh GROUPS (``build_group_placements``) instead of
 one-replica-per-device: 8 chips at ``--serve-mesh 2`` = 4 two-chip
 engines behind the same least-loaded dispatcher.
+
+**The precision plane** (``--serve-precision``; ``SERVE_PRECISIONS``,
+extensible via :func:`register_precision`) is the registry's second
+axis, orthogonal to the mode axis above: every bucket x mode pair can
+lower at ``f32`` (the default — byte-identical to the pre-precision
+engine), ``bf16`` (weights stored bfloat16; compute follows the
+model's own compute-dtype policy — bf16 on the TPU default), ``int8w``
+(weight-only int8: per-leaf symmetric scales, weights dequantized
+on-chip, f32 compute), or ``int8`` (int8w plus int8 activations: the
+HOST quantizes the staged batch with the fixed normalize-range scale —
+quartering the H2D bytes — and the program dequantizes on-chip). Quantization happens at param-INSTALL time
+(:meth:`ServePrecision.quantize`, host-side, outside every engine
+lock): the per-leaf scales are computed once per publish and stored
+alongside the int8 values in :class:`QuantLeaf` pytree nodes, so the
+quantized tree — scales included — remains an ARGUMENT of every
+compiled program (never a baked constant: a new publish's scales must
+not recompile anything) and hot-reload stays the same atomic reference
+swap. ``CompileLog`` names gain the precision suffix
+(``serve_forward_b{b}@{mode}.{prec}``; f32 keeps the historical names).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_mnist_tpu.parallel.expert import moe_ep_rules
@@ -197,6 +218,271 @@ def _get_mode(mode: str) -> ServeMode:
         ) from None
 
 
+# -- the precision plane -----------------------------------------------------
+
+F32 = "f32"
+
+
+class QuantLeaf(NamedTuple):
+    """One int8-quantized param leaf: the int8 values (original shape)
+    and the f32 symmetric scale, TOGETHER as one pytree node — so the
+    scale rides the quantized tree through ``device_put``, the sharding
+    derivation, and into the compiled programs as an ARGUMENT. Baking a
+    publish's scales into the lowered program as constants would force a
+    recompile per hot reload (the recompile-hazard the analyzer fixtures
+    encode); keeping them leaf-shaped keeps reload a reference swap."""
+
+    q: object  # int8 values, the original leaf's shape
+    s: object  # f32 scalar scale (dequant: q.astype(f32) * s)
+
+
+def _act_scale() -> np.float32:
+    """The FIXED int8 activation scale: normalized MNIST pixels live in
+    the closed, data-independent range ``[(0-mean)/std, (1-mean)/std]``
+    (max |x| at pixel 255), so one symmetric scale covers every request
+    — no per-batch calibration, no per-batch scale argument, nothing
+    that could vary a compiled program's inputs. Computed in f32 ops so
+    the host quantizer and the on-chip dequant agree bitwise."""
+    from pytorch_distributed_mnist_tpu.data.mnist import MNIST_MEAN, MNIST_STD
+
+    max_abs = ((np.float32(1.0) - np.float32(MNIST_MEAN))
+               / np.float32(MNIST_STD))
+    return np.float32(max_abs / np.float32(127.0))
+
+
+ACT_SCALE = _act_scale()
+
+
+def _quant_i8_host(x: np.ndarray, scale: np.float32,
+                   workers: int) -> np.ndarray:
+    """The ONE host-side f32 -> int8 quantizer (weight leaves and the
+    int8 activation staging both go through here): the native v4
+    ``tm_quant_i8`` kernel when built, else the bitwise-identical NumPy
+    expression — both round-to-nearest-even after multiplying by the
+    SAME precomputed f32 reciprocal (never a division: divide vs
+    multiply-by-reciprocal round differently, and the native-vs-
+    fallback equivalence is pinned bitwise)."""
+    from pytorch_distributed_mnist_tpu.data import native
+
+    x = np.ascontiguousarray(x, np.float32)
+    q = native.quant_i8(x, float(scale), workers=workers)
+    if q is None:
+        inv = np.float32(1.0) / scale
+        scaled = np.rint(x * inv)
+        # NaN -> 0 explicitly (astype(int8) of NaN is platform-defined,
+        # and the native kernel pins 0); ±inf clip like any overflow.
+        scaled = np.where(np.isnan(scaled), np.float32(0.0), scaled)
+        q = np.clip(scaled, -127, 127).astype(np.int8)
+    return q
+
+
+def quantize_leaf_i8(leaf, workers: int = 4) -> QuantLeaf:
+    """Symmetric per-leaf int8 quantization (host-side, install-time):
+    ``scale = max|leaf| / 127``, ``q = clip(rne(leaf / scale), ±127)``
+    via :func:`_quant_i8_host`. An all-zero leaf gets scale 1.0
+    (quantizes to zeros either way)."""
+    x = np.ascontiguousarray(np.asarray(leaf), np.float32)
+    max_abs = float(np.max(np.abs(x))) if x.size else 0.0
+    scale = np.float32(max_abs) / np.float32(127.0) \
+        if max_abs > 0.0 else np.float32(1.0)
+    return QuantLeaf(q=_quant_i8_host(x, scale, workers), s=scale)
+
+
+def dequantize_params(tree):
+    """In-program dequantization of a :meth:`ServePrecision.quantize`'d
+    tree: every :class:`QuantLeaf` becomes its f32 leaf (``q * s``),
+    everything else passes through. Pure jnp ops — this runs INSIDE the
+    jitted bucket programs, on tracers."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.q.astype(jnp.float32) * leaf.s
+        if isinstance(leaf, QuantLeaf) else leaf,
+        tree, is_leaf=lambda x: isinstance(x, QuantLeaf))
+
+
+def _floating_leaf(leaf) -> bool:
+    return jnp.issubdtype(jnp.result_type(leaf), jnp.floating)
+
+
+class ServePrecision:
+    """One registered serving precision: how params quantize at install
+    time, how the forward program transforms, and what dtype the staged
+    activations ride.
+
+    The hooks the engines call:
+
+    - ``quantize(params, workers)`` — host-side, once per param install
+      (boot, hot reload, regroup), OUTSIDE every engine lock: the slow
+      part rides the same slow-part-outside-the-lock discipline as the
+      ``device_put`` it precedes.
+    - ``wrap_forward(forward)`` — the full-model program transform
+      (dequantize weights / cast activations / cast logits back to f32
+      so ``complete()`` stays precision-agnostic).
+    - ``wrap_stage_forward(forward, first, last)`` — the MPMD per-stage
+      transform: the first stage consumes the host-staged input dtype,
+      inter-stage D2D hops ride ``hop_dtype`` (bf16 stays bf16; the
+      int8 plane hops bf16 — half the hop bytes; re-quantizing
+      activations per boundary would need per-publish calibration), and
+      only the last stage casts logits back to f32.
+    - ``stage_host(images, workers)`` — host-side activation transform
+      before staging (int8: native ``tm_quant_i8`` with the fixed
+      normalize-range scale; the staged batch and the H2D transfer are
+      int8, a quarter of the f32 bytes).
+    - ``expand_shardings(params, shardings, replicated)`` — the sharded
+      plane's tree expansion: a :class:`QuantLeaf`'s values shard
+      exactly as the f32 leaf would, its scalar scale replicates.
+
+    ``f32`` is the identity on every hook — the engines' default path
+    stays byte-identical to the pre-precision plane."""
+
+    def __init__(self, name: str, *, weight_cast=None, int8_weights=False,
+                 int8_activations=False, act_cast=None,
+                 hop_dtype=None) -> None:
+        self.name = name
+        self.weight_cast = weight_cast  # host-side dtype cast (bf16)
+        self.int8_weights = int8_weights
+        self.int8_activations = int8_activations
+        self.act_cast = act_cast  # in-program activation dtype (bf16)
+        self.hop_dtype = hop_dtype if hop_dtype is not None else act_cast
+        self.input_dtype = np.int8 if int8_activations else np.float32
+
+    @property
+    def identity(self) -> bool:
+        """True only for f32: every hook is a no-op and the engines take
+        their historical code paths bit-for-bit."""
+        return not (self.weight_cast is not None or self.int8_weights
+                    or self.int8_activations or self.act_cast is not None)
+
+    def quantize(self, params, workers: int = 4):
+        """IDEMPOTENT by design: a pool quantizes ONCE per publish and
+        fans the quantized tree to its engines, whose ``_place`` runs
+        quantize again — already-``QuantLeaf`` nodes pass through (an
+        unguarded tree_map would descend into them and 'quantize' the
+        f32 scale leaves), already-cast bf16 leaves re-cast copy-free."""
+        if self.int8_weights:
+            return jax.tree_util.tree_map(
+                lambda leaf: leaf if isinstance(leaf, QuantLeaf)
+                else (quantize_leaf_i8(leaf, workers)
+                      if _floating_leaf(leaf) else leaf),
+                params, is_leaf=lambda x: isinstance(x, QuantLeaf))
+        if self.weight_cast is not None:
+            cast = self.weight_cast
+            return jax.tree_util.tree_map(
+                lambda leaf: np.asarray(leaf).astype(cast, copy=False)
+                if _floating_leaf(leaf) else leaf, params)
+        return params
+
+    def wrap_forward(self, forward):
+        if self.identity:
+            return forward
+        spec = self
+
+        def precision_forward(params, images):
+            x = images
+            if spec.int8_activations:
+                x = x.astype(jnp.float32) * ACT_SCALE
+            if spec.act_cast is not None:
+                x = x.astype(spec.act_cast)
+            p = dequantize_params(params) if spec.int8_weights else params
+            return forward(p, x).astype(jnp.float32)
+
+        return precision_forward
+
+    def wrap_stage_forward(self, forward, first: bool, last: bool):
+        if self.identity:
+            return forward
+        spec = self
+
+        def stage_forward(params, x):
+            if first:
+                if spec.int8_activations:
+                    x = x.astype(jnp.float32) * ACT_SCALE
+                if spec.act_cast is not None:
+                    x = x.astype(spec.act_cast)
+            else:
+                # The hop arrived at hop_dtype; restore the compute dtype.
+                x = x.astype(spec.act_cast if spec.act_cast is not None
+                             else jnp.float32)
+            p = dequantize_params(params) if spec.int8_weights else params
+            y = forward(p, x)
+            if last:
+                return y.astype(jnp.float32)
+            return y.astype(spec.hop_dtype) \
+                if spec.hop_dtype is not None else y
+
+        return stage_forward
+
+    def stage_host(self, images: np.ndarray, workers: int = 4) -> np.ndarray:
+        if not self.int8_activations:
+            return images
+        return _quant_i8_host(images, ACT_SCALE, workers)
+
+    def expand_shardings(self, params, shardings, replicated):
+        if not self.int8_weights:
+            return shardings
+        return jax.tree_util.tree_map(
+            lambda leaf, sh: QuantLeaf(q=sh, s=replicated)
+            if _floating_leaf(leaf) else sh,
+            params, shardings)
+
+
+_PRECISIONS: Dict[str, ServePrecision] = {}
+
+
+def register_precision(spec: ServePrecision) -> ServePrecision:
+    """Register a serving precision (the extension point mirroring
+    :func:`register_serve_mode`: a new quantization scheme becomes a
+    ``--serve-precision`` choice and a bench sweep column by adding one
+    :class:`ServePrecision`, no engine/pool/server change)."""
+    if spec.name in _PRECISIONS:
+        raise ValueError(f"serve precision {spec.name!r} already registered")
+    _PRECISIONS[spec.name] = spec
+    return spec
+
+
+register_precision(ServePrecision(F32))
+# bf16 stores the WEIGHTS in bfloat16 (half the HBM at rest, half the
+# reload bytes); the compute dtype stays the MODEL's own policy — the
+# models already cast per-layer to their compute_dtype (bf16 by default
+# on TPU, the training --dtype flag), so forcing activations from
+# outside would fight that policy (and break e.g. the ViT block scan,
+# whose carry dtype the model owns). On the TPU-default models this IS
+# full bf16 inference; on a --dtype f32 model it is weight-only bf16.
+register_precision(ServePrecision("bf16", weight_cast=jnp.bfloat16))
+register_precision(ServePrecision("int8w", int8_weights=True))
+register_precision(ServePrecision(
+    "int8", int8_weights=True, int8_activations=True,
+    hop_dtype=jnp.bfloat16))
+
+
+def serve_precisions() -> List[str]:
+    """Every registered precision, ``f32`` first (the default)."""
+    return [F32] + sorted(n for n in _PRECISIONS if n != F32)
+
+
+def get_precision(name: Optional[str]) -> ServePrecision:
+    """The registered :class:`ServePrecision` for ``name`` (``None``
+    means f32), raising with the registry's vocabulary for unknown
+    names."""
+    try:
+        return _PRECISIONS[name or F32]
+    except KeyError:
+        raise ValueError(
+            f"unknown serve precision {name!r}; registered: "
+            f"{serve_precisions()}"
+        ) from None
+
+
+def precision_engine_name(name: Optional[str],
+                          precision: Optional[str]) -> Optional[str]:
+    """Compose an engine/CompileLog name with its precision suffix —
+    ``serve_forward_b{b}@{mode}.{prec}`` per the registry contract. f32
+    keeps the historical (suffix-free) names, so every pre-precision
+    compile-stats pin and recompile verdict is untouched."""
+    if not precision or precision == F32:
+        return name
+    return f"{name}.{precision}" if name else precision
+
+
 class MeshPlacement:
     """How one sharded engine commits params and lowers its programs.
 
@@ -294,13 +580,21 @@ def validate_serve_mode(mode: str, model_name: str, mesh_devices: int,
 
 
 def build_placement(mode: str, model_name: str, devices: Sequence,
-                    params, name: Optional[str] = None) -> MeshPlacement:
+                    params, name: Optional[str] = None,
+                    precision: Optional[str] = None) -> MeshPlacement:
     """Mesh + sharding derivation for ONE engine spanning ``devices``.
 
     ``name`` defaults to the mode itself, giving the ISSUE-specified
     ``serve_forward_b{b}@{mode}`` CompileLog names on a single-group
     plane; multi-group pools pass ``{mode}.g{i}`` so compile stats and
     the zero-recompile verdicts stay attributable per group.
+
+    ``precision``: the sharding derivation always walks the RAW f32
+    param tree (the rule tables speak the training layout), then
+    :meth:`ServePrecision.expand_shardings` maps the result onto the
+    quantized tree the engine will actually install — a
+    :class:`QuantLeaf`'s int8 values shard exactly as the f32 leaf
+    would (same shape), its scalar scale replicates over the mesh.
     """
     devices = list(devices)
     validate_serve_mode(mode, model_name, len(devices), params)
@@ -310,6 +604,8 @@ def build_placement(mode: str, model_name: str, devices: Sequence,
     param_shardings = jax.tree_util.tree_map_with_path(
         lambda path, _: NamedSharding(mesh, leaf_spec(path, rules)), params
     )
+    param_shardings = get_precision(precision).expand_shardings(
+        params, param_shardings, NamedSharding(mesh, P()))
     return MeshPlacement(mode, mesh, param_shardings, name or mode)
 
 
@@ -377,28 +673,33 @@ def build_group_placements(mode: str, model_name: str, devices: Sequence,
 def build_group_engine(mode: str, model_name: str, devices: Sequence,
                        params, name: str, *, apply_fn, buckets,
                        input_shape, serve_log, params_epoch, workers,
-                       model=None):
+                       model=None, precision: Optional[str] = None):
     """ONE engine spanning ``devices`` for ``mode`` — the single builder
     the pool's boot, regroup, and resize paths all share, which is what
     keeps a registered mode's engine construction from drifting between
     them. SPMD modes get the default ``MeshPlacement`` +
     ``InferenceEngine`` lowering; a mode with an ``engine_factory``
-    (MPMD pipeline) builds its own engine behind the same surface."""
+    (MPMD pipeline) builds its own engine behind the same surface.
+    ``name`` arrives with its precision suffix already composed
+    (:func:`precision_engine_name`); ``precision`` selects the program/
+    quantization plane."""
     spec = _get_mode(mode)
     if spec.engine_factory is not None:
         return spec.engine_factory(
             model=model, model_name=model_name, apply_fn=apply_fn,
             params=params, devices=list(devices), name=name,
             buckets=buckets, input_shape=input_shape, serve_log=serve_log,
-            params_epoch=params_epoch, workers=workers)
+            params_epoch=params_epoch, workers=workers,
+            precision=precision)
     from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
 
     placement = build_placement(mode, model_name, list(devices), params,
-                                name=name)
+                                name=name, precision=precision)
     return InferenceEngine(
         apply_fn, params, buckets=buckets, input_shape=input_shape,
         serve_log=serve_log, params_epoch=params_epoch,
-        placement=placement, name=name, workers=workers)
+        placement=placement, name=name, workers=workers,
+        precision=precision)
 
 
 def check_checkpoint_layout(layout: Optional[dict], mode: str,
@@ -463,7 +764,9 @@ register_serve_mode(
     staged=True,
 )
 
-# Import-time snapshot for docs/tests; anything validating a mode must
-# call serve_modes()/_get_mode (the live registry) so modes registered
-# after import — the extension seam — are honored.
+# Import-time snapshots for docs/tests; anything validating a mode or
+# precision must call serve_modes()/serve_precisions() (the live
+# registries) so entries registered after import — the extension seam —
+# are honored.
 SERVE_MODES = serve_modes()
+SERVE_PRECISIONS = serve_precisions()

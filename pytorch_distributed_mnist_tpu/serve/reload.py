@@ -19,7 +19,9 @@ swap, so the in-flight batch finishes on the old params and the next
 batch sees the new ones. The callback owns whatever fan-out the data
 plane needs — per replica on a pool, per STAGE inside an MPMD pipeline
 chain (``serve/pipeline.py`` splits and installs all stages under one
-lock, so a batch never spans two epochs across stages). Failures are contained: a corrupt or vanished checkpoint is
+lock, so a batch never spans two epochs across stages), and to BOTH
+planes of a shadow canary (``serve/canary.py`` additionally resets the
+promotion cycle, so every publish re-earns its quantized precision). Failures are contained: a corrupt or vanished checkpoint is
 recorded (``serve_reload_failed`` in the stats/JSONL stream) and the
 server keeps answering on the params it has — serving availability never
 depends on the newest file being readable.

@@ -55,7 +55,12 @@ from pytorch_distributed_mnist_tpu.serve.engine import (
     InferenceEngine,
     load_params_for_serving,
 )
-from pytorch_distributed_mnist_tpu.serve.programs import serve_modes
+from pytorch_distributed_mnist_tpu.serve.canary import ShadowCanary
+from pytorch_distributed_mnist_tpu.serve.programs import (
+    precision_engine_name,
+    serve_modes,
+    serve_precisions,
+)
 from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
 from pytorch_distributed_mnist_tpu.utils.profiling import (
     JsonlSink,
@@ -120,6 +125,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "--serve-devices; the pool then runs one "
                         "spanning engine per group. Ignored (must be "
                         "left 0) in replicated mode")
+    # choices read the LIVE precision registry (register_precision is
+    # the documented extension seam, mirroring --serve-mode's).
+    p.add_argument("--serve-precision", type=str, default="f32",
+                   choices=serve_precisions(),
+                   help="numeric precision of the serving programs "
+                        "(serve/programs.py precision plane): 'f32' is "
+                        "the full-precision default; 'bf16' stores "
+                        "weights bfloat16 (compute follows the model's "
+                        "--dtype policy); 'int8w' "
+                        "quantizes weights to int8 (per-leaf symmetric "
+                        "scales, dequantized on-chip, f32 compute); "
+                        "'int8' additionally stages activations as "
+                        "int8 (a quarter of the H2D bytes). "
+                        "Quantization happens at param-install time, "
+                        "so hot reload stays an atomic swap. Composes "
+                        "with every --serve-mode")
+    p.add_argument("--canary-fraction", type=float, default=0.0,
+                   help="shadow-traffic accuracy canary: serve replies "
+                        "from the f32 BASELINE while this fraction of "
+                        "live batches also runs the --serve-precision "
+                        "plane in shadow; argmax disagreements and "
+                        "logit deltas accumulate in /stats, the "
+                        "precision PROMOTES to primary after "
+                        "--canary-promote-after clean rows and AUTO-"
+                        "ROLLS-BACK (permanent for that publish; the "
+                        "server keeps serving) past --canary-budget. "
+                        "0 (default) trusts --serve-precision outright "
+                        "and serves it directly; requires a quantized "
+                        "--serve-precision when set")
+    p.add_argument("--canary-promote-after", type=int, default=200,
+                   help="canary: shadowed rows (images) that must "
+                        "compare within budget before the quantized "
+                        "plane is promoted to primary")
+    p.add_argument("--canary-budget", type=float, default=0.02,
+                   help="canary: allowed argmax-disagreement fraction "
+                        "of the promotion window (budget x promote-"
+                        "after rows; shadow-plane errors count); "
+                        "exceeding it rolls the publish back")
     p.add_argument("--quarantine-after", type=int, default=3,
                    help="serve-pool self-healing threshold: this many "
                         "CONSECUTIVE dispatch/completion failures on one "
@@ -202,9 +245,12 @@ class ServeContext:
                  model_name: str, boot_path: Optional[str] = None,
                  max_request_images: int = 1024, pool=None,
                  max_inflight: int = 1,
-                 serve_mode: str = "replicated") -> None:
+                 serve_mode: str = "replicated",
+                 serve_precision: str = "f32", canary=None) -> None:
         self.max_request_images = max_request_images
         self.serve_mode = serve_mode
+        self.serve_precision = serve_precision
+        self.canary = canary
         self.engine = engine
         self.pool = pool
         self.max_inflight = max_inflight
@@ -280,6 +326,15 @@ class _Handler(BaseHTTPRequestHandler):
             stats["buckets"] = list(ctx.engine.buckets)
             stats["model_epoch"] = ctx.engine.params_epoch
             stats["serve_mode"] = ctx.serve_mode
+            # Always present (like serve_mode): what precision the
+            # serving programs lower at — loadgen's report and the
+            # --expect-precision smoke read it.
+            stats["serve_precision"] = ctx.serve_precision
+            if ctx.canary is not None:
+                # The shadow-canary block: state machine position,
+                # sampling shape, disagreement counters, logit-delta
+                # quantiles (serve/canary.py::snapshot).
+                stats["canary"] = ctx.canary.snapshot()
             if ctx.pool is not None:
                 stats["serve_devices"] = ctx.pool.n_devices
                 stats["max_inflight"] = ctx.max_inflight
@@ -389,6 +444,17 @@ class _Handler(BaseHTTPRequestHandler):
                          "with --serve-devices/--max-inflight/"
                          "--serve-mode (the default single-engine "
                          "server has no pool to re-shape)"})
+            return
+        if ctx.canary is not None:
+            # A resize mid-canary would re-shape only the baseline pool
+            # while the candidate keeps the old topology — the two
+            # planes' capacity (and failure surface) would silently
+            # diverge under the comparison. Deliberately refused.
+            self._reply(400, {
+                "error": "resize is not supported while a precision "
+                         "canary is active (--canary-fraction); the "
+                         "baseline and shadow planes must keep the "
+                         "same topology — restart to change it"})
             return
         length = int(self.headers.get("Content-Length", 0))
         if length > MAX_BODY_BYTES:
@@ -544,6 +610,31 @@ def create_server(args) -> ThreadingHTTPServer:
     except ValueError as exc:
         raise SystemExit(str(exc)) from None
 
+    # Precision plane + canary shape (argparse choices already bound
+    # --serve-precision to the live registry): a canary only makes
+    # sense shadowing a QUANTIZED plane against the f32 baseline.
+    serve_precision = getattr(args, "serve_precision", "f32") or "f32"
+    canary_fraction = float(getattr(args, "canary_fraction", 0.0) or 0.0)
+    canary_promote_after = int(getattr(args, "canary_promote_after", 200))
+    canary_budget = float(getattr(args, "canary_budget", 0.02))
+    if canary_fraction:
+        if serve_precision == "f32":
+            raise SystemExit(
+                "--canary-fraction shadows a quantized plane against "
+                "the f32 baseline; pass a quantized --serve-precision "
+                f"({serve_precisions()[1:]}) or drop the flag")
+        if not (0.0 < canary_fraction <= 1.0):
+            raise SystemExit(
+                f"--canary-fraction {canary_fraction}: must be in "
+                f"(0, 1]")
+        if canary_promote_after < 1:
+            raise SystemExit(
+                f"--canary-promote-after {canary_promote_after}: must "
+                f"be >= 1")
+        if canary_budget < 0:
+            raise SystemExit(
+                f"--canary-budget {canary_budget}: must be >= 0")
+
     # Boot restore walks newest -> oldest: one corrupt latest file must
     # not turn a server RESTART (the natural operator response to any
     # incident) into a total outage — the same availability stance the
@@ -643,17 +734,63 @@ def create_server(args) -> ThreadingHTTPServer:
 
     t0 = time.perf_counter()
     pool = None
-    if pooled:
-        from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
+    canary = None
 
-        pool = EnginePool(
-            model.apply, params, devices=devices[:n_devices],
-            buckets=_parse_buckets(args.buckets), serve_log=serve_log,
-            params_epoch=epoch, workers=getattr(args, "workers", 4),
-            serve_mode=serve_mode, mesh_size=mesh_size,
-            model_name=args.model, model=model,
-            quarantine_after=getattr(args, "quarantine_after", 3),
+    def _make_plane(precision: str):
+        """ONE data plane at ``precision`` over the resolved shape —
+        the single builder both the direct path and the canary's two
+        planes go through, so they cannot drift."""
+        if pooled:
+            from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
+
+            return EnginePool(
+                model.apply, params, devices=devices[:n_devices],
+                buckets=_parse_buckets(args.buckets), serve_log=serve_log,
+                params_epoch=epoch, workers=getattr(args, "workers", 4),
+                serve_mode=serve_mode, mesh_size=mesh_size,
+                model_name=args.model, model=model,
+                quarantine_after=getattr(args, "quarantine_after", 3),
+                precision=precision,
+            )
+        return InferenceEngine(
+            model.apply, params, buckets=_parse_buckets(args.buckets),
+            serve_log=serve_log, params_epoch=epoch,
+            workers=getattr(args, "workers", 4), precision=precision,
+            name=precision_engine_name(None, precision),
         )
+
+    if canary_fraction:
+        # Shadow canary: the f32 BASELINE answers, the quantized
+        # candidate shadows --canary-fraction of batches; both planes
+        # AOT-warm before the socket opens. /stats' topology block and
+        # /resize talk to the baseline pool (the plane answering by
+        # default); the candidate heals itself through the same pool
+        # machinery.
+        baseline = _make_plane("f32")
+        candidate = _make_plane(serve_precision)
+        pool = baseline if pooled else None
+        if pooled and serve_log is not None:
+            # Each pool registers its per-replica probe at construction;
+            # the candidate (built second) would otherwise own /stats'
+            # replica rows. The BASELINE answers by default — its rows
+            # are the ones the probe should show.
+            serve_log.set_replicas_probe(baseline.snapshot)
+        canary = ShadowCanary(
+            baseline, candidate, serve_precision,
+            fraction=canary_fraction, promote_after=canary_promote_after,
+            budget=canary_budget, serve_log=serve_log)
+        engine = canary
+        canary.warmup()
+        batcher = MicroBatcher(
+            None, max_batch=canary.max_batch,
+            max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
+            serve_log=serve_log,
+            dispatch_fn=canary.dispatch,
+            complete_fn=lambda handle: _tag(*canary.predict_complete(handle)),
+            max_inflight=max_inflight,
+        ).start()
+    elif pooled:
+        pool = _make_plane(serve_precision)
         engine = pool
         pool.warmup()
         batcher = MicroBatcher(
@@ -665,11 +802,7 @@ def create_server(args) -> ThreadingHTTPServer:
             max_inflight=max_inflight,
         ).start()
     else:
-        engine = InferenceEngine(
-            model.apply, params, buckets=_parse_buckets(args.buckets),
-            serve_log=serve_log, params_epoch=epoch,
-            workers=getattr(args, "workers", 4),
-        )
+        engine = _make_plane(serve_precision)
         engine.warmup()
 
         def infer(images):
@@ -683,7 +816,13 @@ def create_server(args) -> ThreadingHTTPServer:
     stats = compile_log.stats()["programs"]
     compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
                       if name.startswith("serve_forward_"))
-    if sharded and staged_mode(serve_mode):
+    if canary is not None:
+        plane = (f"f32 baseline + {serve_precision} shadow canary "
+                 f"(fraction {canary_fraction}, promote after "
+                 f"{canary_promote_after} rows, budget {canary_budget})"
+                 + (f" x {n_devices} device(s), {serve_mode}"
+                    if pooled else ""))
+    elif sharded and staged_mode(serve_mode):
         plane = (f"MPMD {serve_mode}: {n_groups} chain(s) x "
                  f"{mesh_size} per-chip stage programs x "
                  f"{len(engine.buckets)} buckets, in-flight window "
@@ -697,6 +836,8 @@ def create_server(args) -> ThreadingHTTPServer:
                  f"buckets, in-flight window {max_inflight}")
     else:
         plane = f"{len(engine.buckets)} bucket programs"
+    if serve_precision != "f32" and canary is None:
+        plane = f"{serve_precision} {plane}"
     print(f"AOT-compiled {plane} "
           f"{list(engine.buckets)} in {time.perf_counter() - t0:.1f}s "
           f"(compile wall {compiled_ms:.0f} ms); steady-state serving "
@@ -727,7 +868,8 @@ def create_server(args) -> ThreadingHTTPServer:
         engine, batcher, watcher, serve_log, sink, args.model,
         boot_path=boot_path,
         max_request_images=getattr(args, "max_request_images", 1024),
-        pool=pool, max_inflight=max_inflight, serve_mode=serve_mode)
+        pool=pool, max_inflight=max_inflight, serve_mode=serve_mode,
+        serve_precision=serve_precision, canary=canary)
     return httpd
 
 

@@ -659,12 +659,14 @@ class ServeLog:
                             "detail": detail, "source": source})
 
     def record_pool_event(self, kind: str, **fields) -> None:
-        """Sink-only pool lifecycle line (``serve_quarantine`` /
-        ``serve_regroup`` / ``serve_resize``): the counters live in the
-        pool's ``topology()`` block (surfaced via ``/stats`` only when
-        pooled), so the single-engine snapshot schema stays untouched —
-        this just lands the event in the shared ``--metrics-file``
-        stream next to the reloads it rides with."""
+        """Sink-only serve lifecycle line (``serve_quarantine`` /
+        ``serve_regroup`` / ``serve_resize``, and the shadow canary's
+        ``serve_canary`` promote/rollback/reset transitions): the
+        counters live in the pool's ``topology()`` / the canary's
+        ``snapshot()`` blocks (surfaced via ``/stats``), so the
+        single-engine snapshot schema stays untouched — this just lands
+        the event in the shared ``--metrics-file`` stream next to the
+        reloads it rides with."""
         with self._lock:
             sink, source = self._sink, self._source
         if sink is not None:

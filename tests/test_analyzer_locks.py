@@ -774,3 +774,64 @@ def test_pipeline_module_clean_and_in_lock_graph():
     graph = result.reports["lock-discipline"]["lock_graph"]
     module = graph["pytorch_distributed_mnist_tpu/serve/pipeline.py"]
     assert "PipelineEngine._lock" in module["locks"]
+
+
+# -- the quantize plane (ISSUE 14) -------------------------------------------
+
+
+def test_fires_on_quantize_and_place_under_engine_lock():
+    """Install-time quantization is the SLOW part of a quantized swap
+    (per-leaf max reductions + the device_put that follows): doing it
+    under the engine lock stalls every dispatch's params capture for
+    the whole quantize+transfer."""
+    src = """
+import threading, jax
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def swap_params(self, params):
+        with self._lock:
+            quantized = self.spec.quantize(params)
+            self._params = jax.device_put(quantized)
+"""
+    (f,) = _findings(src)
+    assert "device_put" in f.message and "Engine._lock" in f.message
+
+
+def test_silent_on_quantize_then_install_under_lock():
+    """The shipped shape (serve/engine.py::_place from swap_params):
+    quantize + device_put OUTSIDE the lock, the reference swap alone
+    under it."""
+    src = """
+import threading, jax
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def swap_params(self, params):
+        quantized = self.spec.quantize(params)
+        placed = jax.device_put(quantized)
+        with self._lock:
+            self._params = placed
+"""
+    assert _findings(src) == []
+
+
+def test_canary_module_clean_and_in_lock_graph():
+    """ISSUE 14: the shadow canary mutates its counters/state under one
+    lock with every dispatch enqueue, completion fetch, and event
+    emission OUTSIDE it — clean under lock-discipline, and its lock is
+    a graph node that never nests with the engine/pool locks."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve",
+                      "canary.py")],
+        checkers=["lock-discipline", "trace-purity"],
+        baseline=None)
+    assert result.findings == []
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    canary = graph["pytorch_distributed_mnist_tpu/serve/canary.py"]
+    assert canary["locks"] == ["ShadowCanary._lock"]
+    assert canary["order_edges"] == []

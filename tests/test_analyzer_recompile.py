@@ -281,3 +281,35 @@ def make_serve_forward(apply_fn, mode, rules, shardings):
     return jax.jit(forward, in_shardings=shardings, out_shardings=None)
 """
     assert _findings(src) == []
+
+
+# -- the quantize plane (ISSUE 14) -------------------------------------------
+
+
+def test_fires_on_scale_constant_into_quantized_bucket_program():
+    """The precision plane's cardinal hazard: a PER-PUBLISH quantization
+    scale baked into a compiled bucket program as a literal — every hot
+    reload's new scales would re-key (recompile) every bucket program.
+    Scales must ride the quantized tree as ARGUMENTS."""
+    src = """
+class QuantEngine:
+    def warm(self, fn, qparams_spec, image_spec):
+        self._fwd = precompile(fn, qparams_spec, image_spec, program="q")
+
+    def infer(self, qvalues, staged):
+        return self._fwd(qvalues, staged, 0.0078125)
+"""
+    (f,) = _findings(src)
+    assert f.symbol.endswith("infer") and "argument 2" in f.message
+
+
+def test_silent_on_scales_as_arguments_of_the_bucket_program():
+    """The shipped shape: the quantized tree — int8 values AND their
+    f32 scales — is one pytree argument of the compiled program; a new
+    publish swaps the argument, never the executable."""
+    src = """
+def serve(fn, qparams_spec, image_spec, qparams, staged):
+    exe = precompile(fn, qparams_spec, image_spec, program="fwd")
+    return exe(qparams, staged)
+"""
+    assert _findings(src) == []

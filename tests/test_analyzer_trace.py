@@ -348,3 +348,40 @@ def build(apply_fn, params, mesh_devices, shardings):
     return jax.jit(forward, in_shardings=shardings, out_shardings=None)
 """
     assert _findings(src) == []
+
+
+# -- the quantize plane (ISSUE 14) -------------------------------------------
+
+
+def test_fires_on_host_concretization_on_the_quant_path():
+    """Dequantization inside a jitted forward must be jnp ops on the
+    tracer: pulling the scale out with .item()/float() concretizes a
+    traced param (and would silently bake one publish's scale into the
+    program)."""
+    src = """
+import jax
+
+def make_quant_forward(forward):
+    def quant_forward(qparams, x):
+        scale = qparams.item()
+        return forward(qparams * scale, x)
+    return jax.jit(quant_forward)
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "quant_forward" and ".item()" in f.message
+
+
+def test_silent_on_jnp_dequant_inside_jitted_forward():
+    """The shipped shape: dequant is pure jnp arithmetic on the traced
+    quantized leaves (astype + multiply), trace-clean."""
+    src = """
+import jax
+import jax.numpy as jnp
+
+def make_quant_forward(forward):
+    def quant_forward(q, s, x):
+        params = q.astype(jnp.float32) * s
+        return forward(params, x)
+    return jax.jit(quant_forward)
+"""
+    assert _findings(src) == []

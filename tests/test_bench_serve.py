@@ -111,3 +111,32 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
     # Per bucket x stage compile rows landed under the @pipeline names.
     assert any("@pipeline.s0" in name for name in programs)
     assert any("@pipeline.s1" in name for name in programs)
+
+    # The precision sweep (ISSUE 14): one entry per registered
+    # quantized precision with the ABBA-paired vs-f32 ratio, the
+    # eval-batch agreement/accuracy deltas, and the per bucket x mode x
+    # precision zero-recompile verdicts; CPU runs carry the BENCH_r05-
+    # style caveat (host int8 says little about the TPU MXU/ICI).
+    sweep = report["precision_sweep"]
+    assert "CPU fallback" in sweep["caveat"]
+    assert "MXU" in sweep["caveat"]
+    assert isinstance(sweep["f32_accuracy"], float)
+    for prec in ("bf16", "int8w", "int8"):
+        block = sweep[prec]
+        assert block["vs_f32"] > 0 and len(block["pairs"]) == 4
+        assert block["requests_per_sec"] > 0
+        assert 0.9 <= block["argmax_agreement_vs_f32"] <= 1.0
+        assert isinstance(block["accuracy_delta_vs_f32"], float)
+        assert block["max_logit_delta_vs_f32"] >= 0
+        assert block["zero_steady_state_recompiles"] is True
+    # Every registered mode x quantized precision got a verdict (the
+    # LIVE registry, engine-factory modes included).
+    modes = sweep["modes"]
+    for mode in ("tensor", "expert", "pipeline"):
+        for prec in ("bf16", "int8w", "int8"):
+            assert modes[f"{mode}.{prec}"][
+                "zero_steady_state_recompiles"] is True
+    # Per bucket x precision compile rows landed under the .{prec} names.
+    assert any(name.endswith("@bf16") for name in programs)
+    assert any("@tensor.int8w" in name for name in programs)
+    assert any("@pipeline.int8.s0" in name for name in programs)

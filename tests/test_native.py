@@ -47,15 +47,20 @@ def built_library():
 
 
 def test_version():
-    # v3 added the serve-dispatch entry points (tm_pad_copy, tm_cast_f32).
-    assert native._load().tm_version() == 3
+    # v3 added the serve-dispatch entry points (tm_pad_copy,
+    # tm_cast_f32); v4 the int8 serving plane's quant/dequant
+    # (tm_quant_i8, tm_dequant_f32).
+    assert native._load().tm_version() == 4
 
 
-def test_stale_pre_v3_library_rejected(monkeypatch):
-    """A pre-v3 .so (TPU_MNIST_NATIVE_LIB override, or a never-re-made
-    build) must be rejected WHOLE: its fused tm_normalize is ~1ulp off
-    the bits every equivalence/trajectory pin now asserts, so stale ->
-    fallback, per DESIGN.md 4b's matrix."""
+@pytest.mark.parametrize("stale_version", [2, 3])
+def test_stale_library_rejected_whole(monkeypatch, stale_version):
+    """A stale .so (TPU_MNIST_NATIVE_LIB override, or a never-re-made
+    build) must be rejected WHOLE: a pre-v3 fused tm_normalize is ~1ulp
+    off the bits every equivalence/trajectory pin asserts, and a pre-v4
+    library lacks the quant/dequant entry points the int8 serving plane
+    stages through — a partial surface would silently mix native and
+    fallback per call site. Stale -> fallback, per DESIGN.md 4b."""
     class _Sym:
         def __init__(self, ret=None):
             self._ret = ret
@@ -66,9 +71,9 @@ def test_stale_pre_v3_library_rejected(monkeypatch):
     class _StubLib:
         def __init__(self):
             for name in ("tm_idx_load", "tm_free", "tm_normalize",
-                         "tm_gather"):
+                         "tm_gather", "tm_pad_copy", "tm_cast_f32"):
                 setattr(self, name, _Sym())
-            self.tm_version = _Sym(2)
+            self.tm_version = _Sym(stale_version)
 
     monkeypatch.setattr(native, "_find_library", lambda: "stub.so")
     monkeypatch.setattr(native.ctypes, "CDLL", lambda path: _StubLib())
@@ -237,6 +242,60 @@ def test_cast_f32_rejects_other_dtypes():
         np.zeros((2, 8), np.float64)[:, ::2]) is None  # non-contiguous
 
 
+def test_quant_i8_matches_numpy_bitwise():
+    """float32 -> int8 symmetric quantization: the native kernel's
+    round-to-nearest-even via the precomputed f32 reciprocal is
+    BITWISE-identical to the NumPy fallback expression the serving
+    plane uses (serve/programs.py) — which engine quantized a batch can
+    never show up in the logits."""
+    rng = np.random.default_rng(12)
+    arr = (rng.normal(size=(65, 28, 28, 1)) * 2.5).astype(np.float32)
+    scale = np.float32(np.abs(arr).max() / np.float32(127.0))
+    got = native.quant_i8(arr, float(scale), workers=4)
+    inv = np.float32(1.0) / scale
+    want = np.clip(np.rint(arr * inv), -127, 127).astype(np.int8)
+    np.testing.assert_array_equal(got, want)
+    # Ties (x/scale exactly .5) round to even in both engines.
+    half = (np.arange(-8, 8, dtype=np.float32) + np.float32(0.5))
+    got_half = native.quant_i8(half, 1.0, workers=1)
+    want_half = np.clip(np.rint(half), -127, 127).astype(np.int8)
+    np.testing.assert_array_equal(got_half, want_half)
+
+
+def test_quant_i8_non_finite_pinned():
+    """NaN quantizes to 0 and ±inf clips to ±127 in BOTH engines
+    (static_cast of NaN is UB in C; NaN.astype(int8) is platform-
+    defined in NumPy — both paths pin the same explicit values, so a
+    client-supplied non-finite pixel can't make the engines diverge)."""
+    x = np.array([np.nan, np.inf, -np.inf, 1.0], np.float32)
+    got = native.quant_i8(x, 1.0, workers=1)
+    np.testing.assert_array_equal(got, np.array([0, 127, -127, 1],
+                                                np.int8))
+
+
+def test_quant_i8_rejects_bad_inputs():
+    assert native.quant_i8(np.zeros((2, 2), np.float64), 1.0) is None
+    assert native.quant_i8(np.zeros((2, 2), np.float32), 0.0) is None
+    assert native.quant_i8(np.zeros((2, 2), np.float32), -1.0) is None
+    assert native.quant_i8(
+        np.zeros((2, 8), np.float32)[:, ::2], 1.0) is None  # non-contiguous
+
+
+def test_dequant_f32_matches_numpy_bitwise():
+    """int8 -> float32 dequantization (q * scale) is one f32 multiply
+    per element in both engines — bitwise-identical."""
+    q = np.arange(-127, 128, dtype=np.int8).reshape(5, 51)
+    scale = np.float32(0.0123)
+    got = native.dequant_f32(q, float(scale), workers=2)
+    want = q.astype(np.float32) * scale
+    np.testing.assert_array_equal(got.view(np.uint32), want.view(np.uint32))
+
+
+def test_dequant_f32_rejects_other_dtypes():
+    assert native.dequant_f32(np.zeros((2, 2), np.uint8), 1.0) is None
+    assert native.dequant_f32(np.zeros((2, 2), np.float32), 1.0) is None
+
+
 def test_tpumnist_native_zero_disables_library(monkeypatch):
     """TPUMNIST_NATIVE=0 is the explicit in-process fallback switch the
     input bench uses to time the NumPy path with the library present."""
@@ -246,6 +305,8 @@ def test_tpumnist_native_zero_disables_library(monkeypatch):
     assert native.cast_f32(np.zeros((2, 2), np.float64)) is None
     assert not native.pad_into(np.zeros((4, 2), np.float32),
                                np.zeros((2, 2), np.float32))
+    assert native.quant_i8(np.zeros((2, 2), np.float32), 1.0) is None
+    assert native.dequant_f32(np.zeros((2, 2), np.int8), 1.0) is None
     monkeypatch.delenv("TPUMNIST_NATIVE")
     monkeypatch.setattr(native, "_lib", None)
     assert native.available()
@@ -327,8 +388,9 @@ def test_library_builds_from_source(tmp_path):
                    capture_output=True)
     lib = ctypes.CDLL(str(build / "libtpumnist_native.so"))
     lib.tm_version.restype = ctypes.c_int
-    assert lib.tm_version() == 3
-    for sym in ("tm_pad_copy", "tm_cast_f32", "tm_normalize", "tm_gather"):
+    assert lib.tm_version() == 4
+    for sym in ("tm_pad_copy", "tm_cast_f32", "tm_normalize", "tm_gather",
+                "tm_quant_i8", "tm_dequant_f32"):
         assert hasattr(lib, sym)
 
 

@@ -130,6 +130,12 @@ from pytorch_distributed_mnist_tpu.runtime.supervision import (  # noqa: E402
 # tests/test_serve_heal_server.py).
 SERVE_FAULT_ENV = "TPUMNIST_SERVE_FAULT"
 
+# serve/canary.py::CANARY_FAULT_ENV, spelled out for the same
+# jax-import-free reason (pinned equal by tests/test_serve_canary.py):
+# the --canary-rollback twin sets it to "disagree" so every shadow
+# comparison fails the budget.
+CANARY_FAULT_ENV = "TPUMNIST_CANARY_FAULT"
+
 # parallel/mesh.py::DCN_SLICES_ENV, spelled out for the same
 # jax-import-free reason (pinned equal by tests/test_hier_mesh.py).
 DCN_SLICES_ENV = "TPUMNIST_DCN_SLICES"
@@ -190,6 +196,14 @@ def run_serve_chaos(args) -> int:
         env[SERVE_FAULT_ENV] = args.serve_fault
     else:
         env.pop(SERVE_FAULT_ENV, None)
+    if args.canary_rollback:
+        # Rehearse the rollback-under-traffic scenario: every shadow
+        # comparison is injected to disagree, so the canary must roll
+        # back while loadgen hammers — and still answer EVERY request
+        # from the baseline (zero drops is the twin's bar).
+        env[CANARY_FAULT_ENV] = "disagree"
+    else:
+        env.pop(CANARY_FAULT_ENV, None)
     if args.cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"
         flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
@@ -211,6 +225,18 @@ def run_serve_chaos(args) -> int:
            "--max-wait-ms", "2", "--poll-interval", "1"]
     if args.serve_mesh:
         cmd += ["--serve-mesh", str(args.serve_mesh)]
+    serve_precision = args.serve_precision
+    if args.canary_rollback and not serve_precision:
+        serve_precision = "bf16"  # the canary needs a quantized plane
+    if serve_precision:
+        cmd += ["--serve-precision", serve_precision]
+    if args.canary_rollback:
+        # Fraction 1.0 shadows every batch; a huge promotion window and
+        # a zero budget make the injected disagreement the only
+        # possible transition.
+        cmd += ["--canary-fraction", "1.0",
+                "--canary-promote-after", "100000",
+                "--canary-budget", "0.0"]
     _say(f"booting serve twin: {' '.join(cmd)}"
          + (f" [{SERVE_FAULT_ENV}={args.serve_fault}]"
             if args.serve_fault else ""))
@@ -263,6 +289,19 @@ def run_serve_chaos(args) -> int:
             return 1
         _say(f"loadgen: {args.requests}/{args.requests} answered, zero "
              f"drops")
+
+        if args.canary_rollback:
+            # The injected disagreement must have rolled the publish
+            # back — with the baseline still answering everything.
+            stats = _get_json(url, "/stats")
+            can = stats.get("canary") or {}
+            if can.get("state") != "rolled_back":
+                _say(f"expected canary state rolled_back under injected "
+                     f"disagreement, got {can.get('state')!r}")
+                return 1
+            _say(f"canary rolled back ({can.get('disagreed_rows')} "
+                 f"disagreeing rows of {can.get('compared_rows')} "
+                 f"compared); baseline kept serving, zero drops")
 
         # Wait for the pool to finish healing (quarantine -> regroup),
         # then assert the final topology with the loadgen smoke gate.
@@ -398,6 +437,19 @@ def main(argv=None) -> int:
     p.add_argument("--serve-mesh", type=int, default=0,
                    help="serve twin: chips per mesh group / stages per "
                         "pipeline chain (0 = server default)")
+    p.add_argument("--serve-precision", type=str, default=None,
+                   help="serve twin: --serve-precision handed to the "
+                        "server (f32/bf16/int8w/int8 — the quantized "
+                        "serving plane under chaos; defaults to the "
+                        "server's f32)")
+    p.add_argument("--canary-rollback", action="store_true",
+                   help="serve twin: rehearse the shadow-canary "
+                        "rollback-under-traffic scenario — boot with "
+                        "--canary-fraction 1.0 and an injected "
+                        f"disagreement ({CANARY_FAULT_ENV}=disagree), "
+                        "assert the canary rolls back while EVERY "
+                        "loadgen request is still answered (implies "
+                        "--serve-precision bf16 unless given)")
     p.add_argument("--serve-model", type=str, default="linear",
                    help="serve twin: --model for the server (sharded/"
                         "staged modes need their model family, e.g. "
@@ -439,8 +491,10 @@ def main(argv=None) -> int:
         args.resize_targets = [int(t) for t in
                                (args.resize or "").split(",") if t.strip()]
         return run_serve_chaos(args)
-    if args.resize or args.serve_fault:
-        raise SystemExit("--serve-fault/--resize are serve-plane twins; "
+    if args.resize or args.serve_fault or args.serve_precision \
+            or args.canary_rollback:
+        raise SystemExit("--serve-fault/--resize/--serve-precision/"
+                         "--canary-rollback are serve-plane twins; "
                          "add --serve")
 
     if args.dcn_slices:

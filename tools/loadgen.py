@@ -214,6 +214,12 @@ def main(argv=None) -> int:
                         "--serve-devices data plane) whose batch counts "
                         "sum to the server's batch total; 0 skips the "
                         "check")
+    p.add_argument("--expect-precision", type=str, default=None,
+                   help="smoke: additionally require /stats to report "
+                        "this serve_precision (e.g. 'bf16' — the "
+                        "quantized --serve-precision plane; the report "
+                        "always carries serve_precision, and the "
+                        "canary block when a shadow canary is active)")
     p.add_argument("--expect-mode", type=str, default=None,
                    help="smoke: additionally require /stats to report "
                         "this serve_mode (e.g. 'tensor' — the sharded "
@@ -257,7 +263,8 @@ def main(argv=None) -> int:
     # otherwise best-effort — a server predating the fields (or an
     # unreachable /stats) just omits them.
     def _shape_fields(stats: dict) -> None:
-        for key in ("serve_mode", "serve_devices", "mesh_devices",
+        for key in ("serve_mode", "serve_precision", "canary",
+                    "serve_devices", "mesh_devices",
                     "mesh_groups", "pipeline_stages", "max_inflight",
                     "topology_generation", "groups", "active_groups",
                     "quarantined_groups", "slice_straddling_groups"):
@@ -302,6 +309,15 @@ def main(argv=None) -> int:
                     and len(replicas) == args.expect_replicas
                     and sum(r.get("batches", 0) for r in replicas.values())
                     == stats.get("batches")
+                )
+            if args.expect_precision:
+                # The quantized plane really is the requested one:
+                # /stats names the serving precision (always present on
+                # precision-aware servers).
+                smoke_ok = (
+                    smoke_ok
+                    and stats.get("serve_precision")
+                    == args.expect_precision
                 )
             if args.expect_mode:
                 # The sharded data plane really is the requested one:
