@@ -1548,6 +1548,192 @@ def main_serve() -> None:
                 "only the schema, the accuracy/agreement deltas, and "
                 "the zero-recompile verdicts are meaningful here")
 
+        # -- overload (ISSUE 15): goodput vs offered load, 1x..10x of
+        # measured capacity, through the PRIORITY batcher (shed policy
+        # attached, mixed interactive/batch/best_effort traffic).
+        # Shed-not-collapse, measured not asserted: the block FAILS the
+        # bench (exit 1) when goodput at 10x drops below 70% of the
+        # curve's peak (the classic signature of queueing collapse —
+        # capacity spent on requests nobody will wait for) or when
+        # interactive p99 is not strictly below batch p99 under
+        # overload (the whole point of priority ordering + per-class
+        # watermarks). Open-loop on purpose: a closed-loop driver slows
+        # with the server and cannot overload anything.
+        import random as _random
+
+        from pytorch_distributed_mnist_tpu.serve.control import (
+            AutoScaler,
+            ShedPolicy,
+        )
+
+        overload_seconds = float(os.environ.get(
+            "BENCH_OVERLOAD_SECONDS", "2.0"))
+        overload_points = [int(t) for t in os.environ.get(
+            "BENCH_OVERLOAD_POINTS", "1,2,5,10").split(",") if t.strip()]
+        overload_mix = (("interactive", 0.6), ("batch", 0.9),
+                       ("best_effort", 1.0))  # cumulative
+        overload_failures: list = []
+        capacity_rps = requests / wall  # the headline closed-loop rate
+        overload_block: dict = {
+            "capacity_rps": round(capacity_rps, 1),
+            "seconds_per_point": overload_seconds,
+            "mix": {"interactive": 0.6, "batch": 0.3, "best_effort": 0.1},
+            "watermarks": dict(ShedPolicy().watermarks),
+            "points": [],
+        }
+
+        def _drive_open(mult: int) -> dict:
+            """One open-loop point: offer ``mult`` x capacity for
+            ``overload_seconds`` straight into a fresh priority
+            batcher, then drain. Per-class completions/sheds/latency
+            come from the drive's own ServeLog."""
+            olog = ServeLog(window_s=30.0)
+            rng = _random.Random(1000 + mult)
+            rate = capacity_rps * mult
+            pendings = []
+            offered = 0
+            # max_batch BELOW max_queue on purpose: a saturated queue
+            # must drain over several engine batches for priority order
+            # to mean anything — at max_batch >= max_queue the whole
+            # queue rides one forward and every class shares one wall.
+            with MicroBatcher(engine.predict, max_batch=16,
+                              max_wait_s=0.002, max_queue=64,
+                              serve_log=olog,
+                              shed_policy=ShedPolicy()) as ob:
+                t_start = time.perf_counter()
+                i = 0
+                while True:
+                    t_next = t_start + i / rate
+                    now = time.perf_counter()
+                    if t_next - t_start >= overload_seconds:
+                        break
+                    if t_next - now > 1e-3:
+                        time.sleep(t_next - now)
+                    r = rng.random()
+                    klass = next(k for k, cum in overload_mix
+                                 if r <= cum)
+                    offered += 1
+                    try:
+                        pendings.append(ob.submit(
+                            stacks[i % len(stacks)], klass=klass))
+                    except Exception:  # noqa: BLE001 - shed IS the point
+                        pass
+                    i += 1
+                for p in pendings:
+                    p.event.wait(30.0)
+            snap = olog.snapshot()
+            classes = {
+                klass: {
+                    "completed": rec["requests"],
+                    "shed": rec["shed"],
+                    "p50_ms": rec["latency_ms"]["p50"],
+                    "p99_ms": rec["latency_ms"]["p99"],
+                }
+                for klass, rec in snap.get("classes", {}).items()
+            }
+            return {
+                "offered_x": mult,
+                "offered_rps": round(offered / overload_seconds, 1),
+                "completed": snap["requests"],
+                "shed": snap["rejected"],
+                "goodput_rps": round(snap["requests"] / overload_seconds,
+                                     1),
+                "classes": classes,
+            }
+
+        for mult in overload_points:
+            overload_block["points"].append(_drive_open(mult))
+        peak_goodput = max(pt["goodput_rps"]
+                           for pt in overload_block["points"])
+        top = overload_block["points"][-1]
+        overload_block["peak_goodput_rps"] = peak_goodput
+        overload_block["goodput_at_top_fraction_of_peak"] = round(
+            top["goodput_rps"] / max(peak_goodput, 1e-9), 3)
+        goodput_holds = top["goodput_rps"] >= 0.7 * peak_goodput
+        overload_block["goodput_holds_at_overload"] = goodput_holds
+        if not goodput_holds:
+            overload_failures.append(
+                f"goodput collapsed under overload: "
+                f"{top['goodput_rps']} rps at "
+                f"{top['offered_x']}x vs peak {peak_goodput} rps "
+                f"(< 70%)")
+        inter = top["classes"].get("interactive", {})
+        batch_c = top["classes"].get("batch", {})
+        tail_ordered = (inter.get("completed", 0) > 0
+                        and batch_c.get("completed", 0) > 0
+                        and inter["p99_ms"] < batch_c["p99_ms"])
+        overload_block["interactive_p99_below_batch_p99"] = tail_ordered
+        if not tail_ordered:
+            overload_failures.append(
+                f"priority inversion under overload: interactive p99 "
+                f"{inter.get('p99_ms')}ms vs batch p99 "
+                f"{batch_c.get('p99_ms')}ms at {top['offered_x']}x "
+                f"(interactive must stay strictly below, with both "
+                f"classes completing)")
+
+        # Autoscaler actuation verdict: a real controller drives the
+        # pool's resize path up then down (synthetic breach/calm
+        # samples — this is the ACTUATION under test, not the sensor),
+        # and the steady state AFTER the resizes must not recompile:
+        # the acceptance criterion "zero steady-state recompiles across
+        # autoscaler resizes".
+        autoscale_block: dict = {}
+        if n_devices >= 2:
+            as_pool = EnginePool(model.apply, state.params,
+                                 devices=jax.local_devices()[:1])
+            as_pool.warmup()
+            feed = {"p95_ms": 0.0, "queue_depth": 0}
+            scaler = AutoScaler(
+                as_pool, lambda: dict(feed), slo_p95_ms=50.0,
+                queue_high=48, max_devices=2, cooldown_s=0.0,
+                down_after=2, interval_s=60.0)
+            feed["p95_ms"] = 500.0  # breach: scale 1 -> 2
+            up = scaler.tick()
+            feed["p95_ms"] = 1.0  # sustained calm: scale 2 -> 1
+            scaler.tick()
+            down = scaler.tick()
+            resized_ok = (up is not None and "error" not in up
+                          and down is not None and "error" not in down
+                          and as_pool.n_devices == 1)
+            before_as = _serve_program_compiles()
+            drive_pool(as_pool, window=2, requests_n=64, reps=1,
+                       fixed_shape=True)
+            delta_as = _recompile_delta(before_as,
+                                        _serve_program_compiles())
+            autoscale_block = {
+                "resizes": [up, down],
+                "actuated": resized_ok,
+                "zero_steady_state_recompiles_across_resizes":
+                    not delta_as,
+            }
+            if not resized_ok:
+                overload_failures.append(
+                    f"autoscaler actuation failed: up={up} down={down} "
+                    f"pool at {as_pool.n_devices} device(s)")
+            if delta_as:
+                overload_failures.append(
+                    f"steady-state serving recompiled across "
+                    f"autoscaler resizes: {delta_as}")
+        else:
+            autoscale_block["skipped"] = (
+                "single-device world: an autoscaler resize needs >= 2 "
+                "chips")
+        overload_block["autoscale"] = autoscale_block
+        if device.platform != "tpu":
+            overload_block["caveat"] = (
+                "CPU fallback (the BENCH_r05 convention): absolute "
+                "capacity is the host's, not the chip's — the CURVE "
+                "shape (goodput held at 10x, interactive < batch p99) "
+                "and the recompile verdicts are the meaningful part "
+                "here")
+        if os.environ.get("BENCH_OVERLOAD_INJECT_FAIL"):
+            # Test hook: pin the fails-loudly path without needing a
+            # real collapse (mirrors BENCH_ZERO_INJECT_RECOMPILE).
+            overload_failures.append(
+                "BENCH_OVERLOAD_INJECT_FAIL set: injected overload "
+                "verdict failure")
+            overload_block["goodput_holds_at_overload"] = False
+
         value = requests / wall
         out.update({
             "value": round(value, 1),
@@ -1566,6 +1752,7 @@ def main_serve() -> None:
             "sharded": sharded_block,
             "pipeline_serving": pipeline_block,
             "precision_sweep": precision_block,
+            "overload": overload_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
             "pool_requests": pool_requests,
@@ -1583,8 +1770,12 @@ def main_serve() -> None:
         served_all = snap["requests"] == 2 * requests  # best-of-2 drives
         ok = (zero_recompiles and not drive_errors and served_all
               and not recompiled_replicas and not sharded_recompiles
-              and not pipeline_recompiles and not precision_recompiles)
-        if not zero_recompiles:
+              and not pipeline_recompiles and not precision_recompiles
+              and not overload_failures)
+        if overload_failures:
+            out["error"] = ("overload block failed: "
+                            + "; ".join(overload_failures))
+        elif not zero_recompiles:
             out["error"] = ("steady-state serving recompiled: "
                             f"{totals_after_warmup} -> {totals_after_load}")
         elif recompiled_replicas:

@@ -38,8 +38,17 @@ REQUESTS — the north-star's "serves heavy traffic" capability. Pieces:
 - ``reload.py``: :class:`CheckpointWatcher` — polls a published
   checkpoint directory (``train/checkpoint.py`` conventions) and swaps
   params atomically between batches (fanned out per replica on a pool);
+- ``control.py``: the CONTROL PLANE above the data plane — priority
+  classes with per-class shed watermarks (:class:`ShedPolicy`),
+  per-client token-bucket quotas (:class:`ClientQuotas`, 429 before a
+  queue slot is spent), the SLO-driven :class:`AutoScaler` actuating
+  the pool's resize path with hysteresis + cooldown, and the
+  :class:`WeightedFairGate` sharing one chip budget across a
+  ``--model-set`` of models;
 - ``server.py``: the ``serve`` CLI subcommand — a stdlib HTTP JSON
-  endpoint with ``/predict``, ``/healthz``, ``/stats``.
+  endpoint with ``/predict``, ``/healthz``, ``/stats``, ``/resize``
+  (one model plane per ``--model-set`` entry, requests routed on their
+  ``model`` field).
 
 Drive it with ``tools/loadgen.py``; measure it with
 ``python bench.py --mode serve``.
@@ -47,6 +56,14 @@ Drive it with ``tools/loadgen.py``; measure it with
 
 from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
 from pytorch_distributed_mnist_tpu.serve.canary import ShadowCanary
+from pytorch_distributed_mnist_tpu.serve.control import (
+    PRIORITY_CLASSES,
+    AutoScaler,
+    ClientQuotas,
+    ShedPolicy,
+    TokenBucket,
+    WeightedFairGate,
+)
 from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
 from pytorch_distributed_mnist_tpu.serve.pipeline import PipelineEngine
 from pytorch_distributed_mnist_tpu.serve.pool import EnginePool, EngineReplica
@@ -64,9 +81,15 @@ from pytorch_distributed_mnist_tpu.serve.programs import (
 from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
 
 __all__ = [
+    "PRIORITY_CLASSES",
     "SERVE_MODES",
     "SERVE_PRECISIONS",
+    "AutoScaler",
     "CheckpointWatcher",
+    "ClientQuotas",
+    "ShedPolicy",
+    "TokenBucket",
+    "WeightedFairGate",
     "EnginePool",
     "EngineReplica",
     "InferenceEngine",

@@ -16,6 +16,19 @@ bound, a stalled or slow engine converts overload into unbounded queue
 growth and minutes-long latency for every request already in line, which
 is strictly worse than telling new arrivals to back off.
 
+With a :class:`~pytorch_distributed_mnist_tpu.serve.control.ShedPolicy`
+attached, overload additionally becomes a POLICY instead of a coin
+flip: each submit carries a priority class, the queue is priority-
+ORDERED (``interactive`` ahead of ``batch`` ahead of ``best_effort``,
+FIFO within a class), and each class has an admission watermark — a
+fraction of ``max_queue`` past which THAT class is shed while more
+urgent classes are still admitted. The raised :class:`Overloaded`
+carries ``retry_after_s`` derived from the completion stage's measured
+drain rate, so the 503 tells the client when capacity plausibly
+exists. Without a policy (the default), every request is the default
+class at watermark 1.0 and behavior is byte-identical to the
+pre-policy batcher.
+
 The worker is split into two stages. The **form/dispatch** stage
 coalesces a batch and hands it to ``dispatch_fn`` — which, against the
 engine/pool two-phase API, stages + pads the batch and ENQUEUES the
@@ -47,20 +60,39 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from pytorch_distributed_mnist_tpu.serve.control import (
+    DrainRate,
+    PRIORITY_CLASSES,
+    priority_rank,
+)
+
 
 class Overloaded(RuntimeError):
-    """Admission control: the request queue is at capacity; back off."""
+    """Admission control: the request queue is at capacity (or past this
+    priority class's shed watermark); back off. ``retry_after_s`` (when
+    known) is the drain-rate-derived hint the HTTP 503 forwards as
+    ``Retry-After``."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class _Pending:
     """One submitted request riding the queue."""
 
     __slots__ = ("images", "rows", "event", "result", "error", "t_submit",
-                 "t_batched", "abandoned")
+                 "t_batched", "abandoned", "klass", "rank", "seq")
 
-    def __init__(self, images: np.ndarray, rows: int) -> None:
+    def __init__(self, images: np.ndarray, rows: int,
+                 klass: Optional[str] = None, rank: int = 0,
+                 seq: int = 0) -> None:
         self.images = images
         self.rows = rows
+        self.klass = klass
+        self.rank = rank
+        self.seq = seq
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -82,6 +114,7 @@ class _Pending:
                 latency_s=now - self.t_submit,
                 queue_wait_s=self.t_batched - self.t_submit,
                 images=self.rows,
+                klass=self.klass,
             )
         self.event.set()
 
@@ -117,6 +150,7 @@ class MicroBatcher:
         dispatch_fn: Optional[Callable] = None,
         complete_fn: Optional[Callable] = None,
         max_inflight: int = 1,
+        shed_policy=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -144,6 +178,13 @@ class MicroBatcher:
         self.max_queue = int(max_queue)
         self.max_inflight = int(max_inflight)
         self.serve_log = serve_log
+        # Priority shedding (serve/control.py): None keeps the classic
+        # single-class admission (full queue = 503) and FIFO order.
+        self.shed_policy = shed_policy
+        # Completion-side requests/sec over a sliding window — the
+        # denominator every Retry-After hint is derived from.
+        self._drain = DrainRate()
+        self._seq = 0
         self._cv = threading.Condition()
         self._queue: List[_Pending] = []
         self._stopped = False
@@ -194,31 +235,74 @@ class MicroBatcher:
         with self._cv:
             return len(self._queue)
 
+    def drain_rps(self) -> float:
+        """Completed requests/sec over the drain window — what
+        ``Retry-After`` hints are derived from."""
+        return self._drain.rate()
+
     # -- producer side -----------------------------------------------------
 
-    def submit(self, images) -> _Pending:
+    def submit(self, images, klass: Optional[str] = None) -> _Pending:
         """Enqueue one request. ``images`` must be a row-stack whose first
         dim is the example count (the server preprocesses through
         ``engine.preprocess`` first, so row counting and concatenation
         are unambiguous); any row count is accepted — oversized batches
         ride alone and the engine chunks them. Raises :class:`Overloaded`
         when the queue is at capacity — admission control happens HERE,
-        before any work is done for the request."""
+        before any work is done for the request.
+
+        ``klass`` is the request's priority class. ``None`` (a client
+        that never spoke priorities) is TREATED as the most urgent
+        class for ordering and admission — identical behavior to the
+        pre-policy batcher — but stays ``None`` in the accounting, so
+        a server whose clients never send priorities keeps the
+        classless ``/stats`` schema (no ``classes`` block). With a
+        shed policy attached, admission additionally applies the
+        class's queue watermark and the queue is kept priority-ordered
+        (FIFO within a class) — an interactive arrival overtakes every
+        queued best_effort request."""
         arr = np.asarray(images)
         if arr.ndim < 2 or arr.shape[0] == 0:
             raise ValueError(
                 f"submit expects a non-empty (rows, ...) stack of "
                 f"examples; got shape {arr.shape}")
-        pending = _Pending(arr, int(arr.shape[0]))
+        effective = klass or PRIORITY_CLASSES[0]
+        rank = priority_rank(effective)
         with self._cv:
             if self._stopped:
                 raise RuntimeError("batcher is shut down")
-            if len(self._queue) >= self.max_queue:
+            depth = len(self._queue)
+            if self.shed_policy is not None:
+                admitted = self.shed_policy.admits(
+                    effective, depth, self.max_queue)
+            else:
+                admitted = depth < self.max_queue
+            if not admitted:
                 if self.serve_log is not None:
-                    self.serve_log.record_rejection()
+                    self.serve_log.record_rejection(klass=klass)
+                if self.shed_policy is None:
+                    raise Overloaded(
+                        f"request queue full ({self.max_queue} pending)")
+                limit = self.shed_policy.admit_depth(
+                    effective, self.max_queue)
+                retry_after = self.shed_policy.retry_after_s(
+                    effective, depth, self.max_queue,
+                    self._drain.rate())
                 raise Overloaded(
-                    f"request queue full ({self.max_queue} pending)")
-            self._queue.append(pending)
+                    f"request queue past the {effective!r} admission "
+                    f"watermark ({depth} pending, class limit {limit} "
+                    f"of {self.max_queue})", retry_after_s=retry_after)
+            pending = _Pending(arr, int(arr.shape[0]), klass=klass,
+                               rank=rank, seq=self._seq)
+            self._seq += 1
+            # Priority insert, stable within a class: scan back from
+            # the tail (same-or-more-urgent arrivals append in O(1),
+            # the common case; an interactive request overtakes only
+            # the less-urgent tail).
+            i = len(self._queue)
+            while i > 0 and self._queue[i - 1].rank > rank:
+                i -= 1
+            self._queue.insert(i, pending)
             self._cv.notify_all()
         return pending
 
@@ -234,9 +318,10 @@ class MicroBatcher:
             raise pending.error
         return pending.result
 
-    def predict(self, images, timeout: Optional[float] = 30.0):
+    def predict(self, images, timeout: Optional[float] = 30.0,
+                klass: Optional[str] = None):
         """Synchronous submit + wait — the HTTP handler's one call."""
-        return self.result(self.submit(images), timeout)
+        return self.result(self.submit(images, klass=klass), timeout)
 
     # -- worker side -------------------------------------------------------
 
@@ -271,7 +356,12 @@ class MicroBatcher:
                     self._cv.wait()
                 if not self._queue:
                     return []
-                deadline = self._queue[0].t_submit + self.max_wait_s
+                # Anchored to the OLDEST waiting request (with priority
+                # ordering the head is the most URGENT, not the oldest —
+                # an interactive trickle must not reset a queued batch
+                # request's clock).
+                deadline = min(p.t_submit for p in self._queue) \
+                    + self.max_wait_s
                 while not self._stopped:
                     remaining = deadline - time.perf_counter()
                     if takeable_rows() >= self.max_batch or remaining <= 0:
@@ -379,3 +469,7 @@ class MicroBatcher:
         for p in taken:
             p.finish(out[off:off + p.rows], None, self.serve_log)
             off += p.rows
+        # Completed requests feed the drain-rate estimate Retry-After
+        # hints divide by (errors excluded: a failing plane is not
+        # drain capacity).
+        self._drain.note(len(taken))

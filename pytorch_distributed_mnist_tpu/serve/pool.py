@@ -198,6 +198,7 @@ class EnginePool:
         auto_regroup: bool = True,
         regroup_retries: int = 3,
         precision: Optional[str] = None,
+        name_prefix: str = "",
     ) -> None:
         devices = list(devices) if devices is not None \
             else list(jax.local_devices())
@@ -218,6 +219,11 @@ class EnginePool:
         self.input_shape = tuple(input_shape)
         self.workers = workers
         self.n_devices = len(devices)
+        # Replica/group name prefix (multi-model serving: ``linear.r0``
+        # vs ``cnn.r0``), so N models' replica rows, CompileLog programs,
+        # and recompile verdicts stay attributable per model in one
+        # process. Empty (the default) keeps every historical name.
+        self.name_prefix = name_prefix
         self.quarantine_after = quarantine_after
         self.auto_regroup = auto_regroup
         self.regroup_retries = regroup_retries
@@ -294,7 +300,8 @@ class EnginePool:
             groups = partition_groups(devices, mesh_size)
             for i, group in enumerate(groups):
                 name = precision_engine_name(
-                    group_name(self.serve_mode, i, len(groups)),
+                    self.name_prefix
+                    + group_name(self.serve_mode, i, len(groups)),
                     self.precision)
                 engine = build_group_engine(
                     self.serve_mode, self.model_name, group, params, name,
@@ -314,7 +321,8 @@ class EnginePool:
                     "replicated serving runs one engine per chip; a "
                     f"{mesh_size}-device mesh needs a sharded serve_mode")
             for i, device in enumerate(devices):
-                name = precision_engine_name(f"r{i}", self.precision)
+                name = precision_engine_name(f"{self.name_prefix}r{i}",
+                                             self.precision)
                 engine = InferenceEngine(
                     self.apply_fn, params, buckets=self._buckets,
                     input_shape=self.input_shape, serve_log=self.serve_log,

@@ -477,7 +477,11 @@ def precision_engine_name(name: Optional[str],
     """Compose an engine/CompileLog name with its precision suffix —
     ``serve_forward_b{b}@{mode}.{prec}`` per the registry contract. f32
     keeps the historical (suffix-free) names, so every pre-precision
-    compile-stats pin and recompile verdict is untouched."""
+    compile-stats pin and recompile verdict is untouched. A multi-model
+    server (``--model-set``) prefixes the MODEL as the name's first
+    dotted segment (``linear.r0``, ``cnn.tensor.g0`` — the pool's
+    ``name_prefix``), which is how per-plane /stats compile blocks
+    attribute programs per model."""
     if not precision or precision == F32:
         return name
     return f"{name}.{precision}" if name else precision

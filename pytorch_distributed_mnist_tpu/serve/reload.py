@@ -21,7 +21,12 @@ plane needs — per replica on a pool, per STAGE inside an MPMD pipeline
 chain (``serve/pipeline.py`` splits and installs all stages under one
 lock, so a batch never spans two epochs across stages), and to BOTH
 planes of a shadow canary (``serve/canary.py`` additionally resets the
-promotion cycle, so every publish re-earns its quantized precision). Failures are contained: a corrupt or vanished checkpoint is
+promotion cycle, so every publish re-earns its quantized precision).
+A multi-model server (``--model-set``) runs one watcher PER model
+plane over that model's own checkpoint directory — one model's publish
+swaps only its own plane; the others' programs and epochs are
+untouched (isolation pinned by tests/test_serve_multimodel.py).
+Failures are contained: a corrupt or vanished checkpoint is
 recorded (``serve_reload_failed`` in the stats/JSONL stream) and the
 server keeps answering on the params it has — serving availability never
 depends on the newest file being readable.

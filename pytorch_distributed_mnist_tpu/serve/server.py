@@ -50,6 +50,16 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher, Overloaded
+from pytorch_distributed_mnist_tpu.serve.control import (
+    PRIORITY_CLASSES,
+    AutoScaler,
+    ClientQuotas,
+    ShedPolicy,
+    WeightedFairGate,
+    parse_quota_spec,
+    parse_weight_spec,
+    priority_rank,
+)
 from pytorch_distributed_mnist_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     InferenceEngine,
@@ -84,6 +94,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model architecture the checkpoints belong to "
                         "(must match training's --model; a mismatched "
                         "checkpoint is rejected at load, not served)")
+    p.add_argument("--model-set", type=str, default=None,
+                   metavar="NAME=DIR[,NAME=DIR...]",
+                   help="multi-model serving: boot one full engine-set "
+                        "(engine/pool + batcher + watcher + canary + "
+                        "layout gate) per MODEL=CHECKPOINT_DIR pair from "
+                        "ONE process sharing the chip budget; requests "
+                        "route on their 'model' field. Overrides "
+                        "--model/--checkpoint-dir; every other serving "
+                        "flag applies to each model's plane")
+    p.add_argument("--model-weights", type=str, default=None,
+                   metavar="NAME=W[,NAME=W...]",
+                   help="multi-model weighted-fair dispatch: when more "
+                        "than one model has queued work, device dispatch "
+                        "grants interleave in this weight proportion "
+                        "(unnamed models weigh 1.0) — one model's "
+                        "backlog cannot starve another's. Requires "
+                        "--model-set")
     p.add_argument("--dtype", type=str, default=None, choices=["bf16", "f32"],
                    help="compute dtype override, same semantics as "
                         "training's --dtype")
@@ -185,6 +212,80 @@ def build_parser() -> argparse.ArgumentParser:
                    help="admission control: pending requests beyond this "
                         "are rejected with 503 instead of queuing "
                         "unboundedly")
+    p.add_argument("--shed-watermarks", type=str, default=None,
+                   metavar="CLASS=FRAC[,...]",
+                   help="priority shedding: per-class admission "
+                        "watermarks as fractions of --max-queue — a "
+                        "class is shed (503 + Retry-After) once the "
+                        "queue is past its fraction. Defaults "
+                        "best_effort=0.5, batch=0.75, interactive=1.0: "
+                        "best_effort sheds first, interactive keeps the "
+                        "full queue (exactly the classic admission "
+                        "bound). The queue itself is priority-ORDERED: "
+                        "interactive requests overtake queued batch/"
+                        "best_effort ones")
+    p.add_argument("--quota-rps", type=str, default=None,
+                   metavar="RPS[,CLASS=RPS...]",
+                   help="per-client token-bucket quotas: each client_id "
+                        "(anonymous requests share one bucket) may "
+                        "submit this many requests/sec per priority "
+                        "class, with a 2s burst; an over-quota request "
+                        "is rejected 429 + Retry-After BEFORE it "
+                        "consumes a queue slot, so one hot client "
+                        "cannot starve the rest. A bare number bounds "
+                        "every class; CLASS=RPS overrides per class "
+                        "(e.g. '100,interactive=20'); unset = no quotas")
+    p.add_argument("--quota-burst-s", type=float, default=2.0,
+                   help="quota burst allowance in seconds of the class "
+                        "rate (bucket capacity = rps x this)")
+    p.add_argument("--stats-window-s", type=float, default=60.0,
+                   help="rolling-window size for /stats' `window` block "
+                        "(p50/p95/p99 + rps over the last N seconds "
+                        "only, next to the lifetime quantiles) — what "
+                        "the autoscaler and an operator mid-incident "
+                        "react to")
+    p.add_argument("--autoscale", action="store_true",
+                   help="SLO-driven autoscaling: a background controller "
+                        "samples the rolling-window p95 and queue depth "
+                        "and actuates the pool's /resize path — scale up "
+                        "on an SLO breach (--slo-p95-ms, or the queue "
+                        "high watermark), scale down after sustained "
+                        "calm; hysteresis + cooldown prevent flapping; "
+                        "every decision is a serve_autoscale JSONL "
+                        "event. Needs the pooled data plane "
+                        "(--serve-devices/--max-inflight) and is "
+                        "incompatible with an active canary (the two "
+                        "planes' topology must not diverge)")
+    p.add_argument("--autoscale-dry-run", action="store_true",
+                   help="autoscaler twin mode: record every scale "
+                        "decision (JSONL + /stats) without actuating "
+                        "the resize")
+    p.add_argument("--slo-p95-ms", type=float, default=100.0,
+                   help="the serving SLO the autoscaler defends: "
+                        "rolling-window p95 latency above this is a "
+                        "breach (scale up); sustained p95 below half of "
+                        "it with an empty-ish queue scales down")
+    p.add_argument("--autoscale-queue-high", type=float, default=0.75,
+                   help="autoscaler queue-depth high watermark as a "
+                        "fraction of --max-queue: depth at/above it is "
+                        "a breach even while p95 holds (latency "
+                        "quantiles lag; queue depth leads)")
+    p.add_argument("--autoscale-interval-s", type=float, default=2.0,
+                   help="seconds between autoscaler samples")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=10.0,
+                   help="seconds after any scale action before the next "
+                        "may fire (a resize builds + AOT-warms a whole "
+                        "layout; back-to-back resizes would spend the "
+                        "capacity they add)")
+    p.add_argument("--autoscale-down-after", type=int, default=3,
+                   help="consecutive calm samples required before a "
+                        "scale-down (with the halved p95 bar, the "
+                        "hysteresis that prevents flapping)")
+    p.add_argument("--autoscale-min-devices", type=int, default=1,
+                   help="autoscaler floor: never scale below this many "
+                        "devices")
+    p.add_argument("--autoscale-max-devices", type=int, default=0,
+                   help="autoscaler ceiling (0 = all local devices)")
     p.add_argument("--max-request-images", type=int, default=1024,
                    help="reject /predict requests with more images than "
                         "this (400): one giant request occupies a single "
@@ -231,36 +332,37 @@ def build_parser() -> argparse.ArgumentParser:
 MAX_BODY_BYTES = 16 << 20
 
 
-class ServeContext:
-    """Everything one serving process owns; built by :func:`create_server`
-    and shared with the HTTP handlers via the server object.
+class _HTTPServer(ThreadingHTTPServer):
+    # Overload must reach ADMISSION CONTROL (a 503 with Retry-After),
+    # not the kernel: the stdlib default accept backlog of 5 turns a
+    # burst into connection-refused at the TCP layer — an unattributed
+    # drop no policy ever saw. 128 rides out any burst the bounded
+    # request queue is sized to answer.
+    request_queue_size = 128
 
-    ``engine`` is the data plane the handlers talk to: a bare
-    :class:`InferenceEngine` on the single-device plane, an
-    :class:`~pytorch_distributed_mnist_tpu.serve.pool.EnginePool` on the
-    multi-chip one (same surface: ``preprocess``/``buckets``/
-    ``params_epoch``). ``pool`` is set only in the pooled case."""
 
-    def __init__(self, engine, batcher, watcher, serve_log, sink,
-                 model_name: str, boot_path: Optional[str] = None,
-                 max_request_images: int = 1024, pool=None,
-                 max_inflight: int = 1,
-                 serve_mode: str = "replicated",
-                 serve_precision: str = "f32", canary=None) -> None:
-        self.max_request_images = max_request_images
-        self.serve_mode = serve_mode
-        self.serve_precision = serve_precision
-        self.canary = canary
+class ModelPlane:
+    """One model's complete serving stack: engine/pool, batcher, reload
+    watcher, optional canary and autoscaler, and its own
+    :class:`ServeLog`. The single-model server is the degenerate case of
+    one plane; ``--model-set`` boots N of these from one process, each
+    keeping its own watcher/canary/layout-gate while sharing the chip
+    budget through the weighted-fair dispatch gate."""
+
+    def __init__(self, model_name: str, engine, batcher, watcher,
+                 serve_log, boot_path: Optional[str], pool=None,
+                 canary=None, autoscaler=None,
+                 checkpoint_dir: Optional[str] = None) -> None:
+        self.model_name = model_name
         self.engine = engine
-        self.pool = pool
-        self.max_inflight = max_inflight
         self.batcher = batcher
         self.watcher = watcher
         self.serve_log = serve_log
-        self.sink = sink
-        self.model_name = model_name
         self.boot_path = boot_path
-        self.t_start = time.time()
+        self.pool = pool
+        self.canary = canary
+        self.autoscaler = autoscaler
+        self.checkpoint_dir = checkpoint_dir
 
     @property
     def checkpoint_path(self) -> Optional[str]:
@@ -271,11 +373,87 @@ class ServeContext:
         return self.boot_path
 
     def close(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.watcher is not None:
             self.watcher.stop()
         self.batcher.close()
+
+
+class ServeContext:
+    """Everything one serving process owns; built by :func:`create_server`
+    and shared with the HTTP handlers via the server object.
+
+    ``planes`` maps model name -> :class:`ModelPlane`;
+    ``default_model`` names the plane a request without a ``model``
+    field routes to (the sole plane on a single-model server — where
+    requests NEVER need the field). The flat attributes (``engine``,
+    ``pool``, ``batcher``, ...) alias the default plane, so everything
+    written against the single-model context keeps working."""
+
+    def __init__(self, planes, default_model: str, sink,
+                 max_request_images: int = 1024,
+                 max_inflight: int = 1,
+                 serve_mode: str = "replicated",
+                 serve_precision: str = "f32",
+                 quotas=None, fair_gate=None) -> None:
+        self.planes = planes
+        self.default_model = default_model
+        self.sink = sink
+        self.max_request_images = max_request_images
+        self.serve_mode = serve_mode
+        self.serve_precision = serve_precision
+        self.quotas = quotas
+        self.fair_gate = fair_gate
+        self.max_inflight = max_inflight
+        self.t_start = time.time()
+        default = planes[default_model]
+        # Single-model aliases (the historical surface).
+        self.model_name = default.model_name
+        self.engine = default.engine
+        self.pool = default.pool
+        self.batcher = default.batcher
+        self.watcher = default.watcher
+        self.canary = default.canary
+        self.serve_log = default.serve_log
+        self.boot_path = default.boot_path
+
+    @property
+    def multi_model(self) -> bool:
+        return len(self.planes) > 1
+
+    @property
+    def checkpoint_path(self) -> Optional[str]:
+        return self.planes[self.default_model].checkpoint_path
+
+    def plane_for(self, model: Optional[str]) -> ModelPlane:
+        """Route one request's ``model`` field to its plane. ``None``
+        routes to the default ONLY on a single-model server — a
+        multi-model server requires the field (silently defaulting
+        would misroute every legacy client the moment a second model
+        is added)."""
+        if model is None:
+            if self.multi_model:
+                raise ValueError(
+                    f"multi-model server: the request body must name "
+                    f"'model' (one of {sorted(self.planes)})")
+            return self.planes[self.default_model]
+        plane = self.planes.get(model)
+        if plane is None:
+            raise ValueError(
+                f"unknown model {model!r}; this server serves "
+                f"{sorted(self.planes)}")
+        return plane
+
+    def write_all_stats(self, **extra) -> None:
+        for plane in self.planes.values():
+            plane.serve_log.write_stats(**extra)
+
+    def close(self) -> None:
+        for plane in self.planes.values():
+            plane.close()
         if self.sink is not None:
-            self.serve_log.write_stats(final=True)
+            self.write_all_stats(final=True)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -287,12 +465,15 @@ class _Handler(BaseHTTPRequestHandler):
     def ctx(self) -> ServeContext:
         return self.server.ctx  # type: ignore[attr-defined]
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, str(value))
             self.end_headers()
             self.wfile.write(body)
         except OSError:
@@ -302,71 +483,113 @@ class _Handler(BaseHTTPRequestHandler):
             # the silenced log_message avoids.
             pass
 
+    def _plane_stats(self, plane: ModelPlane) -> dict:
+        """One plane's /stats payload — the historical single-model
+        schema, byte-compatible for the default configuration."""
+        ctx = self.ctx
+        stats = plane.serve_log.snapshot()
+        compile_stats = compile_log.stats()
+
+        def _is_planes(name: str) -> bool:
+            if not name.startswith("serve_forward_"):
+                return False
+            if not ctx.multi_model:
+                return True
+            # Multi-model: engine/replica names carry the model as
+            # their first dotted segment after '@' ('serve_forward_b8@
+            # linear.r0'), so each plane's block shows only its own
+            # programs.
+            _, _, engine_name = name.partition("@")
+            return engine_name.split(".")[0] == plane.model_name
+
+        stats["compile"] = {
+            "programs": {
+                name: rec for name, rec in
+                compile_stats["programs"].items() if _is_planes(name)
+            },
+            "totals": compile_stats["totals"],
+        }
+        stats["buckets"] = list(plane.engine.buckets)
+        stats["model_epoch"] = plane.engine.params_epoch
+        stats["serve_mode"] = ctx.serve_mode
+        # Always present (like serve_mode): what precision the
+        # serving programs lower at — loadgen's report and the
+        # --expect-precision smoke read it.
+        stats["serve_precision"] = ctx.serve_precision
+        if plane.canary is not None:
+            # The shadow-canary block: state machine position,
+            # sampling shape, disagreement counters, logit-delta
+            # quantiles (serve/canary.py::snapshot).
+            stats["canary"] = plane.canary.snapshot()
+        if plane.autoscaler is not None:
+            # The control-loop block: configuration, scale counters,
+            # and the recent decision log (what the dry-run chaos twin
+            # asserts before the real resize is trusted).
+            stats["autoscaler"] = plane.autoscaler.snapshot()
+        if plane.pool is not None:
+            stats["serve_devices"] = plane.pool.n_devices
+            stats["max_inflight"] = ctx.max_inflight
+            # The self-healing/resize topology block (read LIVE from
+            # the pool, so a /resize or regroup shows up on the next
+            # fetch): generation counter, group counts, quarantine
+            # state, failover/regroup totals. loadgen's
+            # --expect-groups smoke asserts active_groups; its report
+            # carries topology_generation.
+            topo = plane.pool.topology()
+            for key in ("topology_generation", "groups",
+                        "active_groups", "quarantined_groups",
+                        "regroups", "failovers"):
+                stats[key] = topo[key]
+            if ctx.serve_mode != "replicated":
+                # The mesh shape the sharded plane is running:
+                # loadgen's report and --expect-mode smoke read
+                # these.
+                stats["mesh_devices"] = plane.pool.mesh_size
+                stats["mesh_groups"] = plane.pool.n_replicas
+            if "pipeline_stages" in topo:
+                # Staged (pipeline) modes: chips per chain — what
+                # loadgen --expect-stages asserts.
+                stats["pipeline_stages"] = topo["pipeline_stages"]
+            if "slice_straddling_groups" in topo:
+                # Slice-alignment warning (present only when a DCN
+                # slice topology exists): mesh groups whose chips
+                # straddle slices — their intra-group collectives
+                # ride the slow cross-slice axis. loadgen reports
+                # carry it.
+                stats["slice_straddling_groups"] = \
+                    topo["slice_straddling_groups"]
+        return stats
+
     def do_GET(self) -> None:  # noqa: N802 - stdlib name
         ctx = self.ctx
         if self.path == "/healthz":
-            self._reply(200, {
+            payload = {
                 "ok": True,
                 "model": ctx.model_name,
                 "model_epoch": ctx.engine.params_epoch,
                 "checkpoint": ctx.checkpoint_path,
                 "uptime_s": round(time.time() - ctx.t_start, 3),
-            })
-        elif self.path == "/stats":
-            stats = ctx.serve_log.snapshot()
-            compile_stats = compile_log.stats()
-            stats["compile"] = {
-                "programs": {
-                    name: rec for name, rec in
-                    compile_stats["programs"].items()
-                    if name.startswith("serve_forward_")
-                },
-                "totals": compile_stats["totals"],
             }
-            stats["buckets"] = list(ctx.engine.buckets)
-            stats["model_epoch"] = ctx.engine.params_epoch
-            stats["serve_mode"] = ctx.serve_mode
-            # Always present (like serve_mode): what precision the
-            # serving programs lower at — loadgen's report and the
-            # --expect-precision smoke read it.
-            stats["serve_precision"] = ctx.serve_precision
-            if ctx.canary is not None:
-                # The shadow-canary block: state machine position,
-                # sampling shape, disagreement counters, logit-delta
-                # quantiles (serve/canary.py::snapshot).
-                stats["canary"] = ctx.canary.snapshot()
-            if ctx.pool is not None:
-                stats["serve_devices"] = ctx.pool.n_devices
-                stats["max_inflight"] = ctx.max_inflight
-                # The self-healing/resize topology block (read LIVE from
-                # the pool, so a /resize or regroup shows up on the next
-                # fetch): generation counter, group counts, quarantine
-                # state, failover/regroup totals. loadgen's
-                # --expect-groups smoke asserts active_groups; its report
-                # carries topology_generation.
-                topo = ctx.pool.topology()
-                for key in ("topology_generation", "groups",
-                            "active_groups", "quarantined_groups",
-                            "regroups", "failovers"):
-                    stats[key] = topo[key]
-                if ctx.serve_mode != "replicated":
-                    # The mesh shape the sharded plane is running:
-                    # loadgen's report and --expect-mode smoke read
-                    # these.
-                    stats["mesh_devices"] = ctx.pool.mesh_size
-                    stats["mesh_groups"] = ctx.pool.n_replicas
-                if "pipeline_stages" in topo:
-                    # Staged (pipeline) modes: chips per chain — what
-                    # loadgen --expect-stages asserts.
-                    stats["pipeline_stages"] = topo["pipeline_stages"]
-                if "slice_straddling_groups" in topo:
-                    # Slice-alignment warning (present only when a DCN
-                    # slice topology exists): mesh groups whose chips
-                    # straddle slices — their intra-group collectives
-                    # ride the slow cross-slice axis. loadgen reports
-                    # carry it.
-                    stats["slice_straddling_groups"] = \
-                        topo["slice_straddling_groups"]
+            if ctx.multi_model:
+                payload["models"] = {
+                    name: plane.engine.params_epoch
+                    for name, plane in sorted(ctx.planes.items())}
+            self._reply(200, payload)
+        elif self.path == "/stats":
+            # Top level = the default plane's historical schema; the
+            # multi-model server ADDS a per-plane `models` block (and
+            # `model_set`), and quotas add their own block — every
+            # change is schema-additive.
+            stats = self._plane_stats(ctx.planes[ctx.default_model])
+            if ctx.multi_model:
+                stats["model_set"] = sorted(ctx.planes)
+                stats["models"] = {
+                    name: self._plane_stats(plane)
+                    for name, plane in sorted(ctx.planes.items())}
+                if ctx.fair_gate is not None:
+                    stats["fair_dispatch"] = ctx.fair_gate.snapshot()
+            if ctx.quotas is not None:
+                stats["quota"] = ctx.quotas.snapshot()
             self._reply(200, stats)
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
@@ -389,6 +612,40 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             payload = json.loads(self.rfile.read(length) or b"{}")
+            # Control-plane fields first, all cheap string work: the
+            # model route, the priority class (vocabulary-checked), and
+            # the client identity — so quota refusal below happens
+            # before any per-pixel array work is paid.
+            plane = ctx.plane_for(payload.get("model"))
+            # None (no priority field) stays None end to end: treated
+            # as the most urgent class but never recorded as one, so a
+            # server whose clients don't speak priorities keeps the
+            # classless /stats schema.
+            klass = payload.get("priority") or None
+            if klass is not None:
+                priority_rank(klass)  # 400 on an unknown class
+            client_id = payload.get("client_id")
+            if client_id is not None and not isinstance(client_id, str):
+                raise ValueError("client_id must be a string")
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        if ctx.quotas is not None:
+            # Per-client quotas run BEFORE the request consumes a queue
+            # slot (or any preprocessing): 429 is the CLIENT's overload
+            # — admission control (503 below) is the server's.
+            admitted, retry_after = ctx.quotas.admit(
+                client_id, klass or PRIORITY_CLASSES[0])
+            if not admitted:
+                plane.serve_log.record_rejection(klass=klass, quota=True)
+                self._reply(
+                    429,
+                    {"error": "quota exceeded",
+                     "priority": klass or PRIORITY_CLASSES[0],
+                     "retry_after_s": retry_after},
+                    headers={"Retry-After": max(1, round(retry_after))})
+                return
+        try:
             images = payload.get("images")
             if images is None:
                 raise ValueError("body must be JSON {\"images\": ...}")
@@ -397,7 +654,7 @@ class _Handler(BaseHTTPRequestHandler):
             # domain training reads from disk, then the engine applies
             # the training normalize. One preprocessing path, no drift.
             raw = np.clip(np.rint(arr), 0, 255).astype(np.uint8)
-            batch = ctx.engine.preprocess(raw)
+            batch = plane.engine.preprocess(raw)
             if batch.shape[0] > ctx.max_request_images:
                 # One request = one queue slot: an unbounded row count
                 # would monopolize the batcher past admission control.
@@ -412,9 +669,19 @@ class _Handler(BaseHTTPRequestHandler):
             # computed-it) — see create_server's infer wrapper — so the
             # reply can never attribute a batch to a checkpoint a
             # concurrent hot reload installed after it ran.
-            out = ctx.batcher.predict(batch)
+            out = plane.batcher.predict(batch, klass=klass)
         except Overloaded as exc:
-            self._reply(503, {"error": "overloaded", "detail": str(exc)})
+            # The shed reply: Retry-After (derived from the batcher's
+            # measured drain rate) tells the client when this priority
+            # class plausibly re-admits — back-off becomes a contract,
+            # not a guess.
+            payload = {"error": "overloaded", "detail": str(exc),
+                       "priority": klass or PRIORITY_CLASSES[0]}
+            headers = None
+            if exc.retry_after_s is not None:
+                payload["retry_after_s"] = exc.retry_after_s
+                headers = {"Retry-After": max(1, round(exc.retry_after_s))}
+            self._reply(503, payload, headers=headers)
             return
         except TimeoutError as exc:
             self._reply(504, {"error": str(exc)})
@@ -423,11 +690,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": repr(exc)})
             return
         epoch = int(out[0, 1])
-        self._reply(200, {
+        reply = {
             "predictions": [int(v) for v in out[:, 0]],
             "model_epoch": None if epoch < 0 else epoch,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
-        })
+        }
+        if ctx.multi_model:
+            reply["model"] = plane.model_name
+        self._reply(200, reply)
 
     def _do_resize(self) -> None:
         """``POST /resize`` — the admin topology dial: body
@@ -438,14 +708,29 @@ class _Handler(BaseHTTPRequestHandler):
         new topology. An operator's curl today, the autoscaler's
         actuator tomorrow (ROADMAP item 1)."""
         ctx = self.ctx
-        if ctx.pool is None:
+        # Multi-model: an optional "model" field routes the resize to
+        # that plane's pool (peeked before the full parse below so the
+        # plane's canary/pool checks see the right plane).
+        length_peek = int(self.headers.get("Content-Length", 0))
+        if length_peek > MAX_BODY_BYTES:
+            self._reply(413, {"error": "oversized /resize body"})
+            return
+        raw_body = self.rfile.read(length_peek)
+        try:
+            peek = json.loads(raw_body or b"{}")
+            plane = ctx.plane_for(
+                peek.get("model") if isinstance(peek, dict) else None)
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        if plane.pool is None:
             self._reply(400, {
                 "error": "resize needs the pooled data plane; start "
                          "with --serve-devices/--max-inflight/"
                          "--serve-mode (the default single-engine "
                          "server has no pool to re-shape)"})
             return
-        if ctx.canary is not None:
+        if plane.canary is not None:
             # A resize mid-canary would re-shape only the baseline pool
             # while the candidate keeps the old topology — the two
             # planes' capacity (and failure surface) would silently
@@ -456,12 +741,8 @@ class _Handler(BaseHTTPRequestHandler):
                          "baseline and shadow planes must keep the "
                          "same topology — restart to change it"})
             return
-        length = int(self.headers.get("Content-Length", 0))
-        if length > MAX_BODY_BYTES:
-            self._reply(413, {"error": "oversized /resize body"})
-            return
         try:
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = json.loads(raw_body or b"{}")
             if not isinstance(payload, dict):
                 raise ValueError(
                     "body must be a JSON object with serve_devices "
@@ -481,8 +762,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         t0 = time.perf_counter()
         try:
-            result = ctx.pool.resize(n_devices=n_devices,
-                                     mesh_size=mesh_size)
+            result = plane.pool.resize(n_devices=n_devices,
+                                       mesh_size=mesh_size)
         except ValueError as exc:
             # An invalid target topology (device bounds, mesh
             # divisibility, a replicated mesh) — flag-language message,
@@ -515,44 +796,69 @@ def _parse_buckets(spec: str):
     return buckets
 
 
-def create_server(args) -> ThreadingHTTPServer:
-    """Build engine + batcher + watcher and bind the HTTP server (socket
-    bound, not yet serving — callers run ``serve_forever`` themselves, so
-    tests can boot on port 0 in-process). ``server.ctx.close()`` tears
-    the serving stack down."""
+def _parse_model_set(spec: str, list_models) -> "dict":
+    """``--model-set NAME=DIR[,NAME=DIR...]`` -> ordered
+    ``{model: checkpoint_dir}``; flag-language SystemExits on unknown
+    models, duplicates, or a malformed pair."""
+    entries: dict = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, directory = tok.partition("=")
+        name, directory = name.strip(), directory.strip()
+        if not sep or not name or not directory:
+            raise SystemExit(
+                f"--model-set: expected MODEL=CHECKPOINT_DIR, got "
+                f"{tok!r}")
+        if name not in list_models():
+            raise SystemExit(f"--model-set names unknown model {name!r}; "
+                             f"available: {list_models()}")
+        if name in entries:
+            raise SystemExit(
+                f"--model-set names {name!r} twice (one engine-set per "
+                f"model; point retrains at one directory)")
+        entries[name] = directory
+    if not entries:
+        raise SystemExit("--model-set needs at least one MODEL=DIR pair")
+    return entries
+
+
+def _parse_watermarks(spec: Optional[str]) -> ShedPolicy:
+    if not spec:
+        return ShedPolicy()
+    marks = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        klass, sep, frac = tok.partition("=")
+        if not sep:
+            raise SystemExit(
+                f"--shed-watermarks: expected CLASS=FRACTION, got "
+                f"{tok!r}")
+        try:
+            marks[klass.strip()] = float(frac)
+        except ValueError:
+            raise SystemExit(
+                f"--shed-watermarks: {frac!r} is not a number") from None
+    try:
+        return ShedPolicy(marks)
+    except ValueError as exc:
+        raise SystemExit(f"--shed-watermarks: {exc}") from None
+
+
+def _build_plane(args, model_name: str, checkpoint_dir: str, *,
+                 shape: dict, sink, shed_policy, fair_gate,
+                 multi_model: bool) -> ModelPlane:
+    """One model's full serving stack over the resolved data-plane
+    ``shape`` — the single-model server builds exactly one of these;
+    ``--model-set`` builds one per model (each with its own ServeLog,
+    reload watcher, canary, layout gate, and — when autoscaling — its
+    own controller over its own pool)."""
     import jax
 
-    from pytorch_distributed_mnist_tpu.models import get_model, list_models
-    from pytorch_distributed_mnist_tpu.train.checkpoint import (
-        _epoch_checkpoints,
-    )
-    from pytorch_distributed_mnist_tpu.utils import compile_cache
-
-    if args.model not in list_models():
-        raise SystemExit(f"unknown --model {args.model!r}; "
-                         f"available: {list_models()}")
-    cache_dir = compile_cache.configure(getattr(args, "compile_cache", None))
-    if cache_dir:
-        print(f"compile cache: {cache_dir}", flush=True)
-
-    model_kwargs = {}
-    if getattr(args, "dtype", None):
-        import jax.numpy as jnp
-
-        model_kwargs["compute_dtype"] = {
-            "bf16": jnp.bfloat16, "f32": jnp.float32}[args.dtype]
-    model = get_model(args.model, **model_kwargs)
-
-    # Data-plane shape: --serve-devices chips (0 = all local devices),
-    # --serve-mode deciding how a forward spans them (replicated per
-    # chip, tensor/expert-sharded over --serve-mesh-chip groups, or a
-    # pipeline of per-chip stage programs), with a --max-inflight
-    # pipelined dispatch window (0 = auto). The default (replicated, 1
-    # device, window 1) is the single-device plane, built exactly as it
-    # always was. Resolved BEFORE the template and the boot restore: the
-    # template's param LAYOUT is per mode (pipeline restores onto the
-    # stage-stacked tree), and the checkpoint walk applies the layout
-    # gate per candidate.
+    from pytorch_distributed_mnist_tpu.models import get_model
     from pytorch_distributed_mnist_tpu.serve.programs import (
         check_checkpoint_layout,
         make_serve_template,
@@ -560,21 +866,28 @@ def create_server(args) -> ThreadingHTTPServer:
         validate_serve_mode,
     )
     from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        _epoch_checkpoints,
         checkpoint_parallel_layout,
         checkpoint_world,
     )
 
-    devices = jax.local_devices()
-    n_devices = getattr(args, "serve_devices", 1)
-    if n_devices == 0:
-        n_devices = len(devices)
-    if n_devices < 0 or n_devices > len(devices):
-        raise SystemExit(
-            f"--serve-devices {n_devices}: this host has "
-            f"{len(devices)} local device(s)")
-    serve_mode = getattr(args, "serve_mode", "replicated")
-    serve_mesh = getattr(args, "serve_mesh", 0)
-    sharded = serve_mode != "replicated"
+    devices = shape["devices"]
+    n_devices = shape["n_devices"]
+    serve_mode = shape["serve_mode"]
+    mesh_size = shape["mesh_size"]
+    sharded = shape["sharded"]
+    max_inflight = shape["max_inflight"]
+    pooled = shape["pooled"]
+    n_groups = shape["n_groups"]
+
+    model_kwargs = {}
+    if getattr(args, "dtype", None):
+        import jax.numpy as jnp
+
+        model_kwargs["compute_dtype"] = {
+            "bf16": jnp.bfloat16, "f32": jnp.float32}[args.dtype]
+    model = get_model(model_name, **model_kwargs)
+
     if sharded:
         try:
             # The mode/model PAIR check (mode registered, rule table for
@@ -583,21 +896,11 @@ def create_server(args) -> ThreadingHTTPServer:
             # splits block layers), so an unservable pair has to die
             # with flag language HERE, not a traceback in there. The
             # full check with the real mesh and params runs below.
-            validate_serve_mode(serve_mode, args.model, 1)
+            validate_serve_mode(serve_mode, model_name, 1)
         except ValueError as exc:
             raise SystemExit(str(exc)) from None
     template = make_serve_template(serve_mode, model,
                                    jax.random.key(args.seed))
-    mesh_size = 1
-    if sharded:
-        mesh_size = serve_mesh or n_devices
-        if n_devices % mesh_size:
-            raise SystemExit(
-                f"--serve-mesh {mesh_size} must divide --serve-devices "
-                f"{n_devices} (the pool runs one spanning engine per "
-                f"mesh group)")
-    elif serve_mesh not in (0, 1):
-        mesh_size = serve_mesh  # rejected by the validation below
     try:
         # ONE rule source (programs.validate_serve_mode): a mesh on the
         # replicated plane, a mode without a rule table for the model,
@@ -605,7 +908,7 @@ def create_server(args) -> ThreadingHTTPServer:
         # template's shapes are every loadable checkpoint's shapes) all
         # fail HERE with flag language, before any mesh or program is
         # built.
-        validate_serve_mode(serve_mode, args.model, mesh_size,
+        validate_serve_mode(serve_mode, model_name, mesh_size,
                             template.params if sharded else None)
     except ValueError as exc:
         raise SystemExit(str(exc)) from None
@@ -649,13 +952,13 @@ def create_server(args) -> ThreadingHTTPServer:
     # by silently serving fresh-init params instead of the trained model.
     boot_path, params, epoch = None, None, None
     layout_rejection = None  # newest layout-mismatch (path, message)
-    for _, candidate in reversed(_epoch_checkpoints(args.checkpoint_dir)):
+    for _, candidate in reversed(_epoch_checkpoints(checkpoint_dir)):
         try:
             try:
                 layout = checkpoint_parallel_layout(candidate)
             except Exception:  # noqa: BLE001 - unreadable meta: let the
                 layout = None  # load attempt below classify the damage
-            check_checkpoint_layout(layout, serve_mode, args.model)
+            check_checkpoint_layout(layout, serve_mode, model_name)
         except ValueError as exc:
             if layout_rejection is None:
                 layout_rejection = (candidate, str(exc))
@@ -690,38 +993,25 @@ def create_server(args) -> ThreadingHTTPServer:
     elif getattr(args, "require_checkpoint", False):
         raise SystemExit(
             f"--require-checkpoint: no loadable published checkpoint in "
-            f"{args.checkpoint_dir!r}")
+            f"{checkpoint_dir!r}")
     else:
         params, epoch = template.params, None
         print(f"WARNING: no loadable checkpoint in "
-              f"{args.checkpoint_dir!r}; serving fresh-init params "
+              f"{checkpoint_dir!r}; serving fresh-init params "
               f"(seed {args.seed}) until one is published", flush=True)
 
-    serve_log = ServeLog()
-    sink = None
-    metrics_file = getattr(args, "metrics_file", None)
-    if metrics_file:
-        sink = JsonlSink(metrics_file)
-        serve_log.set_sink(sink, source="serve")
+    serve_log = ServeLog(
+        window_s=float(getattr(args, "stats_window_s", 60.0) or 60.0))
+    if sink is not None:
+        # One plane, one source tag: a multi-model process's JSONL
+        # lines stay attributable per model in the shared file.
+        serve_log.set_sink(
+            sink, source=f"serve/{model_name}" if multi_model else "serve")
 
-    max_inflight = getattr(args, "max_inflight", 0)
-    if max_inflight < 0:
-        raise SystemExit(f"--max-inflight {max_inflight}: must be >= 0")
-    n_groups = n_devices // mesh_size
-    if max_inflight == 0:
-        # Auto window: one in-flight batch per engine plus one forming.
-        # A single sharded group still defaults to 2 — host staging of
-        # batch N+1 overlaps the mesh executing batch N. A STAGED mode's
-        # group is a pipeline of per-chip programs, so its window sizes
-        # per CHIP (stages x groups + 1): the pipe needs >= stages
-        # batches in flight before every stage chip is busy.
-        if sharded and staged_mode(serve_mode):
-            max_inflight = n_devices + 1
-        elif sharded:
-            max_inflight = n_groups + 1
-        else:
-            max_inflight = n_devices + 1 if n_devices > 1 else 1
-    pooled = n_devices > 1 or max_inflight > 1 or sharded
+    # Multi-model names: the model is the first dotted segment of every
+    # engine/replica name ('linear.r0', 'cnn.tensor.g0'), so /stats
+    # rows, CompileLog programs, and recompile verdicts stay per model.
+    name_prefix = f"{model_name}." if multi_model else ""
 
     def _tag(labels, epoch):
         # Row-tagged outputs (label, epoch): the epoch is captured WITH
@@ -731,6 +1021,20 @@ def create_server(args) -> ThreadingHTTPServer:
         # really computed it.
         tag = np.full_like(labels, -1 if epoch is None else epoch)
         return np.stack([labels, tag], axis=1)
+
+    def _gated(dispatch_fn):
+        """Wrap a dispatch with the weighted-fair gate: the grant runs
+        on the batcher's dispatch thread (blocking only while OTHER
+        models are ahead in virtual time), the dispatch itself after
+        the grant — outside the gate's lock."""
+        if fair_gate is None:
+            return dispatch_fn
+
+        def gated(images):
+            fair_gate.grant(model_name, int(images.shape[0]))
+            return dispatch_fn(images)
+
+        return gated
 
     t0 = time.perf_counter()
     pool = None
@@ -748,15 +1052,16 @@ def create_server(args) -> ThreadingHTTPServer:
                 buckets=_parse_buckets(args.buckets), serve_log=serve_log,
                 params_epoch=epoch, workers=getattr(args, "workers", 4),
                 serve_mode=serve_mode, mesh_size=mesh_size,
-                model_name=args.model, model=model,
+                model_name=model_name, model=model,
                 quarantine_after=getattr(args, "quarantine_after", 3),
-                precision=precision,
+                precision=precision, name_prefix=name_prefix,
             )
         return InferenceEngine(
             model.apply, params, buckets=_parse_buckets(args.buckets),
             serve_log=serve_log, params_epoch=epoch,
             workers=getattr(args, "workers", 4), precision=precision,
-            name=precision_engine_name(None, precision),
+            name=precision_engine_name(
+                model_name if multi_model else None, precision),
         )
 
     if canary_fraction:
@@ -785,9 +1090,9 @@ def create_server(args) -> ThreadingHTTPServer:
             None, max_batch=canary.max_batch,
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
             serve_log=serve_log,
-            dispatch_fn=canary.dispatch,
+            dispatch_fn=_gated(canary.dispatch),
             complete_fn=lambda handle: _tag(*canary.predict_complete(handle)),
-            max_inflight=max_inflight,
+            max_inflight=max_inflight, shed_policy=shed_policy,
         ).start()
     elif pooled:
         pool = _make_plane(serve_precision)
@@ -797,9 +1102,9 @@ def create_server(args) -> ThreadingHTTPServer:
             None, max_batch=pool.max_batch,
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
             serve_log=serve_log,
-            dispatch_fn=pool.dispatch,
+            dispatch_fn=_gated(pool.dispatch),
             complete_fn=lambda handle: _tag(*pool.predict_complete(handle)),
-            max_inflight=max_inflight,
+            max_inflight=max_inflight, shed_policy=shed_policy,
         ).start()
     else:
         engine = _make_plane(serve_precision)
@@ -809,9 +1114,9 @@ def create_server(args) -> ThreadingHTTPServer:
             return _tag(*engine.predict_with_epoch(images))
 
         batcher = MicroBatcher(
-            infer, max_batch=engine.max_batch,
+            _gated(infer), max_batch=engine.max_batch,
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
-            serve_log=serve_log,
+            serve_log=serve_log, shed_policy=shed_policy,
         ).start()
     stats = compile_log.stats()["programs"]
     compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
@@ -838,7 +1143,7 @@ def create_server(args) -> ThreadingHTTPServer:
         plane = f"{len(engine.buckets)} bucket programs"
     if serve_precision != "f32" and canary is None:
         plane = f"{serve_precision} {plane}"
-    print(f"AOT-compiled {plane} "
+    print(f"{model_name}: AOT-compiled {plane} "
           f"{list(engine.buckets)} in {time.perf_counter() - t0:.1f}s "
           f"(compile wall {compiled_ms:.0f} ms); steady-state serving "
           f"never recompiles", flush=True)
@@ -854,22 +1159,231 @@ def create_server(args) -> ThreadingHTTPServer:
             # layout is skipped (permanent for that file) instead of
             # silently served under the wrong mode.
             check_checkpoint_layout(
-                checkpoint_parallel_layout(path), serve_mode, args.model)
+                checkpoint_parallel_layout(path), serve_mode, model_name)
 
         watcher = CheckpointWatcher(
-            args.checkpoint_dir, template, engine.swap_params,
+            checkpoint_dir, template, engine.swap_params,
             poll_interval_s=args.poll_interval, serve_log=serve_log,
             current_path=boot_path, validate_fn=_validate_reload,
         ).start()
 
-    httpd = ThreadingHTTPServer((args.host, args.port), _Handler)
+    autoscaler = None
+    if getattr(args, "autoscale", False):
+        # The SLO control loop over THIS plane's pool: samples the
+        # plane's rolling-window p95/queue depth, actuates its resize.
+        # Validation (pooled plane required, no canary, sane bounds,
+        # mesh-multiple min/max on sharded modes) happened in
+        # create_server before any plane was built. On a sharded pool
+        # the scale STEP is one whole mesh group (mesh_size chips) —
+        # resize validates serve_mesh | serve_devices, so a +1-chip
+        # step could never actuate there.
+        max_devices = getattr(args, "autoscale_max_devices", 0) or \
+            (len(devices) - len(devices) % mesh_size)
+        queue_high = max(1, int(getattr(args, "autoscale_queue_high",
+                                        0.75) * args.max_queue))
+        min_devices = getattr(args, "autoscale_min_devices", 1)
+        if sharded:
+            min_devices = max(min_devices, mesh_size)
+        autoscaler = AutoScaler(
+            pool, serve_log.window_stats,
+            slo_p95_ms=getattr(args, "slo_p95_ms", 100.0),
+            queue_high=queue_high,
+            min_devices=min_devices,
+            max_devices=max_devices,
+            step=mesh_size,
+            interval_s=getattr(args, "autoscale_interval_s", 2.0),
+            cooldown_s=getattr(args, "autoscale_cooldown_s", 10.0),
+            down_after=getattr(args, "autoscale_down_after", 3),
+            dry_run=getattr(args, "autoscale_dry_run", False),
+            serve_log=serve_log,
+            model=model_name if multi_model else None,
+        ).start()
+        print(f"autoscaler: SLO p95 {autoscaler.slo_p95_ms}ms, queue "
+              f"high {queue_high}, {autoscaler.min_devices}.."
+              f"{max_devices} device(s), cooldown "
+              f"{autoscaler.cooldown_s}s"
+              + (" [dry run]" if autoscaler.dry_run else ""), flush=True)
+
+    return ModelPlane(
+        model_name, engine, batcher, watcher, serve_log, boot_path,
+        pool=pool, canary=canary, autoscaler=autoscaler,
+        checkpoint_dir=checkpoint_dir)
+
+
+def create_server(args) -> ThreadingHTTPServer:
+    """Build the model plane(s) — engine/pool + batcher + watcher (+
+    canary/autoscaler) per model — and bind the HTTP server (socket
+    bound, not yet serving — callers run ``serve_forever`` themselves, so
+    tests can boot on port 0 in-process). ``server.ctx.close()`` tears
+    the serving stack down."""
+    import jax
+
+    from pytorch_distributed_mnist_tpu.models import list_models
+    from pytorch_distributed_mnist_tpu.serve.programs import staged_mode
+    from pytorch_distributed_mnist_tpu.utils import compile_cache
+
+    # The model set: --model-set wins (multi-model), else the classic
+    # --model/--checkpoint-dir pair is a one-plane set.
+    model_set_spec = getattr(args, "model_set", None)
+    if model_set_spec:
+        model_dirs = _parse_model_set(model_set_spec, list_models)
+    else:
+        if args.model not in list_models():
+            raise SystemExit(f"unknown --model {args.model!r}; "
+                             f"available: {list_models()}")
+        model_dirs = {args.model: args.checkpoint_dir}
+    multi_model = len(model_dirs) > 1
+
+    cache_dir = compile_cache.configure(getattr(args, "compile_cache", None))
+    if cache_dir:
+        print(f"compile cache: {cache_dir}", flush=True)
+
+    # Data-plane shape: --serve-devices chips (0 = all local devices),
+    # --serve-mode deciding how a forward spans them (replicated per
+    # chip, tensor/expert-sharded over --serve-mesh-chip groups, or a
+    # pipeline of per-chip stage programs), with a --max-inflight
+    # pipelined dispatch window (0 = auto). The default (replicated, 1
+    # device, window 1) is the single-device plane, built exactly as it
+    # always was. Shared by every model plane: N models serve from ONE
+    # chip budget.
+    devices = jax.local_devices()
+    n_devices = getattr(args, "serve_devices", 1)
+    if n_devices == 0:
+        n_devices = len(devices)
+    if n_devices < 0 or n_devices > len(devices):
+        raise SystemExit(
+            f"--serve-devices {n_devices}: this host has "
+            f"{len(devices)} local device(s)")
+    serve_mode = getattr(args, "serve_mode", "replicated")
+    serve_mesh = getattr(args, "serve_mesh", 0)
+    sharded = serve_mode != "replicated"
+    mesh_size = 1
+    if sharded:
+        mesh_size = serve_mesh or n_devices
+        if n_devices % mesh_size:
+            raise SystemExit(
+                f"--serve-mesh {mesh_size} must divide --serve-devices "
+                f"{n_devices} (the pool runs one spanning engine per "
+                f"mesh group)")
+    elif serve_mesh not in (0, 1):
+        mesh_size = serve_mesh  # rejected by per-plane validation
+    max_inflight = getattr(args, "max_inflight", 0)
+    if max_inflight < 0:
+        raise SystemExit(f"--max-inflight {max_inflight}: must be >= 0")
+    n_groups = n_devices // mesh_size
+    if max_inflight == 0:
+        # Auto window: one in-flight batch per engine plus one forming.
+        # A single sharded group still defaults to 2 — host staging of
+        # batch N+1 overlaps the mesh executing batch N. A STAGED mode's
+        # group is a pipeline of per-chip programs, so its window sizes
+        # per CHIP (stages x groups + 1): the pipe needs >= stages
+        # batches in flight before every stage chip is busy.
+        if sharded and staged_mode(serve_mode):
+            max_inflight = n_devices + 1
+        elif sharded:
+            max_inflight = n_groups + 1
+        else:
+            max_inflight = n_devices + 1 if n_devices > 1 else 1
+    pooled = n_devices > 1 or max_inflight > 1 or sharded
+    shape = {"devices": devices, "n_devices": n_devices,
+             "serve_mode": serve_mode, "mesh_size": mesh_size,
+             "sharded": sharded, "max_inflight": max_inflight,
+             "pooled": pooled, "n_groups": n_groups}
+
+    # Control-plane configuration, validated BEFORE any plane is built
+    # so a bad flag dies in milliseconds, not after the AOT compiles.
+    shed_policy = _parse_watermarks(getattr(args, "shed_watermarks", None))
+    quotas = None
+    quota_spec = getattr(args, "quota_rps", None)
+    if quota_spec:
+        try:
+            rates = parse_quota_spec(quota_spec)
+            quotas = ClientQuotas(
+                rates, burst_s=getattr(args, "quota_burst_s", 2.0))
+        except ValueError as exc:
+            raise SystemExit(f"--quota-rps: {exc}") from None
+        if not quotas.enabled:
+            quotas = None  # every class unlimited: no quota plane
+    if getattr(args, "autoscale_dry_run", False) \
+            and not getattr(args, "autoscale", False):
+        raise SystemExit("--autoscale-dry-run modifies --autoscale; "
+                         "pass both")
+    if getattr(args, "autoscale", False):
+        if not pooled:
+            raise SystemExit(
+                "--autoscale actuates the pool's resize path; start "
+                "the pooled data plane (--serve-devices N / "
+                "--max-inflight) — the single-engine server has no "
+                "topology to scale")
+        if float(getattr(args, "canary_fraction", 0.0) or 0.0):
+            raise SystemExit(
+                "--autoscale cannot run under an active precision "
+                "canary (--canary-fraction): a resize would re-shape "
+                "only the baseline pool and the two planes' topology "
+                "must not diverge")
+        if getattr(args, "autoscale_min_devices", 1) < 1:
+            raise SystemExit("--autoscale-min-devices must be >= 1")
+        max_dev = getattr(args, "autoscale_max_devices", 0)
+        if max_dev and max_dev > len(devices):
+            raise SystemExit(
+                f"--autoscale-max-devices {max_dev}: this host has "
+                f"{len(devices)} local device(s)")
+        if sharded:
+            # The autoscaler steps by whole MESH GROUPS (resize
+            # validates serve_mesh | serve_devices): bounds that are
+            # not mesh multiples would make every actuation a
+            # validation error — reject them with flag language
+            # instead of letting the controller spin on 400s.
+            min_dev = getattr(args, "autoscale_min_devices", 1)
+            if min_dev > 1 and min_dev % mesh_size:
+                raise SystemExit(
+                    f"--autoscale-min-devices {min_dev}: the sharded "
+                    f"pool scales by whole {mesh_size}-chip mesh "
+                    f"groups; pass a multiple of --serve-mesh")
+            if max_dev and max_dev % mesh_size:
+                raise SystemExit(
+                    f"--autoscale-max-devices {max_dev}: the sharded "
+                    f"pool scales by whole {mesh_size}-chip mesh "
+                    f"groups; pass a multiple of --serve-mesh")
+    fair_gate = None
+    weight_spec = getattr(args, "model_weights", None)
+    if weight_spec and not multi_model:
+        raise SystemExit("--model-weights shapes multi-model dispatch; "
+                         "it requires --model-set with >= 2 models")
+    if multi_model:
+        try:
+            weights = parse_weight_spec(weight_spec or "",
+                                        list(model_dirs))
+        except ValueError as exc:
+            raise SystemExit(str(exc)) from None
+        fair_gate = WeightedFairGate(weights)
+
+    sink = None
+    metrics_file = getattr(args, "metrics_file", None)
+    if metrics_file:
+        sink = JsonlSink(metrics_file)
+
+    planes = {}
+    for model_name, checkpoint_dir in model_dirs.items():
+        planes[model_name] = _build_plane(
+            args, model_name, checkpoint_dir, shape=shape, sink=sink,
+            shed_policy=shed_policy, fair_gate=fair_gate,
+            multi_model=multi_model)
+    default_model = next(iter(model_dirs))
+    if multi_model:
+        print(f"multi-model serving: {sorted(planes)} from one "
+              f"{n_devices}-device budget (weighted-fair dispatch "
+              f"{fair_gate.weights}); requests route on their 'model' "
+              f"field", flush=True)
+
+    httpd = _HTTPServer((args.host, args.port), _Handler)
     httpd.daemon_threads = True
     httpd.ctx = ServeContext(  # type: ignore[attr-defined]
-        engine, batcher, watcher, serve_log, sink, args.model,
-        boot_path=boot_path,
+        planes, default_model, sink,
         max_request_images=getattr(args, "max_request_images", 1024),
-        pool=pool, max_inflight=max_inflight, serve_mode=serve_mode,
-        serve_precision=serve_precision, canary=canary)
+        max_inflight=max_inflight, serve_mode=serve_mode,
+        serve_precision=getattr(args, "serve_precision", "f32") or "f32",
+        quotas=quotas, fair_gate=fair_gate)
     return httpd
 
 
@@ -888,7 +1402,7 @@ def main(argv: Optional[list] = None) -> None:
 
         def _periodic():
             while not stop.wait(stats_interval):
-                httpd.ctx.serve_log.write_stats()
+                httpd.ctx.write_all_stats()
 
         stats_timer = (threading.Thread(target=_periodic, daemon=True,
                                         name="serve-stats"), stop)

@@ -566,11 +566,35 @@ class ServeLog:
     attached, ``write_stats()`` appends a ``{"kind": "serve_stats", ...}``
     snapshot line — the same ``--metrics-file`` stream training writes its
     epoch rows and failure events to.
+
+    Two schema-ADDITIVE planes ride the same log:
+
+    - a **rolling window** (``window_s``, default 60s): every snapshot
+      carries a ``window`` block — p50/p95/p99 and requests/sec over
+      the last ``window_s`` seconds ONLY — because the lifetime
+      quantiles the block sits next to converge to history and cannot
+      see current load (the autoscaler and an operator mid-incident
+      both need "now", not "since boot"). ``window_stats()`` is the
+      cheap probe the autoscaler samples.
+    - **per-class counters** (priority serving): requests recorded with
+      a ``klass`` land per-class latency quantiles, shed (503) and
+      quota (429) counts in a ``classes`` block — present only when a
+      class was ever recorded, so the single-class schema is unchanged.
     """
 
-    def __init__(self, max_samples: int = 8192) -> None:
+    #: Rolling-window sample bounds: latency samples and request
+    #: timestamps kept for the window quantiles/rps. At 60s these cap
+    #: the honest window at ~1k rps sustained — beyond that the window
+    #: rps undercounts (documented, bounded memory wins).
+    WINDOW_SAMPLES = 8192
+    WINDOW_TIMES = 65536
+
+    def __init__(self, max_samples: int = 8192,
+                 window_s: float = 60.0) -> None:
         self._lock = threading.Lock()
         self._max_samples = max_samples
+        self.window_s = float(window_s)
+        self._now = time.monotonic  # overridable clock (tests)
         self._sink: Optional[JsonlSink] = None
         self._source = "serve"
         self._queue_depth_probe: Optional[Callable[[], int]] = None
@@ -585,6 +609,15 @@ class ServeLog:
             self._counts = {"requests": 0, "images": 0, "batches": 0,
                             "rejected": 0, "reloads": 0,
                             "reload_failures": 0}
+            # Rolling window: (t, latency_s) samples + bare timestamps
+            # (for rps), pruned past window_s at record/snapshot time.
+            self._win = collections.deque(maxlen=self.WINDOW_SAMPLES)
+            self._win_times = collections.deque(maxlen=self.WINDOW_TIMES)
+            self._t_reset = self._now()
+            # Per-priority-class accounting (priority serving only):
+            # stays empty — and out of the snapshot — when no request
+            # ever carried a class.
+            self._classes: Dict[str, Dict] = {}
             # Per-replica execution counters (multi-chip pool only): the
             # single-engine data plane records with replica=None and this
             # stays empty, keeping its snapshot/JSONL schema unchanged.
@@ -612,13 +645,72 @@ class ServeLog:
 
     # -- recorders (each from its owning thread) --------------------------
 
+    def _class_rec(self, klass: str) -> Dict:
+        """Per-class record (caller holds the lock)."""
+        rec = self._classes.get(klass)
+        if rec is None:
+            rec = self._classes[klass] = {
+                "requests": 0, "images": 0, "shed": 0,
+                "quota_rejected": 0,
+                "latency": collections.deque(maxlen=4096),
+            }
+        return rec
+
     def record_request(self, latency_s: float, queue_wait_s: float = 0.0,
-                       images: int = 1) -> None:
+                       images: int = 1,
+                       klass: Optional[str] = None) -> None:
+        now = self._now()
         with self._lock:
             self._counts["requests"] += 1
             self._counts["images"] += images
             self._latency.append(latency_s)
             self._queue_wait.append(queue_wait_s)
+            self._win.append((now, latency_s))
+            self._win_times.append(now)
+            if klass is not None:
+                rec = self._class_rec(klass)
+                rec["requests"] += 1
+                rec["images"] += images
+                rec["latency"].append(latency_s)
+
+    def _prune_window(self, now: float) -> None:
+        """Drop window samples older than ``window_s`` (lock held)."""
+        cutoff = now - self.window_s
+        while self._win and self._win[0][0] < cutoff:
+            self._win.popleft()
+        while self._win_times and self._win_times[0] < cutoff:
+            self._win_times.popleft()
+
+    def window_stats(self) -> Dict:
+        """The rolling-window block: latency quantiles + rps over the
+        last ``window_s`` seconds only. Cheap enough to sample on the
+        autoscaler's interval; also merged into every ``snapshot()``."""
+        now = self._now()
+        with self._lock:
+            self._prune_window(now)
+            lat = [s for _, s in self._win]
+            n_requests = len(self._win_times)
+            t_reset = self._t_reset
+            probe = self._queue_depth_probe
+        # The honest span: the full window once one has elapsed, the
+        # log's lifetime before that (a fresh boot's rps must neither
+        # be diluted over a window it hasn't lived nor inflated over
+        # the microseconds since its first request), floored at 1s.
+        span = max(1.0, min(self.window_s, now - t_reset))
+        stats = self._quantiles(lat)
+        depth = 0
+        if probe is not None:
+            try:
+                depth = int(probe())
+            except Exception:  # noqa: BLE001 - stats must never raise
+                depth = -1
+        return {
+            "seconds": self.window_s,
+            "rps": round(n_requests / span, 2),
+            "queue_depth": depth,
+            "p50_ms": stats["p50"], "p95_ms": stats["p95"],
+            "p99_ms": stats["p99"], "count": stats["count"],
+        }
 
     def record_batch(self, rows: int, bucket: int,
                      replica: Optional[str] = None) -> None:
@@ -636,9 +728,19 @@ class ServeLog:
                 hist = rec["batch_histogram"]
                 hist[bucket] = hist.get(bucket, 0) + 1
 
-    def record_rejection(self) -> None:
+    def record_rejection(self, klass: Optional[str] = None,
+                         quota: bool = False) -> None:
+        """One shed (503) or — with ``quota=True`` — one per-client
+        quota refusal (429). Quota refusals never touch the lifetime
+        ``rejected`` counter: they are the CLIENT's overload, not the
+        server's, and conflating them would make the admission-control
+        history unreadable."""
         with self._lock:
-            self._counts["rejected"] += 1
+            if not quota:
+                self._counts["rejected"] += 1
+            if klass is not None:
+                rec = self._class_rec(klass)
+                rec["quota_rejected" if quota else "shed"] += 1
 
     def record_reload(self, path: str, epoch: int) -> None:
         with self._lock:
@@ -696,6 +798,16 @@ class ServeLog:
             hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
             probe = self._queue_depth_probe
             replicas_probe = self._replicas_probe
+            classes = {
+                klass: {
+                    "requests": rec["requests"],
+                    "images": rec["images"],
+                    "shed": rec["shed"],
+                    "quota_rejected": rec["quota_rejected"],
+                    "latency_ms": self._quantiles(list(rec["latency"])),
+                }
+                for klass, rec in sorted(self._classes.items())
+            }
             replicas = {name: {**rec,
                                "batch_histogram": {
                                    str(k): v for k, v in
@@ -721,7 +833,15 @@ class ServeLog:
             "latency_ms": self._quantiles(latency),
             "queue_wait_ms": self._quantiles(queue_wait),
             "batch_histogram": hist,
+            # Rolling-window block (schema-ADDITIVE next to the
+            # lifetime quantiles): what the load looks like NOW.
+            "window": self.window_stats(),
         }
+        # Per-priority-class rows appear only once a request carried a
+        # class (priority serving) — classless servers' schema is
+        # unchanged beyond the window block.
+        if classes:
+            snap["classes"] = classes
         # Per-replica rows appear only on the pooled data plane — the
         # single-engine snapshot/JSONL schema is unchanged.
         if replicas:

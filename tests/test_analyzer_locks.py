@@ -835,3 +835,93 @@ def test_canary_module_clean_and_in_lock_graph():
     canary = graph["pytorch_distributed_mnist_tpu/serve/canary.py"]
     assert canary["locks"] == ["ShadowCanary._lock"]
     assert canary["order_edges"] == []
+
+
+# -- ISSUE 15: the serving control plane (serve/control.py) ------------------
+
+
+def test_fires_on_resize_actuation_under_controller_lock():
+    """The autoscaler's actuation is a pool topology rebuild — seconds
+    of build + AOT warm. Holding the controller (or stats, or pool)
+    lock across it stalls every /stats read and dispatch behind the
+    rebuild."""
+    src = """
+import threading
+
+class AutoScaler:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self.pool = pool
+
+    def tick(self, decision):
+        with self._lock:
+            self.pool.resize(n_devices=decision["to_devices"])
+            self._decisions.append(decision)
+"""
+    (f,) = _findings(src)
+    assert "resize" in f.message and "AutoScaler._lock" in f.message
+
+
+def test_fires_on_token_bucket_sleep_under_quota_lock():
+    """A quota layer that SLEEPS a refused client under its lock makes
+    every other client's admission wait behind the abuser's back-off —
+    the quota consuming the capacity it exists to protect. Refusal must
+    be arithmetic (429 + Retry-After), never a sleep."""
+    src = """
+import threading, time
+
+class ClientQuotas:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def admit(self, client, cost):
+        with self._lock:
+            bucket = self._buckets[client]
+            if bucket.tokens < cost:
+                time.sleep((cost - bucket.tokens) / bucket.rate)
+            bucket.tokens -= cost
+"""
+    (f,) = _findings(src)
+    assert "sleep" in f.message and "ClientQuotas._lock" in f.message
+
+
+def test_silent_on_snapshot_then_actuate_after_release():
+    """The shipped shape (serve/control.py::AutoScaler.tick): decide
+    and mutate counters under the lock, snapshot the target, actuate
+    the resize strictly AFTER release."""
+    src = """
+import threading
+
+class AutoScaler:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self.pool = pool
+
+    def tick(self, decision):
+        with self._lock:
+            self._decisions.append(decision)
+            target = decision["to_devices"]
+        self.pool.resize(n_devices=target)
+"""
+    assert _findings(src) == []
+
+
+def test_control_module_clean_and_in_lock_graph():
+    """ISSUE 15: the control plane holds its locks for arithmetic only
+    — quota admits, drain-rate sums, controller decisions, fair-gate
+    virtual time — with every actuation (resize) and event emission
+    outside them. Clean under lock-discipline, and its locks are graph
+    nodes with no nesting edges (none of them may ever nest with the
+    batcher cv or pool lock)."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve",
+                      "control.py")],
+        checkers=["lock-discipline", "trace-purity"],
+        baseline=None)
+    assert result.findings == []
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    control = graph["pytorch_distributed_mnist_tpu/serve/control.py"]
+    assert control["locks"] == [
+        "AutoScaler._lock", "ClientQuotas._lock", "DrainRate._lock",
+        "WeightedFairGate._cv"]
+    assert control["order_edges"] == []

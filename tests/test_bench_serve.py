@@ -140,3 +140,60 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
     assert any(name.endswith("@bf16") for name in programs)
     assert any("@tensor.int8w" in name for name in programs)
     assert any("@pipeline.int8.s0" in name for name in programs)
+
+    # The overload block (ISSUE 15): goodput-vs-offered-load curve
+    # through the priority batcher, per-class completions + p99, the
+    # 70%-of-peak and interactive-below-batch verdicts, and the
+    # autoscaler-actuation recompile verdict — all of which FAIL the
+    # bench (exit 1) when violated.
+    over = report["overload"]
+    assert over["capacity_rps"] > 0
+    assert [pt["offered_x"] for pt in over["points"]] == [1, 2, 5, 10]
+    for pt in over["points"]:
+        assert pt["offered_rps"] > 0
+        assert pt["goodput_rps"] > 0
+        assert set(pt["classes"]) <= {"interactive", "batch",
+                                      "best_effort"}
+    assert over["peak_goodput_rps"] > 0
+    assert over["goodput_holds_at_overload"] is True
+    assert over["interactive_p99_below_batch_p99"] is True
+    top = over["points"][-1]
+    # 10x offered load really was overload: most of it was shed, and
+    # best_effort shed proportionally hardest (the watermark order).
+    assert top["shed"] > top["completed"]
+    auto = over["autoscale"]
+    assert auto["actuated"] is True
+    assert auto["zero_steady_state_recompiles_across_resizes"] is True
+    assert [d["action"] for d in auto["resizes"]] == [
+        "scale_up", "scale_down"]
+    assert "CPU fallback" in over["caveat"]
+
+
+def test_bench_serve_overload_verdicts_fail_loudly():
+    """The overload verdicts really carry teeth: the injected failure
+    hook (mirroring BENCH_ZERO_INJECT_RECOMPILE) must turn the line
+    into exit 1 with the overload error named."""
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_SERVE_REQUESTS": "64",
+        "BENCH_SERVE_POOL_REQUESTS": "64",
+        "BENCH_SERVE_CONCURRENCY": "8",
+        "BENCH_SERVE_PRECISION_REQUESTS": "32",
+        "BENCH_OVERLOAD_SECONDS": "0.5",
+        "BENCH_OVERLOAD_POINTS": "1,2",
+        "BENCH_OVERLOAD_INJECT_FAIL": "1",
+        "BENCH_COMPILE_CACHE": "",
+        "TPUMNIST_COMPILE_CACHE": "",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
+         "serve"],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "overload" in report["error"]
+    assert report["overload"]["goodput_holds_at_overload"] is False

@@ -51,6 +51,12 @@ _BLOCKING_ATTR_CALLS = {
     "allgather_records": "a cross-host collective",
     "agree": "a cross-host collective",
     "_agree_phase_ok": "a cross-host collective",
+    # The autoscaler's actuation (ISSUE 15): a pool resize builds and
+    # AOT-warms a WHOLE replica layout — seconds of work. Under the
+    # controller/stats/pool lock it stalls every /stats read and
+    # dispatch for the build; the shipped shape snapshots state under
+    # the lock and actuates after release.
+    "resize": "a pool topology rebuild (build + AOT warm)",
 }
 _BLOCKING_BARE_CALLS = {
     "open": "file IO",

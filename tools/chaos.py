@@ -69,6 +69,18 @@ deaths instead of monkeypatches:
     python tools/chaos.py --serve --serve-devices 2 --resize 4,2 \\
         --expect-groups 2 --requests 400 --cpu-devices 4
 
+    # AUTOSCALER: spike load against a 1-device pool with --autoscale.
+    # Phase 1 (dry run) asserts the controller DECIDED to scale up
+    # without touching the topology; phase 2 asserts the real resize
+    # up during the spike and back down after it — zero dropped
+    # in-flight requests, Retry-After on every shed
+    python tools/chaos.py --autoscale-spike --cpu-devices 2
+
+    # QUOTA ABUSE: one hot client at 10x --quota-rps is clipped with
+    # 429 + Retry-After while the well-behaved client keeps >= 90%
+    # goodput — one abuser cannot starve the rest
+    python tools/chaos.py --quota-abuse --cpu-devices 2 --quota-rps 20
+
 Fault host indices are process RANKS within the world that reads the
 plan — in an elastic run each rebuilt generation renumbers its ranks
 0..W'-1, so a spec aimed at rank 2 cannot re-fire once the world is
@@ -181,6 +193,266 @@ def _post_json(url: str, path: str, payload: dict,
 
 def _say(msg: str) -> None:
     print(f"chaos: {msg}", file=sys.stderr, flush=True)
+
+
+def _serve_env(args) -> dict:
+    """Environment for a serve-twin subprocess (CPU device forcing +
+    unbuffered + repo on path)."""
+    env = dict(os.environ)
+    if args.cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            f"count={args.cpu_devices}").strip()
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _boot_serve(env: dict, flags: list, timeout: float):
+    """Boot one `tpu-mnist serve` subprocess on a fresh-init checkpoint
+    dir; returns ``(server, log, ckpt_dir, url)`` (url None = never came
+    up; caller prints the log tail and bails). Caller owns teardown."""
+    ckpt_dir = tempfile.mkdtemp(prefix="tpumnist-serve-chaos-")
+    log = tempfile.NamedTemporaryFile(mode="w+", suffix=".log",
+                                      delete=False)
+    cmd = [sys.executable, "-m", "pytorch_distributed_mnist_tpu", "serve",
+           "--checkpoint-dir", ckpt_dir, "--host", "127.0.0.1",
+           "--port", "0"] + flags
+    _say(f"booting serve twin: {' '.join(cmd)}")
+    server = subprocess.Popen(cmd, env=env, stdout=log,
+                              stderr=subprocess.STDOUT)
+    url = None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and url is None:
+        if server.poll() is not None:
+            break
+        log.flush()
+        with open(log.name) as f:
+            m = re.search(r"serving on (http://\S+)", f.read())
+        if m:
+            url = m.group(1).rstrip("/")
+        else:
+            time.sleep(0.2)
+    if url is None:
+        with open(log.name) as f:
+            print(f.read()[-4000:], file=sys.stderr)
+        _say("server never came up")
+    return server, log, ckpt_dir, url
+
+
+def _kill_serve(server, log, ckpt_dir) -> None:
+    server.kill()
+    server.wait()
+    log.close()
+    os.unlink(log.name)
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _loadgen_report(proc_out: str) -> dict:
+    line = proc_out.strip().splitlines()[-1] if proc_out.strip() else "{}"
+    print(line)
+    return json.loads(line)
+
+
+def _sends(report: dict) -> int:
+    """Requests the loadgen actually launched: every status code plus
+    transport errors (open-loop sends it could not launch count there
+    too — nothing is silently skipped)."""
+    return (sum(report.get("status_counts", {}).values())
+            + report.get("transport_errors", 0))
+
+
+def run_autoscale_spike(args) -> int:
+    """The autoscaler twin (ISSUE 15): spike load must trigger a
+    scale-up — FIRST proven in dry-run (the decision log fills, the
+    topology does NOT move), THEN for real (the pool resizes up under
+    the spike and back down after it, with zero dropped in-flight
+    requests). Two server boots on purpose: the dry-run assertion is
+    worthless if the same process already resized."""
+    env = _serve_env(args)
+    # cnn by default: its CPU forward is slow enough that an 8x spike
+    # genuinely backs the queue up (linear answers 500 rps from one
+    # device — nothing to scale for). --stats-window-s 5 so the
+    # controller's p95 reflects the LAST seconds, not the whole run —
+    # the post-spike calm must become visible within the twin's budget.
+    model = args.serve_model if args.serve_model != "linear" else "cnn"
+    # Buckets capped at 4: micro-batching otherwise absorbs an 8x spike
+    # whole (a bucket-32 cnn batch amortizes to ~500 rps/device) and
+    # there is nothing to scale for. With the cap, the spike genuinely
+    # backs the queue up, so the breach fires on BOTH signals — queue
+    # depth immediately, window p95 a beat later.
+    base_flags = [
+        "--model", model, "--buckets", "1,4",
+        "--serve-devices", "1", "--max-inflight", "2",
+        "--max-wait-ms", "2", "--max-queue", "64",
+        "--poll-interval", "5", "--stats-window-s", "5",
+        "--autoscale", "--slo-p95-ms", str(args.slo_p95_ms),
+        "--autoscale-interval-s", "0.3",
+        "--autoscale-cooldown-s", "1.5",
+        "--autoscale-down-after", "3",
+        "--autoscale-max-devices", "2",
+    ]
+    loadgen_spike = [
+        sys.executable, os.path.join(_REPO, "tools", "loadgen.py"),
+        "--mode", "open", "--shape", "spike", "--rate",
+        str(args.spike_rate), "--spike-mult", "8",
+        "--duration", str(args.spike_duration),
+        "--mix", "interactive=0.6,batch=0.3,best_effort=0.1",
+        "--timeout", "30"]
+
+    # -- phase 1: dry run. The controller must DECIDE to scale up and
+    # must NOT actuate.
+    server, log, ckpt_dir, url = _boot_serve(
+        env, base_flags + ["--autoscale-dry-run"], args.timeout)
+    try:
+        if url is None:
+            return 1
+        lg = subprocess.Popen(loadgen_spike + ["--url", url],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+        out, _ = lg.communicate(timeout=args.timeout)
+        _loadgen_report(out)
+        stats = _get_json(url, "/stats")
+        scaler = stats.get("autoscaler") or {}
+        ups = [d for d in scaler.get("decisions", [])
+               if d.get("action") == "scale_up"]
+        if not ups or not all(d.get("dry_run") for d in ups):
+            _say(f"dry run: expected recorded scale_up decisions, got "
+                 f"{scaler.get('decisions')}")
+            return 1
+        if stats.get("serve_devices") != 1:
+            _say(f"dry run actuated! serve_devices="
+                 f"{stats.get('serve_devices')}")
+            return 1
+        _say(f"dry run: {len(ups)} scale_up decision(s) recorded, "
+             f"topology untouched (serve_devices=1)")
+    finally:
+        _kill_serve(server, log, ckpt_dir)
+
+    # -- phase 2: real. The spike must resize the pool up; the calm
+    # after it must bring it back down; every accepted request answers.
+    server, log, ckpt_dir, url = _boot_serve(env, base_flags,
+                                             args.timeout)
+    try:
+        if url is None:
+            return 1
+        lg = subprocess.Popen(loadgen_spike + ["--url", url],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+        scaled_up = False
+        deadline = time.monotonic() + args.spike_duration + 30
+        while time.monotonic() < deadline and lg.poll() is None:
+            try:
+                stats = _get_json(url, "/stats", timeout=5.0)
+            except Exception:  # noqa: BLE001 - server busy; retry
+                time.sleep(0.3)
+                continue
+            if stats.get("serve_devices", 1) > 1:
+                scaled_up = True
+                break
+            time.sleep(0.3)
+        out, _ = lg.communicate(timeout=args.timeout)
+        report = _loadgen_report(out)
+        if not scaled_up:
+            stats = _get_json(url, "/stats")
+            scaled_up = (stats.get("autoscaler", {})
+                         .get("scale_ups", 0)) > 0
+        if not scaled_up:
+            _say("spike never scaled the pool up")
+            return 1
+        if report.get("transport_errors"):
+            _say(f"{report['transport_errors']} transport errors — "
+                 f"dropped in-flight requests during resize")
+            return 1
+        answered = report.get("ok", 0) + report.get("rejected", 0) \
+            + report.get("quota_rejected", 0)
+        if answered != _sends(report):
+            _say(f"{_sends(report) - answered} request(s) unanswered")
+            return 1
+        # Post-spike calm: the controller must scale back DOWN.
+        deadline = time.monotonic() + 30
+        scaled_down = False
+        while time.monotonic() < deadline:
+            stats = _get_json(url, "/stats")
+            if stats.get("serve_devices") == 1 and \
+                    stats.get("autoscaler", {}).get("scale_downs", 0):
+                scaled_down = True
+                break
+            time.sleep(0.5)
+        if not scaled_down:
+            _say("pool never scaled back down after the spike")
+            return 1
+        stats = _get_json(url, "/stats")
+        scaler = stats["autoscaler"]
+        _say(f"autoscale spike twin: {scaler['scale_ups']} up / "
+             f"{scaler['scale_downs']} down, zero dropped requests "
+             f"({report['ok']} ok, {report['rejected']} shed with "
+             f"Retry-After on {report['retry_after_seen']})")
+        return 0
+    finally:
+        _kill_serve(server, log, ckpt_dir)
+
+
+def run_quota_abuse(args) -> int:
+    """The per-client quota twin (ISSUE 15): one hot client hammering
+    far past --quota-rps must be clipped with 429s while the
+    well-behaved clients' goodput stays >= 90% of their offered load —
+    one abuser cannot starve the rest."""
+    env = _serve_env(args)
+    flags = [
+        "--model", args.serve_model, "--buckets", "1,8,32",
+        "--serve-devices", str(args.serve_devices),
+        "--max-wait-ms", "2", "--max-queue", "64",
+        "--poll-interval", "5",
+        "--quota-rps", str(args.quota_rps),
+    ]
+    server, log, ckpt_dir, url = _boot_serve(env, flags, args.timeout)
+    try:
+        if url is None:
+            return 1
+        good_rate = max(2.0, args.quota_rps / 4.0)
+        duration = args.quota_duration
+        hog = subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "tools", "loadgen.py"),
+             "--url", url, "--mode", "open", "--rate",
+             str(args.quota_rps * 10), "--duration", str(duration),
+             "--client-id", "hog", "--timeout", "20"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        good = subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "tools", "loadgen.py"),
+             "--url", url, "--mode", "open", "--rate", str(good_rate),
+             "--duration", str(duration), "--client-id", "good",
+             "--timeout", "20"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        hog_out, _ = hog.communicate(timeout=args.timeout)
+        good_out, _ = good.communicate(timeout=args.timeout)
+        hog_report = _loadgen_report(hog_out)
+        good_report = _loadgen_report(good_out)
+        if not hog_report.get("quota_rejected"):
+            _say("the hot client was never 429'd — quotas inactive?")
+            return 1
+        if not hog_report.get("retry_after_seen"):
+            _say("429s arrived without Retry-After")
+            return 1
+        good_sends = _sends(good_report)
+        good_ok = good_report.get("ok", 0)
+        if good_sends == 0 or good_ok < 0.9 * good_sends:
+            _say(f"well-behaved client starved: {good_ok}/{good_sends} "
+                 f"answered (need >= 90%)")
+            return 1
+        stats = _get_json(url, "/stats")
+        _say(f"quota twin: hog clipped "
+             f"({hog_report['quota_rejected']} x 429 of "
+             f"{_sends(hog_report)} sends), good client "
+             f"{good_ok}/{good_sends} "
+             f"({100.0 * good_ok / good_sends:.1f}% goodput); server "
+             f"tracked {stats.get('quota', {}).get('clients_tracked')} "
+             f"client(s)")
+        return 0
+    finally:
+        _kill_serve(server, log, ckpt_dir)
 
 
 def run_serve_chaos(args) -> int:
@@ -468,6 +740,35 @@ def main(argv=None) -> int:
     p.add_argument("--expect-groups", type=int, default=0,
                    help="serve twin: require this many ACTIVE groups "
                         "in the final /stats (0 skips)")
+    p.add_argument("--autoscale-spike", action="store_true",
+                   help="serve twin: the SLO-autoscaler scenario — "
+                        "spike loadgen against a 1-device pool with "
+                        "--autoscale; phase 1 asserts the DRY-RUN "
+                        "decision log (scale_up recorded, topology "
+                        "untouched), phase 2 asserts the real resize "
+                        "up during the spike and back down after it, "
+                        "with zero dropped in-flight requests. "
+                        "Needs --cpu-devices >= 2 off-TPU")
+    p.add_argument("--slo-p95-ms", type=float, default=150.0,
+                   help="autoscale-spike twin: the SLO handed to the "
+                        "server — above the calm p95, far below the "
+                        "queueing-collapse p95 the spike causes, so "
+                        "breach and calm are both unambiguous")
+    p.add_argument("--spike-rate", type=float, default=60.0,
+                   help="autoscale-spike twin: loadgen base rate "
+                        "(burst = 8x through the middle fifth)")
+    p.add_argument("--spike-duration", type=float, default=8.0,
+                   help="autoscale-spike twin: loadgen run seconds")
+    p.add_argument("--quota-abuse", action="store_true",
+                   help="serve twin: the per-client quota scenario — "
+                        "one hot client at 10x --quota-rps must be "
+                        "clipped with 429+Retry-After while a "
+                        "well-behaved client keeps >= 90%% goodput")
+    p.add_argument("--quota-rps", type=float, default=20.0,
+                   help="quota-abuse twin: per-client requests/sec "
+                        "handed to the server")
+    p.add_argument("--quota-duration", type=float, default=6.0,
+                   help="quota-abuse twin: loadgen run seconds")
     p.add_argument("--quarantine-after", type=int, default=3,
                    help="serve twin: consecutive-failure threshold "
                         "handed to the server (default 3)")
@@ -487,6 +788,10 @@ def main(argv=None) -> int:
         list_fault_points()
         return 0
 
+    if args.autoscale_spike:
+        return run_autoscale_spike(args)
+    if args.quota_abuse:
+        return run_quota_abuse(args)
     if args.serve:
         args.resize_targets = [int(t) for t in
                                (args.resize or "").split(",") if t.strip()]

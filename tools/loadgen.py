@@ -14,16 +14,33 @@ its own import time. Two disciplines:
   CLIENTS down). Overload shows up as 503 rejections and p99 growth
   instead of a silently reduced send rate.
 
+Open-loop traffic SHAPES (`--shape`) modulate the rate over the run:
+`sine` is the diurnal curve (one period over the duration), `spike` is
+a flat baseline with a `--spike-mult`x burst through the middle fifth
+(what the autoscaler twin fires at a server), `adversarial` flips
+per-second between near-silence and a 3x burst on a seeded RNG — the
+worst case for any controller that trusts a trend.
+
+Priority classes: `--mix interactive=0.8,batch=0.2` samples each
+request's `priority` field from the given distribution (and the report
+grows a per-class block: sent/ok/shed/quota-rejected, goodput, p50/p99
+— the shed-not-collapse evidence per class). `--client-id` stamps every
+request (the per-client quota twins), `--model` routes to one model of
+a multi-model server.
+
 Report: one JSON line — throughput, p50/p95/p99/mean/max latency, status
-counts, rejection count. `--smoke` is the CI entry: closed-loop burst
-with tight defaults, nonzero exit unless every request succeeded and the
-server's /stats and /healthz answer.
+counts, rejection count, `retry_after_seen` (429/503 replies carrying a
+Retry-After header — the back-off contract). `--smoke` is the CI entry:
+closed-loop burst with tight defaults, nonzero exit unless every request
+succeeded and the server's /stats and /healthz answer;
+`--expect-models N` additionally requires the multi-model /stats block.
 
 Examples:
     python tools/loadgen.py --url http://127.0.0.1:8000 \
         --requests 2000 --concurrency 16
     python tools/loadgen.py --url http://127.0.0.1:8000 \
-        --mode open --rate 500 --duration 10
+        --mode open --rate 500 --duration 10 --shape spike \
+        --mix interactive=0.7,batch=0.2,best_effort=0.1
     python tools/loadgen.py --smoke --url http://127.0.0.1:8000
 """
 
@@ -31,6 +48,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import random
 import sys
 import threading
@@ -39,41 +57,160 @@ import urllib.error
 import urllib.request
 
 
-def _make_images(n_templates: int, images_per_request: int, seed: int):
-    """Deterministic raw 28x28 uint8-valued images as nested lists,
-    pre-serialized to JSON bodies (serialization cost paid once, not per
-    request)."""
+#: Priority-class vocabulary, mirrored from serve/control.py (this tool
+#: stays jax/numpy-import-free on purpose; pinned equal by
+#: tests/test_serve_control.py).
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+
+def parse_mix(spec):
+    """``interactive=0.8,batch=0.2`` -> [(class, cumulative_weight)];
+    None/empty = every request is the default class."""
+    if not spec:
+        return None
+    weights = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        klass, sep, val = tok.partition("=")
+        klass = klass.strip()
+        if not sep or klass not in PRIORITY_CLASSES:
+            raise SystemExit(
+                f"--mix: expected CLASS=WEIGHT with CLASS one of "
+                f"{list(PRIORITY_CLASSES)}, got {tok!r}")
+        weights.append((klass, float(val)))
+    total = sum(w for _, w in weights)
+    if total <= 0:
+        raise SystemExit(f"--mix {spec!r}: weights must sum > 0")
+    cum, out = 0.0, []
+    for klass, w in weights:
+        cum += w / total
+        out.append((klass, cum))
+    return out
+
+
+def pick_class(mix, rng) -> str:
+    if not mix:
+        return PRIORITY_CLASSES[0]
+    r = rng.random()
+    for klass, cum in mix:
+        if r <= cum:
+            return klass
+    return mix[-1][0]
+
+
+def rate_at(shape: str, base_rate: float, t: float, duration: float,
+            spike_mult: float, rng_seed: int) -> float:
+    """Instantaneous offered rate at time ``t`` for one traffic shape.
+    Pure (the adversarial shape hashes the second index with the seed),
+    so the schedule is unit-testable and reproducible."""
+    if shape == "sine":
+        # One diurnal period over the run: 0.2x at the trough, 1.8x at
+        # the peak — the autoscaler sees both directions.
+        return max(0.0, base_rate * (1.0 + 0.8 * math.sin(
+            2.0 * math.pi * t / max(duration, 1e-9))))
+    if shape == "spike":
+        # Flat baseline, spike_mult burst through the middle fifth —
+        # the scale-up trigger with a clean before/after.
+        return base_rate * (spike_mult
+                            if 0.4 <= t / max(duration, 1e-9) <= 0.6
+                            else 1.0)
+    if shape == "adversarial":
+        # Per-second coin flip between near-silence and a 3x burst:
+        # no trend to learn, maximal flap pressure on a controller.
+        slot_rng = random.Random(rng_seed * 1000003 + int(t))
+        return base_rate * (3.0 if slot_rng.random() < 0.5 else 0.1)
+    return base_rate
+
+
+def schedule(shape: str, rate: float, duration: float, seed: int,
+             spike_mult: float = 5.0):
+    """Fire times for one open-loop run: next-event stepping through
+    the shape's instantaneous rate (1/rate(t) between events), so the
+    offered load IS the shape, not a smoothed average of it."""
+    times = []
+    t = 0.0
+    while t < duration:
+        r = rate_at(shape, rate, t, duration, spike_mult, seed)
+        if r <= 0:
+            t += 0.05
+            continue
+        times.append(t)
+        t += 1.0 / r
+    return times
+
+
+def _make_images(n_templates: int, images_per_request: int, seed: int,
+                 extra_fields=None, mix=None):
+    """Deterministic raw 28x28 uint8-valued images, pre-serialized to
+    JSON bodies (serialization cost paid once, not per request). With a
+    priority ``mix``, one body set per class (the class rides the
+    body); ``extra_fields`` (model/client_id) stamp every body.
+    Returns ``[(klass_or_None, body_bytes), ...]``."""
     rng = random.Random(seed)
-    bodies = []
+    classes = [k for k, _ in mix] if mix else [None]
+    bodies = {klass: [] for klass in classes}
     for _ in range(n_templates):
         imgs = [[[rng.randrange(256) for _ in range(28)] for _ in range(28)]
                 for _ in range(images_per_request)]
-        bodies.append(json.dumps({"images": imgs}).encode())
+        for klass in classes:
+            payload = {"images": imgs}
+            if klass is not None:
+                payload["priority"] = klass
+            payload.update(extra_fields or {})
+            bodies[klass].append(json.dumps(payload).encode())
     return bodies
 
 
 class Collector:
-    """Thread-safe result accumulator."""
+    """Thread-safe result accumulator (overall + per priority class)."""
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.latencies = []
         self.status = {}
         self.errors = 0
+        self.not_launched = 0
+        self.retry_after_seen = 0
+        self.classes = {}
 
-    def record(self, status: int, latency_s: float) -> None:
+    def _class_rec(self, klass):
+        rec = self.classes.get(klass)
+        if rec is None:
+            rec = self.classes[klass] = {
+                "sent": 0, "status": {}, "latencies": []}
+        return rec
+
+    def record(self, status: int, latency_s: float, klass=None,
+               retry_after: bool = False) -> None:
         with self.lock:
             self.status[status] = self.status.get(status, 0) + 1
             if status == 200:
                 self.latencies.append(latency_s)
+            if retry_after:
+                self.retry_after_seen += 1
+            if klass is not None:
+                rec = self._class_rec(klass)
+                rec["sent"] += 1
+                rec["status"][status] = rec["status"].get(status, 0) + 1
+                if status == 200:
+                    rec["latencies"].append(latency_s)
 
     def record_error(self) -> None:
         with self.lock:
             self.errors += 1
 
+    def record_not_launched(self) -> None:
+        """Open loop only: the schedule fired but the CLIENT could not
+        launch (outstanding cap) — the client's limit, not a server
+        drop, so it must not count as a transport error."""
+        with self.lock:
+            self.not_launched += 1
+
 
 def _one_request(url: str, body: bytes, timeout: float,
-                 collector: Collector) -> None:
+                 collector: Collector, klass=None) -> None:
     req = urllib.request.Request(
         url + "/predict", data=body,
         headers={"Content-Type": "application/json"})
@@ -81,19 +218,31 @@ def _one_request(url: str, body: bytes, timeout: float,
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
-            collector.record(resp.status, time.perf_counter() - t0)
+            collector.record(resp.status, time.perf_counter() - t0,
+                             klass=klass)
     except urllib.error.HTTPError as exc:
         exc.read()
-        collector.record(exc.code, time.perf_counter() - t0)
+        collector.record(
+            exc.code, time.perf_counter() - t0, klass=klass,
+            retry_after=exc.headers.get("Retry-After") is not None)
     except Exception:  # noqa: BLE001 - connection/timeout errors
         collector.record_error()
 
 
+def _pick_body(bodies, mix, rng, i):
+    """``(klass, body)`` for request ``i``: class sampled from the mix,
+    body round-robin within the class's template set."""
+    klass = pick_class(mix, rng) if mix else None
+    per_class = bodies[klass]
+    return klass, per_class[i % len(per_class)]
+
+
 def run_closed(url: str, requests: int, concurrency: int, bodies,
-               timeout: float) -> Collector:
+               timeout: float, mix=None, seed: int = 0) -> Collector:
     collector = Collector()
     counter = {"next": 0}
     lock = threading.Lock()
+    rng = random.Random(seed + 1)
 
     def worker(wid: int) -> None:
         while True:
@@ -102,7 +251,8 @@ def run_closed(url: str, requests: int, concurrency: int, bodies,
                 if i >= requests:
                     return
                 counter["next"] = i + 1
-            _one_request(url, bodies[i % len(bodies)], timeout, collector)
+                klass, body = _pick_body(bodies, mix, rng, i)
+            _one_request(url, body, timeout, collector, klass=klass)
 
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(concurrency)]
@@ -114,38 +264,40 @@ def run_closed(url: str, requests: int, concurrency: int, bodies,
 
 
 def run_open(url: str, rate: float, duration: float, bodies,
-             timeout: float, max_outstanding: int = 512) -> Collector:
+             timeout: float, max_outstanding: int = 512,
+             shape: str = "constant", spike_mult: float = 5.0,
+             mix=None, seed: int = 0) -> Collector:
     collector = Collector()
     sem = threading.Semaphore(max_outstanding)
     threads = []
-    interval = 1.0 / max(rate, 1e-9)
+    rng = random.Random(seed + 1)
+    fire_times = schedule(shape, rate, duration, seed,
+                          spike_mult=spike_mult)
     t_start = time.perf_counter()
-    i = 0
-    while True:
-        t_next = t_start + i * interval
+    for i, t_fire in enumerate(fire_times):
         now = time.perf_counter()
-        if t_next - t_start >= duration:
-            break
+        t_next = t_start + t_fire
         if t_next > now:
             time.sleep(t_next - now)
         if not sem.acquire(blocking=False):
             # The schedule never waits for the server (that would be
-            # closed-loop in disguise); a send the client can't launch is
-            # counted as an error, not silently skipped.
-            collector.record_error()
-            i += 1
+            # closed-loop in disguise); a send the client can't launch
+            # is counted (never silently skipped) — as not_launched,
+            # distinct from transport errors: it is the CLIENT's
+            # outstanding cap, not a dropped request.
+            collector.record_not_launched()
             continue
+        klass, body = _pick_body(bodies, mix, rng, i)
 
-        def fire(body=bodies[i % len(bodies)]):
+        def fire(body=body, klass=klass):
             try:
-                _one_request(url, body, timeout, collector)
+                _one_request(url, body, timeout, collector, klass=klass)
             finally:
                 sem.release()
 
         th = threading.Thread(target=fire, daemon=True)
         th.start()
         threads.append(th)
-        i += 1
     for th in threads:
         th.join(timeout)
     return collector
@@ -163,14 +315,17 @@ def report(collector: Collector, wall_s: float, mode: str) -> dict:
     lats = sorted(collector.latencies)
     ms = lambda s: round(s * 1e3, 3)  # noqa: E731
     ok = collector.status.get(200, 0)
-    return {
+    out = {
         "mode": mode,
         "wall_s": round(wall_s, 3),
         "ok": ok,
         "rejected": collector.status.get(503, 0),
+        "quota_rejected": collector.status.get(429, 0),
+        "retry_after_seen": collector.retry_after_seen,
         "status_counts": {str(k): v
                           for k, v in sorted(collector.status.items())},
         "transport_errors": collector.errors,
+        "not_launched": collector.not_launched,
         "throughput_rps": round(ok / wall_s, 2) if wall_s > 0 else 0.0,
         "latency_ms": {
             "p50": ms(_percentile(lats, 0.50)),
@@ -180,6 +335,27 @@ def report(collector: Collector, wall_s: float, mode: str) -> dict:
             "max": ms(lats[-1]) if lats else 0.0,
         },
     }
+    if collector.classes:
+        # Per-priority-class goodput + tail: the shed-not-collapse
+        # evidence per class (interactive p99 should stay BELOW batch
+        # p99 under overload, and best_effort should shed first).
+        out["classes"] = {}
+        for klass, rec in sorted(collector.classes.items()):
+            clats = sorted(rec["latencies"])
+            cok = rec["status"].get(200, 0)
+            out["classes"][klass] = {
+                "sent": rec["sent"],
+                "ok": cok,
+                "shed": rec["status"].get(503, 0),
+                "quota_rejected": rec["status"].get(429, 0),
+                "goodput_rps": round(cok / wall_s, 2)
+                if wall_s > 0 else 0.0,
+                "latency_ms": {
+                    "p50": ms(_percentile(clats, 0.50)),
+                    "p99": ms(_percentile(clats, 0.99)),
+                },
+            }
+    return out
 
 
 def _get_json(url: str, path: str, timeout: float) -> dict:
@@ -198,9 +374,34 @@ def main(argv=None) -> int:
                    help="closed loop: workers with one request in flight "
                         "each")
     p.add_argument("--rate", type=float, default=200.0,
-                   help="open loop: target requests/sec")
+                   help="open loop: target requests/sec (the BASE rate "
+                        "the shape modulates)")
     p.add_argument("--duration", type=float, default=5.0,
                    help="open loop: seconds to run")
+    p.add_argument("--shape", type=str, default="constant",
+                   choices=["constant", "sine", "spike", "adversarial"],
+                   help="open loop traffic shape: 'sine' = one diurnal "
+                        "period over the duration (0.2x..1.8x), "
+                        "'spike' = --spike-mult x burst through the "
+                        "middle fifth, 'adversarial' = seeded "
+                        "per-second flips between 0.1x and 3x (no "
+                        "trend for a controller to learn)")
+    p.add_argument("--spike-mult", type=float, default=5.0,
+                   help="spike shape: burst multiple of --rate")
+    p.add_argument("--mix", type=str, default=None,
+                   metavar="CLASS=W[,CLASS=W...]",
+                   help="priority-class request mix (e.g. "
+                        "interactive=0.8,batch=0.2): each request's "
+                        "'priority' field is sampled from this "
+                        "distribution and the report gains a per-class "
+                        "goodput/p99 block")
+    p.add_argument("--client-id", type=str, default=None,
+                   help="stamp every request with this client_id (the "
+                        "per-client quota plane); omit for anonymous")
+    p.add_argument("--model", type=str, default=None,
+                   help="stamp every request with this model field "
+                        "(multi-model servers route on it; required "
+                        "there)")
     p.add_argument("--images-per-request", type=int, default=1)
     p.add_argument("--timeout", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=0)
@@ -232,6 +433,11 @@ def main(argv=None) -> int:
                         "MPMD plane; mirrors --expect-groups); the "
                         "report always carries pipeline_stages when the "
                         "server serves a staged mode; 0 skips the check")
+    p.add_argument("--expect-models", type=int, default=0,
+                   help="smoke: additionally require /stats to carry a "
+                        "multi-model `models` block with exactly this "
+                        "many planes (the --model-set server); 0 skips "
+                        "the check")
     p.add_argument("--expect-groups", type=int, default=0,
                    help="smoke: additionally require /stats to report "
                         "exactly this many ACTIVE (non-quarantined) "
@@ -243,17 +449,27 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     url = args.url.rstrip("/")
+    mix = parse_mix(args.mix)
+    extra_fields = {}
+    if args.client_id:
+        extra_fields["client_id"] = args.client_id
+    if args.model:
+        extra_fields["model"] = args.model
     bodies = _make_images(
         n_templates=min(16, max(1, args.requests)),
-        images_per_request=args.images_per_request, seed=args.seed)
+        images_per_request=args.images_per_request, seed=args.seed,
+        extra_fields=extra_fields, mix=mix)
 
     t0 = time.perf_counter()
     if args.mode == "open" and not args.smoke:
         collector = run_open(url, args.rate, args.duration, bodies,
-                             args.timeout)
+                             args.timeout, shape=args.shape,
+                             spike_mult=args.spike_mult, mix=mix,
+                             seed=args.seed)
     else:
         collector = run_closed(url, args.requests, args.concurrency,
-                               bodies, args.timeout)
+                               bodies, args.timeout, mix=mix,
+                               seed=args.seed)
     out = report(collector, time.perf_counter() - t0,
                  "closed" if args.smoke else args.mode)
     # Data-plane shape from /stats on EVERY run (not just smoke): a
@@ -267,7 +483,8 @@ def main(argv=None) -> int:
                     "serve_devices", "mesh_devices",
                     "mesh_groups", "pipeline_stages", "max_inflight",
                     "topology_generation", "groups", "active_groups",
-                    "quarantined_groups", "slice_straddling_groups"):
+                    "quarantined_groups", "slice_straddling_groups",
+                    "model_set", "quota", "autoscaler"):
             if key in stats:
                 out[key] = stats[key]
 
@@ -288,13 +505,19 @@ def main(argv=None) -> int:
             _shape_fields(stats)
             out["healthz"] = health
             out["stats_keys"] = sorted(stats)
+            # On a multi-model server the top-level block is the
+            # DEFAULT plane's; a smoke driving --model must judge the
+            # latency/histogram surface of the plane it actually hit.
+            plane = stats
+            if args.model and isinstance(stats.get("models"), dict):
+                plane = stats["models"].get(args.model) or {}
             smoke_ok = (
                 health.get("ok") is True
                 and out["ok"] == args.requests
                 and out["transport_errors"] == 0
-                and "p50" in stats.get("latency_ms", {})
-                and "p99" in stats.get("latency_ms", {})
-                and stats.get("batch_histogram")
+                and "p50" in plane.get("latency_ms", {})
+                and "p99" in plane.get("latency_ms", {})
+                and plane.get("batch_histogram")
             )
             if args.expect_replicas:
                 # The pooled data plane really is pooled: one /stats row
@@ -336,6 +559,17 @@ def main(argv=None) -> int:
                 smoke_ok = (
                     smoke_ok
                     and stats.get("pipeline_stages") == args.expect_stages
+                )
+            if args.expect_models:
+                # The multi-model server really serves N planes: /stats
+                # carries one `models` entry per plane, each with its
+                # own latency/reload schema.
+                models = stats.get("models") or {}
+                out["models_served"] = sorted(models)
+                smoke_ok = (
+                    smoke_ok
+                    and len(models) == args.expect_models
+                    and all("latency_ms" in m for m in models.values())
                 )
             if args.expect_groups:
                 # The post-regroup/post-resize topology really landed:
