@@ -973,6 +973,18 @@ def main_serve() -> None:
     loudly. Same CPU caveat discipline: host-thread transfers say
     nothing about ICI hop costs.
 
+    The WHOLE-PROGRAM plane (ISSUE 16) gets the ``whole_program``
+    block: one fused engine on the MFU-honest ViT config serving BOTH
+    routes — raw uint8 through the fused bucket programs (in-XLA
+    normalize, staging donated) vs host-normalized float32 through the
+    split ones — with the ABBA-paired fused-over-split ratio, the
+    host-work collapse in ms/request, staged H2D bytes per request
+    (float32 vs raw uint8), forward-only MFU, the donated-staging
+    retirement counts, and zero-recompile verdicts across both planes
+    that fail the bench loudly. On TPU a median paired speedup below
+    1.0 also fails the line; on CPU it is caveated instead (no MXU, no
+    real H2D hop).
+
     In CI this runs on CPU with
     ``XLA_FLAGS=--xla_force_host_platform_device_count=4``.
     """
@@ -1436,16 +1448,17 @@ def main_serve() -> None:
         acc_f32 = float((ref_pred == eval_labels).mean())
         precision_block["f32_accuracy"] = round(acc_f32, 4)
 
-        def drive_engine(eng, requests_n: int) -> float:
+        def drive_engine(eng, requests_n: int, req_stacks=None) -> float:
             """One fixed-shape closed-loop drive through a fresh
             batcher (8-row exact-bucket requests, max_batch=8 — the
             pool blocks' reasoning: pin batch formation so the ratio
             measures the forward programs, not packing)."""
+            req_stacks = pool_stacks if req_stacks is None else req_stacks
             with MicroBatcher(eng.predict, max_batch=8,
                               max_wait_s=0.002,
                               max_queue=4 * concurrency) as b:
-                drive(b, max(32, requests_n // 10), pool_stacks)  # warm
-                return drive(b, requests_n, pool_stacks)
+                drive(b, max(32, requests_n // 10), req_stacks)  # warm
+                return drive(b, requests_n, req_stacks)
 
         for prec in quantized:
             prec_engine = InferenceEngine(
@@ -1547,6 +1560,129 @@ def main_serve() -> None:
                 "the per-precision throughput sign is not the chip's — "
                 "only the schema, the accuracy/agreement deltas, and "
                 "the zero-recompile verdicts are meaningful here")
+
+        # -- whole-program fused serving (ISSUE 16): the fused plane
+        # stages RAW uint8 bytes and runs ONE XLA program per bucket —
+        # in-XLA normalize (+ activation quantize) fused ahead of the
+        # forward, staging buffer DONATED — where the split plane
+        # normalizes on the host and stages float32. Measured on an
+        # MFU-honest config (the ViT: its matmul FLOPs the analytic
+        # helper counts honestly; CNN conv FLOPs would be a made-up
+        # number): the ABBA-paired fused-vs-split throughput ratio on
+        # the SAME engine (only the input dtype differs, so params and
+        # placement cannot skew the pair), the host-work collapse
+        # (per-request preprocess wall), H2D bytes per request (staged
+        # float32 vs raw uint8), and zero-recompile verdicts across
+        # BOTH planes that fail the bench line (exit 1).
+        from pytorch_distributed_mnist_tpu.data.mnist import (
+            normalize_images,
+        )
+
+        fused_requests = int(os.environ.get(
+            "BENCH_SERVE_FUSED_REQUESTS", max(200, pool_requests // 2)))
+        fused_recompiles: list = []
+        wp_failures: list = []
+        wp_model = get_model(
+            "vit", **({} if device.platform == "tpu"
+                      else {"compute_dtype": jnp.float32}))
+        wp_state = create_train_state(wp_model, jax.random.key(0))
+        wp_engine = InferenceEngine(wp_model.apply, wp_state.params,
+                                    buckets=(1, 8), fuse=True, name="wp")
+        wp_engine.warmup()
+        raw_stacks = [np.ascontiguousarray(images[i:i + 8])
+                      for i in range(8)]
+        wp_float_stacks = [normalize_images(s) for s in raw_stacks]
+
+        # Host-work collapse: what the fused plane removes from the
+        # host per request is the float conversion — raw bytes ride
+        # straight into uint8 staging (the copy happens on both planes).
+        host_reps = 50
+        t0 = time.perf_counter()
+        for r in range(host_reps):
+            wp_engine.preprocess(raw_stacks[r % 8])  # raw passthrough
+        fused_host_ms = (time.perf_counter() - t0) / host_reps * 1e3
+        t0 = time.perf_counter()
+        for r in range(host_reps):
+            normalize_images(raw_stacks[r % 8])  # split plane host work
+        split_host_ms = (time.perf_counter() - t0) / host_reps * 1e3
+
+        # H2D bytes per 8-row request, from the ACTUAL staging pools
+        # (the split pool's dtype is the precision plane's choice, the
+        # fused pool always stages raw bytes).
+        split_pool_ = wp_engine._staging
+        fused_pool_ = wp_engine._fused_staging
+        split_bytes = int(np.prod((8,) + split_pool_.input_shape)
+                          ) * split_pool_.dtype.itemsize
+        fused_bytes = int(np.prod((8,) + fused_pool_.input_shape)
+                          ) * fused_pool_.dtype.itemsize
+
+        before_wp = _serve_program_compiles()
+        walls_wp = {"fused": [], "split": []}
+        for rep in range(4):
+            order = (("fused", "split") if rep % 2 == 0
+                     else ("split", "fused"))
+            for leg in order:
+                leg_stacks = (raw_stacks if leg == "fused"
+                              else wp_float_stacks)
+                walls_wp[leg].append(
+                    drive_engine(wp_engine, fused_requests, leg_stacks))
+        pairs_wp = [round(s / f, 3) for f, s in
+                    zip(walls_wp["fused"], walls_wp["split"])]
+        fused_speedup = sorted(pairs_wp)[len(pairs_wp) // 2]
+        delta_wp = _recompile_delta(before_wp, _serve_program_compiles())
+        if delta_wp:
+            fused_recompiles.append(delta_wp)
+        speedup_holds = fused_speedup >= 1.0
+        if device.platform == "tpu" and not speedup_holds:
+            # On the chip the fusion must pay for itself; on the CPU
+            # fallback the sign is caveated, not enforced.
+            wp_failures.append(
+                f"whole-program fusion slower than split on TPU: median "
+                f"paired speedup {fused_speedup} < 1.0")
+
+        # MFU at the fused drive's rate: forward-only model FLOPs (the
+        # training helper counts fwd + 2x bwd, hence /3), matmuls only,
+        # against the chip's peak — None off-TPU, where there is no
+        # honest peak to divide by.
+        wp_tokens = (28 // wp_model.patch_size) ** 2
+        serve_flops_per_image = _vit_model_flops_per_image(
+            wp_tokens, wp_model.embed_dim, wp_model.depth,
+            wp_model.patch_size) / 3.0
+        fused_rps = fused_requests / min(walls_wp["fused"])
+        peak = _peak_flops(device.device_kind)
+        mfu = (round(fused_rps * 8 * serve_flops_per_image / peak, 5)
+               if peak else None)
+
+        whole_program_block: dict = {
+            "model": "vit",
+            "requests": fused_requests,
+            "images_per_request": 8,
+            "fused_over_split_speedup": fused_speedup,
+            "speedup_holds": speedup_holds,
+            "pairs": pairs_wp,
+            "requests_per_sec": round(fused_rps, 1),
+            "host_preprocess_ms_per_request": {
+                "split": round(split_host_ms, 4),
+                "fused": round(fused_host_ms, 4),
+            },
+            "h2d_bytes_per_request": {
+                "split": split_bytes,
+                "fused": fused_bytes,
+                "ratio": round(split_bytes / fused_bytes, 2),
+            },
+            "model_flops_per_image": serve_flops_per_image,
+            "mfu": mfu,
+            "donated_staging_retired": wp_engine.fused_staging_retired(),
+            "zero_steady_state_recompiles": not delta_wp,
+        }
+        if device.platform != "tpu":
+            whole_program_block["caveat"] = (
+                "CPU fallback (the BENCH_r05 convention): host matmuls "
+                "say nothing about the MXU and there is no real H2D "
+                "hop, so the fused-vs-split sign is not the chip's and "
+                "MFU is unreportable — only the schema, the host-work "
+                "collapse, the staged-bytes ratio, and the "
+                "zero-recompile verdicts are meaningful here")
 
         # -- overload (ISSUE 15): goodput vs offered load, 1x..10x of
         # measured capacity, through the PRIORITY batcher (shed policy
@@ -1752,6 +1888,7 @@ def main_serve() -> None:
             "sharded": sharded_block,
             "pipeline_serving": pipeline_block,
             "precision_sweep": precision_block,
+            "whole_program": whole_program_block,
             "overload": overload_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
@@ -1771,10 +1908,18 @@ def main_serve() -> None:
         ok = (zero_recompiles and not drive_errors and served_all
               and not recompiled_replicas and not sharded_recompiles
               and not pipeline_recompiles and not precision_recompiles
+              and not fused_recompiles and not wp_failures
               and not overload_failures)
         if overload_failures:
             out["error"] = ("overload block failed: "
                             + "; ".join(overload_failures))
+        elif fused_recompiles:
+            out["error"] = ("steady-state WHOLE-PROGRAM serving "
+                            "recompiled (fused plane): "
+                            f"{fused_recompiles}")
+        elif wp_failures:
+            out["error"] = ("whole-program block failed: "
+                            + "; ".join(wp_failures))
         elif not zero_recompiles:
             out["error"] = ("steady-state serving recompiled: "
                             f"{totals_after_warmup} -> {totals_after_load}")

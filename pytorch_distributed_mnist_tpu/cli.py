@@ -181,6 +181,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "devices are split data x model; composes with "
                         "--optimizer-sharding zero1 and "
                         "--sequence-parallel)")
+    p.add_argument("--tp-overlap", action="store_true",
+                   help="overlap the Megatron column-parallel matmuls with "
+                        "their sequence allgather: explicit ring-ppermute "
+                        "collective-matmul schedule on a sequence-sharded "
+                        "residual stream (parallel/tensor.py "
+                        "allgather_matmul). Requires --tensor-parallel >= 2 "
+                        "with --model vit and a tp-divisible token count "
+                        "(e.g. --patch-size 7). Off by default: the GSPMD "
+                        "propagation path stays the reference; this path "
+                        "is trajectory-equal to it")
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="expert-parallel width for --model moe_mlp: expert "
                         "weights (leading num_experts dim) shard over an "
@@ -962,6 +972,13 @@ def _run_body(args, epoch_callback=None) -> dict:
     ep = getattr(args, "expert_parallel", 1)
     patch = getattr(args, "patch_size", 4)
     grad_accum = getattr(args, "grad_accum", 1)
+    tp_overlap = getattr(args, "tp_overlap", False)
+    if tp_overlap and (tp < 2 or pp > 1):
+        raise SystemExit(
+            "--tp-overlap requires --tensor-parallel >= 2 without "
+            "--pipeline-stages (it rewrites the pure DP x TP schedule; "
+            "the pipeline's stage body is already an explicit program)"
+        )
     if ep > 1:
         # EP targets the MoE family; TP/SP/PP target the ViT. The mesh
         # families are disjoint (data x expert vs data x model/seq/stage),
@@ -1343,6 +1360,44 @@ def _run_body(args, epoch_callback=None) -> dict:
                         f"{num_heads} heads over the seq axis; "
                         f"--sequence-parallel {sp} must divide {num_heads}"
                     )
+        if tp_overlap:
+            # The overlapped schedule owns the sequence axis (it shards
+            # tokens over 'model' between blocks) and runs in its own
+            # shard_map — every composition that would contend for either
+            # is rejected at flag level.
+            if sp > 1:
+                raise SystemExit(
+                    "--tp-overlap does not compose with "
+                    "--sequence-parallel: the overlapped schedule already "
+                    "shards the token axis (over 'model', between blocks)"
+                )
+            tokens = (28 // patch) ** 2
+            if tokens % tp:
+                raise SystemExit(
+                    f"--tp-overlap shards the ViT's {tokens} tokens over "
+                    f"--tensor-parallel {tp}, which does not divide "
+                    f"evenly; try --patch-size 7 (16 tokens)"
+                )
+            if args.trainer_mode == "explicit":
+                raise SystemExit(
+                    "--tp-overlap does not compose with --trainer-mode "
+                    "explicit (the overlapped shard_map cannot nest "
+                    "inside the explicit-DP shard_map); use scan or "
+                    "stepwise"
+                )
+            if getattr(args, "attention", "dense") == "flash":
+                raise SystemExit(
+                    "--tp-overlap hands attention this device's local "
+                    "heads directly inside its shard_map; --attention "
+                    "flash's GSPMD wrapper does not apply there"
+                )
+            if getattr(args, "optimizer_sharding", "none") != "none":
+                raise SystemExit(
+                    "--tp-overlap uses the explicit head-major layout "
+                    "(parallel/pipeline_tp.py); the ZeRO rule composition "
+                    "targets the standard flax tree — drop "
+                    "--optimizer-sharding"
+                )
         # sp > 1 with dcn > 1 was rejected above, so the hierarchical
         # branch only ever carries the (GSPMD-pure) model axis.
         if dcn > 1:
@@ -1560,6 +1615,19 @@ def _run_body(args, epoch_callback=None) -> dict:
             lr=args.lr, optimizer=args.optimizer, momentum=args.momentum,
             weight_decay=args.weight_decay, place=pp_place,
         )
+    elif tp > 1 and tp_overlap:
+        # Overlapped TP: explicit head-major state + the collective-matmul
+        # apply_fn (parallel/tensor.py). ZeRO was rejected above, so this
+        # is always the single placement.
+        from pytorch_distributed_mnist_tpu.parallel.tensor import (
+            create_overlap_tp_vit_state,
+        )
+
+        state, pp_sharding = create_overlap_tp_vit_state(
+            model, jax.random.key(seed), mesh, data_axis="data",
+            lr=args.lr, optimizer=args.optimizer, momentum=args.momentum,
+            weight_decay=args.weight_decay,
+        )
     else:
         state = create_train_state(
             init_model or model, jax.random.key(seed), lr=args.lr,
@@ -1581,10 +1649,11 @@ def _run_body(args, epoch_callback=None) -> dict:
     state_sharding = pp_sharding
     tp_rules = None
     zero = getattr(args, "optimizer_sharding", "none")
-    if tp > 1 and pp == 1:
-        # PP x TP already placed the state (head-major explicit layout,
-        # parallel/pipeline_tp.py); the GSPMD rule table below only
-        # applies to the standard flax tree.
+    if tp > 1 and pp == 1 and not tp_overlap:
+        # PP x TP and overlapped TP already placed the state (head-major
+        # explicit layout, parallel/pipeline_tp.py / parallel/tensor.py);
+        # the GSPMD rule table below only applies to the standard flax
+        # tree.
         from pytorch_distributed_mnist_tpu.parallel.tensor import (
             shard_state,
             vit_tp_rules,

@@ -8,6 +8,13 @@ normalize, and epoch gather run in multithreaded C++ when
 count coming from the CLI's ``-j/--workers`` flag. Every entry point has a
 pure-NumPy fallback in ``data/mnist.py`` / ``data/loader.py``; the native
 path is an optimization, never a requirement.
+
+Serving note (DESIGN.md §7k): on a FUSED serve plane the per-request
+``tm_cast_f32``/``tm_normalize``/``tm_quant_i8`` calls disappear — raw
+uint8 requests stage as bytes and that math runs inside the fused XLA
+program. These kernels remain the training input path and the split
+(``--no-fuse`` / float-input) serve plane, which is the bitwise
+reference the fused programs are pinned against.
 """
 
 from __future__ import annotations

@@ -57,6 +57,7 @@ class MultiHeadSelfAttention(nn.Module):
     num_heads: int
     attention_fn: Optional[Callable] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
+    dot_general: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -64,13 +65,15 @@ class MultiHeadSelfAttention(nn.Module):
         h = self.num_heads
         assert c % h == 0, f"embed dim {c} not divisible by heads {h}"
         d = c // h
-        qkv = nn.Dense(3 * c, dtype=self.compute_dtype, name="qkv")(x)
+        qkv = nn.Dense(3 * c, dtype=self.compute_dtype,
+                       dot_general=self.dot_general, name="qkv")(x)
         qkv = qkv.reshape(b, t, 3, h, d)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attend = self.attention_fn or full_attention
         o = attend(q, k, v)  # (B, T, H, D)
         o = o.reshape(b, t, c).astype(self.compute_dtype)
-        return nn.Dense(c, dtype=self.compute_dtype, name="proj")(o)
+        return nn.Dense(c, dtype=self.compute_dtype,
+                        dot_general=self.dot_general, name="proj")(o)
 
 
 class TransformerBlock(nn.Module):
@@ -80,18 +83,22 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     attention_fn: Optional[Callable] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
+    dot_general: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         c = x.shape[-1]
         y = nn.LayerNorm(dtype=self.compute_dtype, name="ln1")(x)
         x = x + MultiHeadSelfAttention(
-            self.num_heads, self.attention_fn, self.compute_dtype, name="attn"
+            self.num_heads, self.attention_fn, self.compute_dtype,
+            dot_general=self.dot_general, name="attn"
         )(y)
         y = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
-        y = nn.Dense(self.mlp_ratio * c, dtype=self.compute_dtype, name="mlp1")(y)
+        y = nn.Dense(self.mlp_ratio * c, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="mlp1")(y)
         y = nn.gelu(y)
-        y = nn.Dense(c, dtype=self.compute_dtype, name="mlp2")(y)
+        y = nn.Dense(c, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="mlp2")(y)
         return x + y
 
 
@@ -107,6 +114,10 @@ class VisionTransformer(nn.Module):
     mlp_ratio: int = 4
     attention_fn: Optional[Callable] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # Matmul implementation for every Dense in the model (None =
+    # lax.dot_general); the int8 serving plane injects the MXU-native
+    # int8 kernel (ops/pallas/matmul_i8.py) through this field.
+    dot_general: Optional[Callable] = None
     # jax.checkpoint around each block: activations inside a block are
     # recomputed during backward instead of stored, the standard TPU
     # HBM-for-FLOPs trade for long sequences (the FLOPs rerun on an MXU
@@ -120,7 +131,8 @@ class VisionTransformer(nn.Module):
         # Accept flat (B, 784), (B, 28, 28), or (B, 28, 28, 1) like the other
         # zoo models, so the same data pipeline feeds all of them.
         x = patchify(x, self.patch_size, self.compute_dtype)
-        x = nn.Dense(self.embed_dim, dtype=self.compute_dtype, name="embed")(x)
+        x = nn.Dense(self.embed_dim, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="embed")(x)
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(stddev=0.02),
@@ -131,9 +143,11 @@ class VisionTransformer(nn.Module):
         for i in range(self.depth):
             x = block_cls(
                 self.num_heads, self.mlp_ratio, self.attention_fn,
-                self.compute_dtype, name=f"block{i}",
+                self.compute_dtype, dot_general=self.dot_general,
+                name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
         x = jnp.mean(x, axis=1)
-        x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="head")(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="head")(x)
         return x.astype(jnp.float32)

@@ -13,6 +13,8 @@ are multiples of 8 to line up with VPU/MXU tiling.
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -25,6 +27,11 @@ class ConvNet(nn.Module):
 
     num_classes: int = 10
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # Matmul implementation for the Dense layers (None = lax.dot_general).
+    # The int8 serving plane injects the MXU-native int8 kernel here
+    # (ops/pallas/matmul_i8.py); model_accepts("cnn", "dot_general")
+    # gates the wiring.
+    dot_general: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -42,7 +49,9 @@ class ConvNet(nn.Module):
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(128, dtype=self.compute_dtype, name="fc1")(x)
+        x = nn.Dense(128, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="fc1")(x)
         x = nn.relu(x)
-        x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="fc2")(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="fc2")(x)
         return x.astype(jnp.float32)

@@ -14,6 +14,8 @@ cross-entropy reduction is accurate.
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -26,10 +28,14 @@ class LinearNet(nn.Module):
 
     num_classes: int = 10
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # Matmul implementation for the Dense layer (None = lax.dot_general);
+    # the int8 serving plane injects ops/pallas int8_dot_general here.
+    dot_general: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         del train  # no train-time-only behavior (parity: reference has none)
         x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
-        x = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="fc")(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     dot_general=self.dot_general, name="fc")(x)
         return x.astype(jnp.float32)

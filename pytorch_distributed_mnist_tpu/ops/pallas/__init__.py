@@ -19,6 +19,11 @@ hand-written kernels cover the two places a fused kernel beats stock XLA:
   in VMEM) with a single-pass backward from the saved lse
   (``--loss fused``; ``ops/loss.py`` embeds it in GSPMD via a nested
   shard_map over the data axis).
+- ``int8_dot_general``: int8 x int8 -> int32 MXU-native matmul (dynamic
+  per-tensor symmetric scales, RNE rounding) behind a ``lax.dot_general``
+  drop-in — the int8 serving precision's forward matmul, injected
+  through the models' ``dot_general`` field so int8 buys chip clock,
+  not just smaller transfers.
 
 Every kernel auto-selects interpret mode off-TPU so the whole suite runs
 hermetically on the virtual CPU mesh (tests/conftest.py).
@@ -28,6 +33,11 @@ from pytorch_distributed_mnist_tpu.ops.pallas.adam import fused_adam_leaf, palla
 from pytorch_distributed_mnist_tpu.ops.pallas.flash import (
     flash_attention,
     sharded_flash_attention,
+)
+from pytorch_distributed_mnist_tpu.ops.pallas.matmul_i8 import (
+    int8_dot_general,
+    matmul_i8,
+    quantize_dynamic_i8,
 )
 from pytorch_distributed_mnist_tpu.ops.pallas.xent import (
     fused_cross_entropy,
@@ -41,4 +51,7 @@ __all__ = [
     "sharded_flash_attention",
     "fused_cross_entropy",
     "fused_cross_entropy_per_example",
+    "int8_dot_general",
+    "matmul_i8",
+    "quantize_dynamic_i8",
 ]

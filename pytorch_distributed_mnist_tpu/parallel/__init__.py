@@ -21,8 +21,12 @@ from pytorch_distributed_mnist_tpu.parallel.ulysses import (
     ulysses_attention_local,
 )
 from pytorch_distributed_mnist_tpu.parallel.tensor import (
+    allgather_matmul,
+    create_overlap_tp_vit_state,
+    make_overlap_tp_vit_apply,
     make_tp_eval_step,
     make_tp_train_step,
+    overlap_tp_rules,
     shard_state,
     state_shardings,
     vit_tp_rules,
@@ -46,8 +50,12 @@ __all__ = [
     "ring_attention_local",
     "ulysses_attention",
     "ulysses_attention_local",
+    "allgather_matmul",
+    "create_overlap_tp_vit_state",
+    "make_overlap_tp_vit_apply",
     "make_tp_eval_step",
     "make_tp_train_step",
+    "overlap_tp_rules",
     "shard_state",
     "state_shardings",
     "vit_tp_rules",

@@ -340,12 +340,15 @@ class MicroBatcher:
             'fill' the batch on paper and flush the 1-row alone with
             coalescing time still on the clock."""
             rows = 0
+            dtype = None
             for p in self._queue:
                 if p.abandoned:
                     continue
-                if rows and rows + p.rows > self.max_batch:
+                if rows and (rows + p.rows > self.max_batch
+                             or p.images.dtype != dtype):
                     break
                 rows += p.rows
+                dtype = p.images.dtype
                 if rows >= self.max_batch:
                     break
             return rows
@@ -380,8 +383,15 @@ class MicroBatcher:
                     # Never split one request across batches: results map
                     # back by whole slices. A request bigger than
                     # max_batch rides alone (the engine chunks it through
-                    # the top bucket).
-                    if taken and rows + head.rows > self.max_batch:
+                    # the top bucket). Never MIX dtypes either: with the
+                    # fused serve plane, raw uint8 requests ride the
+                    # preprocess passthrough next to already-normalized
+                    # float ones, and np.concatenate's promotion would
+                    # silently reinterpret 0-255 bytes as normalized
+                    # pixels — a dtype change flushes the batch instead.
+                    if taken and (rows + head.rows > self.max_batch
+                                  or head.images.dtype
+                                  != taken[0].images.dtype):
                         break
                     self._queue.pop(0)
                     taken.append(head)

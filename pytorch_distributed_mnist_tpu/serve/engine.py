@@ -62,7 +62,9 @@ entirely (the bitwise-exactness tests pin that path).
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import warnings
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -77,6 +79,19 @@ from pytorch_distributed_mnist_tpu.train.steps import (
 )
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """Backends that cannot alias a donated host buffer (CPU — the test
+    and interpret-mode world) warn once per fused-program compile that
+    the donation was unusable. The fused plane is DESIGNED to run there
+    (correctness is backend-independent; the aliasing is a TPU win), so
+    the warning is expected noise around fused compiles, not a bug."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
 
 
 class StagingPool:
@@ -98,6 +113,7 @@ class StagingPool:
         self._lock = threading.Lock()
         self._free: dict = {b: [] for b in buckets}
         self._allocated = {b: 0 for b in buckets}
+        self._retired = {b: 0 for b in buckets}
 
     def acquire(self, bucket: int) -> np.ndarray:
         """Pop a free staging buffer for ``bucket`` (allocate only when
@@ -114,6 +130,24 @@ class StagingPool:
         with self._lock:
             for bucket, buf in buffers:
                 self._free[bucket].append(buf)
+
+    def retire(self, buffers: List[Tuple[int, np.ndarray]]) -> None:
+        """Permanently drop buffers whose bytes were DONATED to a
+        compiled program (``donate_argnums``): XLA owns that memory now
+        — on backends that alias host buffers into device arrays,
+        re-appending a donated buffer to the free-list would hand a
+        future batch memory the program may already have overwritten (a
+        use-after-free in staging clothing). Retired buffers are counted
+        so tests can pin the lifecycle; the free-list never sees them
+        again."""
+        with self._lock:
+            for bucket, _buf in buffers:
+                self._retired[bucket] += 1
+
+    def retired(self) -> dict:
+        """Total buffers retired (donated, dropped) per bucket."""
+        with self._lock:
+            return dict(self._retired)
 
     def allocated(self) -> dict:
         """Total buffers ever allocated per bucket — the steady-state
@@ -193,6 +227,25 @@ def preprocess_images(images, input_shape: Tuple[int, ...],
         f"expected uint8 (N, {', '.join(map(str, raw_shape))}) raw "
         f"images or float32 (N, {', '.join(map(str, input_shape))})"
         f" normalized images; got {arr.dtype} {arr.shape}")
+
+
+def as_raw_images(images, input_shape: Tuple[int, ...]) \
+        -> Optional[np.ndarray]:
+    """The fused plane's validation: raw uint8 ``(N, 28, 28)`` request
+    pixels (a single example may drop its leading axis) pass through
+    UNNORMALIZED — the fused bucket programs take the bytes themselves.
+    Returns ``None`` for anything else (already-normalized float input,
+    wrong shape), which routes the caller to the split plane — the split
+    path stays the one place float inputs are validated and served."""
+    arr = np.asarray(images)
+    if arr.dtype != np.uint8 or arr.size == 0:
+        return None
+    raw_shape = input_shape[:-1]  # e.g. (28, 28): pre-channel
+    if arr.shape == raw_shape:
+        arr = arr[None]
+    if arr.ndim == len(raw_shape) + 1 and arr.shape[1:] == raw_shape:
+        return arr
+    return None
 
 
 def bucket_for(buckets: Sequence[int], n: int) -> int:
@@ -275,6 +328,7 @@ class InferenceEngine:
         workers: int = 4,
         placement=None,
         precision: Optional[str] = None,
+        fuse: bool = False,
     ) -> None:
         buckets = sorted({int(b) for b in buckets})
         if not buckets or buckets[0] < 1:
@@ -322,6 +376,35 @@ class InferenceEngine:
         else:
             self._sharding = None
             self._jit = jax.jit(self._forward)  # lazy fallback, same program
+        # The FUSED (whole-program) plane: one additional program per
+        # bucket taking the raw staged uint8 bytes — normalize (and int8
+        # activation quantization) runs inside XLA, bitwise-pinned to
+        # the host twins (serve/programs.py), and the staged batch is
+        # DONATED (its buffer is retired from the free-list, never
+        # re-pinned). The split programs above stay compiled alongside:
+        # they serve float (already-normalized) inputs, and they are the
+        # bitwise reference --no-fuse pins against.
+        self.fuse = bool(fuse)
+        self.raw_shape = self.input_shape[:-1]
+        self._fused_compiled = {}  # bucket -> Compiled executable
+        if self.fuse:
+            fused = self._precision_spec.wrap_fused_forward(
+                make_forward_program(apply_fn))
+            if placement is not None:
+                self._fused_jit = placement.jit_fused_forward(fused)
+            elif device is not None:
+                self._fused_jit = jax.jit(
+                    fused, in_shardings=self._sharding,
+                    out_shardings=self._sharding, donate_argnums=(1,))
+            else:
+                self._fused_jit = jax.jit(fused, donate_argnums=(1,))
+            # Raw uint8 staging, one buffer per dispatch: acquired, always
+            # COPIED into (donating a request's own array would corrupt
+            # the pool's failover redispatch, which re-sends the same
+            # rows), then retired at dispatch because donation hands the
+            # bytes to XLA.
+            self._fused_staging = StagingPool(self.buckets, self.raw_shape,
+                                              dtype=np.uint8)
         self._lock = threading.Lock()
         # Committed to device once per swap, not once per request.
         self._params = self._place(params)
@@ -379,6 +462,14 @@ class InferenceEngine:
         base = f"serve_forward_b{bucket}"
         return f"{base}@{self.name}" if self.name else base
 
+    def fused_program_name(self, bucket: int) -> str:
+        """The fused program's ``CompileLog`` name: the ``.fused`` tag
+        rides the bucket segment (``serve_forward_b{bucket}.fused@{name}``)
+        so every ``serve_forward_`` prefix filter — /stats' compile
+        block, the bench recompile verdicts — covers both planes."""
+        base = f"serve_forward_b{bucket}.fused"
+        return f"{base}@{self.name}" if self.name else base
+
     def warmup(self) -> None:
         """AOT-compile every bucket's forward program (idempotent).
 
@@ -399,6 +490,21 @@ class InferenceEngine:
             self._compiled[bucket] = precompile(
                 self._jit, params_spec, image_spec,
                 program=self.program_name(bucket))
+        if not self.fuse:
+            return
+        # The fused plane warms alongside the split one: BOTH are
+        # steady-state programs (raw uint8 requests ride fused, float
+        # ones ride split), so both must be executables before the
+        # socket opens for the zero-recompile guarantee to cover them.
+        for bucket in self.buckets:
+            if bucket in self._fused_compiled:
+                continue
+            raw_spec = jax.ShapeDtypeStruct(
+                (bucket,) + self.raw_shape, np.uint8)
+            with _quiet_donation():
+                self._fused_compiled[bucket] = precompile(
+                    self._fused_jit, params_spec, raw_spec,
+                    program=self.fused_program_name(bucket))
 
     def swap_params(self, params, epoch: Optional[int] = None,
                     path: Optional[str] = None) -> bool:
@@ -437,7 +543,18 @@ class InferenceEngine:
     def preprocess(self, images: np.ndarray) -> np.ndarray:
         """Raw request pixels -> the float32 normalized layout training
         uses (module-level :func:`preprocess_images`, shared with the
-        per-stage MPMD plane)."""
+        per-stage MPMD plane).
+
+        On a FUSED engine, validated raw uint8 input passes through
+        unnormalized — the whole point of the fused plane is that the
+        normalize runs inside the compiled program, so the batcher
+        coalesces uint8 rows and dispatch routes them to the fused
+        bucket programs. Float (already-normalized) input still takes
+        the split path either way."""
+        if self.fuse:
+            raw = as_raw_images(images, self.input_shape)
+            if raw is not None:
+                return raw
         return preprocess_images(images, self.input_shape, self.workers)
 
     # -- staging-buffer lifecycle -----------------------------------------
@@ -445,10 +562,25 @@ class InferenceEngine:
     def _release_staging(self, buffers: List[Tuple[int, np.ndarray]]) -> None:
         self._staging.release(buffers)
 
+    def _retire_fused_staging(self,
+                              buffers: List[Tuple[int, np.ndarray]]) -> None:
+        # Deliberately a SEPARATE function from _release_staging: a
+        # donated buffer must never reach release() (the analyzer's
+        # donation-discipline rule fires on any function that can route
+        # one buffer to both).
+        self._fused_staging.retire(buffers)
+
     def staging_allocated(self) -> dict:
         """Total buffers ever allocated per bucket (see
         :meth:`StagingPool.allocated`)."""
         return self._staging.allocated()
+
+    def fused_staging_retired(self) -> dict:
+        """Donated-and-dropped fused staging buffers per bucket (the
+        donation lifecycle's observable; zeros on an unfused engine)."""
+        if not self.fuse:
+            return {}
+        return self._fused_staging.retired()
 
     # -- dispatch / complete ----------------------------------------------
 
@@ -474,6 +606,47 @@ class InferenceEngine:
             self.serve_log.record_batch(n, bucket, replica=self.name)
         return out
 
+    def _dispatch_fused(self, raw: np.ndarray) -> _InFlightBatch:
+        """The whole-program hot path: host work is ONE bytes-copy into
+        a raw uint8 staging buffer per chunk; normalize/quantize/forward
+        all run inside the fused bucket program. The staging buffer is
+        ALWAYS copied into (never the split path's exact-fit zero-copy:
+        the program donates its input, and donating a request's own
+        array would corrupt the pool's failover redispatch, which
+        re-sends the same rows) and RETIRED at dispatch — donation hands
+        the bytes to XLA, so the free-list must never see the buffer
+        again. The in-flight batch therefore pins nothing."""
+        with self._lock:
+            params = self._params  # captured ONCE: swap-atomicity boundary
+            epoch = self._params_epoch
+        chunks = []
+        for start in range(0, raw.shape[0], self.max_batch):
+            chunk = raw[start:start + self.max_batch]
+            n = chunk.shape[0]
+            bucket = self.bucket_for(n)
+            buf = self._fused_staging.acquire(bucket)
+            buf[:n] = chunk
+            if n < bucket:
+                # Raw-zero padding: the program normalizes pad rows to
+                # (0-mean)/std rather than the split plane's 0.0 — the
+                # real rows' logits are unaffected (the forward is
+                # row-independent) and pad rows are sliced off at
+                # complete(); DESIGN.md §7k names the one exception
+                # (batch-coupled capacity routing) as a --no-fuse case.
+                buf[n:] = 0
+            x = self._place_input(buf)
+            self._retire_fused_staging([(bucket, buf)])
+            compiled = self._fused_compiled.get(bucket)
+            if compiled is not None:
+                out = compiled(params, x)
+            else:
+                with _quiet_donation():
+                    out = self._fused_jit(params, x)
+            if self.serve_log is not None:
+                self.serve_log.record_batch(n, bucket, replica=self.name)
+            chunks.append((out, n))
+        return _InFlightBatch(self, chunks, epoch, [])
+
     def dispatch_logits(self, images) -> _InFlightBatch:
         """Preprocess + stage + enqueue the forward WITHOUT waiting for
         the result: the returned :class:`_InFlightBatch` holds device
@@ -481,7 +654,16 @@ class InferenceEngine:
         goes on to form/stage the next batch. Params and epoch are
         captured together under the lock, once for every chunk — the same
         swap-atomicity boundary the synchronous path has. Batches larger
-        than the top bucket are chunked through it."""
+        than the top bucket are chunked through it.
+
+        A FUSED engine routes validated raw uint8 input to the fused
+        bucket programs (:meth:`_dispatch_fused`); float input — already
+        normalized upstream — keeps the split path below, which is also
+        the ``--no-fuse`` reference plane."""
+        if self.fuse:
+            raw = as_raw_images(images, self.input_shape)
+            if raw is not None:
+                return self._dispatch_fused(raw)
         x = self.preprocess(images)
         # Host-side activation transform (int8 plane: quantize the whole
         # normalized batch once with the fixed scale — native v4 kernel,

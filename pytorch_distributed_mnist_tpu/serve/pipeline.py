@@ -57,6 +57,7 @@ without special-casing.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -72,6 +73,8 @@ from pytorch_distributed_mnist_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     StagingPool,
     _InFlightBatch,
+    _quiet_donation,
+    as_raw_images,
     bucket_for,
     preprocess_images,
     stage_batch,
@@ -87,21 +90,30 @@ class _StageProgram:
     executable per batch bucket. Holds no params — the engine owns the
     per-stage params list so the cross-stage swap stays atomic."""
 
-    __slots__ = ("index", "device", "sharding", "name", "forward", "_jit",
-                 "_compiled")
+    __slots__ = ("index", "device", "sharding", "name", "forward", "fused",
+                 "_jit", "_compiled")
 
-    def __init__(self, index: int, forward, device, name: str) -> None:
+    def __init__(self, index: int, forward, device, name: str,
+                 fused: bool = False) -> None:
         self.index = index
         self.device = device
         self.name = name  # e.g. "pipeline.s0" / "pipeline.g1.s0"
         self.forward = forward
+        self.fused = fused
         self.sharding = jax.sharding.SingleDeviceSharding(device)
-        self._jit = jax.jit(forward, in_shardings=self.sharding,
-                            out_shardings=self.sharding)
+        jit_kwargs = dict(in_shardings=self.sharding,
+                          out_shardings=self.sharding)
+        if fused:
+            # The fused stage-0 program consumes the raw uint8 staging
+            # buffer and DONATES it — the chain's only H2D transfer is
+            # the raw bytes, and XLA owns them afterwards.
+            jit_kwargs["donate_argnums"] = (1,)
+        self._jit = jax.jit(forward, **jit_kwargs)
         self._compiled = {}  # bucket -> Compiled executable
 
     def program_name(self, bucket: int) -> str:
-        return f"serve_forward_b{bucket}@{self.name}"
+        tag = ".fused" if self.fused else ""
+        return f"serve_forward_b{bucket}{tag}@{self.name}"
 
     def warmup(self, params_spec, in_specs: dict) -> dict:
         """AOT-compile every bucket's program (idempotent; measured
@@ -112,9 +124,12 @@ class _StageProgram:
         out_specs = {}
         for bucket, spec in in_specs.items():
             if bucket not in self._compiled:
-                self._compiled[bucket] = precompile(
-                    self._jit, params_spec, spec,
-                    program=self.program_name(bucket))
+                quiet = (_quiet_donation() if self.fused
+                         else contextlib.nullcontext())
+                with quiet:
+                    self._compiled[bucket] = precompile(
+                        self._jit, params_spec, spec,
+                        program=self.program_name(bucket))
             out_specs[bucket] = jax.eval_shape(self.forward, params_spec,
                                                spec)
         return out_specs
@@ -128,7 +143,9 @@ class _StageProgram:
         # Lazy fallback (warmup skipped or failed): same program via
         # jit — correctness preserved; the no-recompile guarantee is
         # what warmup buys.
-        return self._jit(params, x)
+        quiet = _quiet_donation() if self.fused else contextlib.nullcontext()
+        with quiet:
+            return self._jit(params, x)
 
 
 class PipelineEngine:
@@ -156,6 +173,7 @@ class PipelineEngine:
         name: str = "pipeline",
         workers: int = 4,
         precision: Optional[str] = None,
+        fuse: bool = False,
     ) -> None:
         devices = list(devices)
         if not devices:
@@ -181,16 +199,32 @@ class PipelineEngine:
 
         self._precision_spec = get_precision(precision)
         self.precision = self._precision_spec.name
+        stage_fwds = list(make_stage_forward_fns(model, self.n_stages))
         forwards = [
             self._precision_spec.wrap_stage_forward(
                 fwd, first=(k == 0), last=(k == self.n_stages - 1))
-            for k, fwd in enumerate(
-                make_stage_forward_fns(model, self.n_stages))
+            for k, fwd in enumerate(stage_fwds)
         ]
         self._stages = [
             _StageProgram(k, fwd, dev, f"{name}.s{k}")
             for k, (fwd, dev) in enumerate(zip(forwards, devices))
         ]
+        # Whole-program fusion cuts in at the chain's ONLY host boundary
+        # — stage 0: a second stage-0 program consumes the raw staged
+        # uint8 bytes (normalize + int8 activation quant inside XLA,
+        # bitwise twins of the host path) and donates its buffer. Later
+        # stages see the identical activation contract either way, so
+        # they need no fused variant — the split chain past stage 0 IS
+        # the fused chain past stage 0.
+        self.fuse = bool(fuse)
+        self.raw_shape = self.input_shape[:-1]
+        if self.fuse:
+            fused0 = self._precision_spec.wrap_fused_stage_forward(
+                stage_fwds[0], first=True, last=(self.n_stages == 1))
+            self._fused_stage0 = _StageProgram(
+                0, fused0, devices[0], f"{name}.s0", fused=True)
+            self._fused_staging = StagingPool(self.buckets, self.raw_shape,
+                                              dtype=np.uint8)
         self._lock = threading.Lock()
         self._stage_params = self._place_stages(params)
         self._params_epoch = params_epoch
@@ -238,6 +272,19 @@ class PipelineEngine:
         }
         for stage, params in zip(self._stages, stage_params):
             specs = stage.warmup(abstract_spec(params), specs)
+        if not self.fuse:
+            return
+        # The fused stage-0 programs warm alongside: raw uint8 buckets
+        # in, the SAME activation spec out as split stage 0 (the fused
+        # wrapper prepends in-XLA normalize/quant to the identical
+        # post-normalize math), so stages 1..S-1 — already warmed above
+        # — cover both planes and the fused chain adds exactly one
+        # program per bucket.
+        raw_specs = {
+            b: jax.ShapeDtypeStruct((b,) + self.raw_shape, np.uint8)
+            for b in self.buckets
+        }
+        self._fused_stage0.warmup(abstract_spec(stage_params[0]), raw_specs)
 
     def swap_params(self, params, epoch: Optional[int] = None,
                     path: Optional[str] = None) -> bool:
@@ -267,10 +314,29 @@ class PipelineEngine:
         return bucket_for(self.buckets, n)
 
     def preprocess(self, images) -> np.ndarray:
+        if self.fuse:
+            raw = as_raw_images(images, self.input_shape)
+            if raw is not None:
+                return raw  # validated raw bytes: the fused plane's input
         return preprocess_images(images, self.input_shape, self.workers)
 
     def staging_allocated(self) -> dict:
         return self._staging.allocated()
+
+    def _retire_fused_staging(self,
+                              buffers: List[Tuple[int, np.ndarray]]) -> None:
+        # Retirement-only twin of the split plane's release path: a
+        # donated buffer must never reach release() (the analyzer's
+        # donation-discipline rule pins that retire and release never
+        # share a routing function).
+        self._fused_staging.retire(buffers)
+
+    def fused_staging_retired(self) -> dict:
+        """Donated-and-dropped buffer counts per bucket (empty when the
+        fused plane is off)."""
+        if not self.fuse:
+            return {}
+        return self._fused_staging.retired()
 
     def _dispatch_bucket(self, stage_params: List, images: np.ndarray,
                          buffers) -> Tuple:
@@ -292,13 +358,50 @@ class PipelineEngine:
             self.serve_log.record_batch(n, bucket, replica=self.name)
         return x
 
+    def _dispatch_fused(self, raw: np.ndarray) -> _InFlightBatch:
+        """Whole-program chain dispatch: one bytes-copy into the raw
+        uint8 staging buffer, the fused stage-0 program (normalize/quant
+        inside XLA, buffer DONATED and retired at dispatch), then the
+        ordinary stage 1..S-1 chain — identical activations, identical
+        programs. The in-flight batch pins no buffers."""
+        with self._lock:
+            stage_params = list(self._stage_params)  # captured ONCE
+            epoch = self._params_epoch
+        chunks = []
+        for start in range(0, raw.shape[0], self.max_batch):
+            chunk = raw[start:start + self.max_batch]
+            n = chunk.shape[0]
+            bucket = self.bucket_for(n)
+            buf = self._fused_staging.acquire(bucket)
+            buf[:n] = chunk
+            if n < bucket:
+                buf[n:] = 0  # pad rows sliced off at complete()
+            x = jax.device_put(buf, self._stages[0].sharding)
+            self._retire_fused_staging([(bucket, buf)])
+            x = self._fused_stage0.run(stage_params[0], x)
+            for stage, params in zip(self._stages[1:], stage_params[1:]):
+                x = jax.device_put(x, stage.sharding)  # D2D hop
+                x = stage.run(params, x)
+            if self.serve_log is not None:
+                self.serve_log.record_batch(n, bucket, replica=self.name)
+            chunks.append((x, n))
+        return _InFlightBatch(self, chunks, epoch, [])
+
     def dispatch_logits(self, images) -> _InFlightBatch:
         """Preprocess + stage + enqueue the per-stage chain WITHOUT
         waiting (the PR 4 two-phase API): the returned batch holds
         device futures that materialize while the caller forms the next
         batch. The per-stage params and the epoch are captured together
         under the lock, once per batch — the cross-stage swap-atomicity
-        boundary. Batches larger than the top bucket are chunked."""
+        boundary. Batches larger than the top bucket are chunked.
+
+        A FUSED chain routes validated raw uint8 input through the fused
+        stage-0 programs (:meth:`_dispatch_fused`); float input keeps
+        the split path below — the ``--no-fuse`` reference plane."""
+        if self.fuse:
+            raw = as_raw_images(images, self.input_shape)
+            if raw is not None:
+                return self._dispatch_fused(raw)
         x = self.preprocess(images)
         # Host-side activation transform (int8 plane: quantize once with
         # the fixed scale before chunking — the staged buffers and the
@@ -407,7 +510,8 @@ def make_pipeline_template(model, rng):
 
 def pipeline_engine_factory(*, model, model_name, params, devices, name,
                             buckets, input_shape, serve_log, params_epoch,
-                            workers, apply_fn=None, precision=None):
+                            workers, apply_fn=None, precision=None,
+                            fuse=False):
     """The registry's engine hook (``serve/programs.py`` registers mode
     ``pipeline`` with it): one pipeline CHAIN spanning ``devices``
     (stage k on chip k). Needs the model CONFIG, not just an apply_fn —
@@ -421,4 +525,4 @@ def pipeline_engine_factory(*, model, model_name, params, devices, name,
     return PipelineEngine(
         model, params, devices, buckets=buckets, input_shape=input_shape,
         serve_log=serve_log, params_epoch=params_epoch, name=name,
-        workers=workers, precision=precision)
+        workers=workers, precision=precision, fuse=fuse)

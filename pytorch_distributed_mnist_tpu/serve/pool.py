@@ -199,6 +199,7 @@ class EnginePool:
         regroup_retries: int = 3,
         precision: Optional[str] = None,
         name_prefix: str = "",
+        fuse: bool = False,
     ) -> None:
         devices = list(devices) if devices is not None \
             else list(jax.local_devices())
@@ -240,6 +241,11 @@ class EnginePool:
 
         self._precision_spec = get_precision(precision)
         self.precision = self._precision_spec.name
+        # Whole-program dispatch plane (fused raw-bytes -> logits bucket
+        # programs, donated staging): one setting per pool, threaded to
+        # every replica/group engine across boot, regroup, and resize so
+        # the fleet never mixes dispatch planes.
+        self.fuse = bool(fuse)
         if serve_mode != "replicated":
             from pytorch_distributed_mnist_tpu.serve.programs import (
                 staged_mode,
@@ -308,7 +314,8 @@ class EnginePool:
                     apply_fn=self.apply_fn, buckets=self._buckets,
                     input_shape=self.input_shape, serve_log=self.serve_log,
                     params_epoch=params_epoch, workers=self.workers,
-                    model=self.model, precision=self.precision)
+                    model=self.model, precision=self.precision,
+                    fuse=self.fuse)
                 replicas.append(EngineReplica(
                     i, group[0], engine, name=name, devices=group))
         else:
@@ -327,7 +334,8 @@ class EnginePool:
                     self.apply_fn, params, buckets=self._buckets,
                     input_shape=self.input_shape, serve_log=self.serve_log,
                     params_epoch=params_epoch, device=device, name=name,
-                    workers=self.workers, precision=self.precision)
+                    workers=self.workers, precision=self.precision,
+                    fuse=self.fuse)
                 replicas.append(EngineReplica(
                     i, device, engine, name=name))
         return replicas
@@ -347,12 +355,12 @@ class EnginePool:
                 name, apply_fn=self.apply_fn, buckets=self._buckets,
                 input_shape=self.input_shape, serve_log=self.serve_log,
                 params_epoch=params_epoch, workers=self.workers,
-                model=self.model, precision=self.precision)
+                model=self.model, precision=self.precision, fuse=self.fuse)
         return InferenceEngine(
             self.apply_fn, params, buckets=self._buckets,
             input_shape=self.input_shape, serve_log=self.serve_log,
             params_epoch=params_epoch, device=devices[0], name=name,
-            workers=self.workers, precision=self.precision)
+            workers=self.workers, precision=self.precision, fuse=self.fuse)
 
     # -- engine-compatible surface ----------------------------------------
 
@@ -736,6 +744,7 @@ class EnginePool:
             "topology_generation": self._topology_generation,
             "serve_mode": self.serve_mode,
             "serve_precision": self.precision,
+            "fused": self.fuse,
             "serve_devices": self.n_devices,
             "mesh_devices": self.mesh_size,
             "groups": len(self.replicas),
@@ -777,6 +786,18 @@ class EnginePool:
         block ``loadgen --expect-groups`` asserts against."""
         with self._lock:
             return self._topology_locked()
+
+    def fused_staging_retired(self) -> dict:
+        """Donated-and-dropped fused staging buffers per bucket, summed
+        across every replica (the donation lifecycle's pool-wide
+        observable; empty when the fused plane is off)."""
+        with self._lock:
+            replicas = list(self.replicas)
+        totals: dict = {}
+        for r in replicas:
+            for bucket, n in r.engine.fused_staging_retired().items():
+                totals[bucket] = totals.get(bucket, 0) + n
+        return totals
 
     def snapshot(self) -> dict:
         """Per-replica rows for ``/stats`` and the JSONL sink: device,

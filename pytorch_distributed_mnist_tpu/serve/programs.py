@@ -57,6 +57,16 @@ compiled program (never a baked constant: a new publish's scales must
 not recompile anything) and hot-reload stays the same atomic reference
 swap. ``CompileLog`` names gain the precision suffix
 (``serve_forward_b{b}@{mode}.{prec}``; f32 keeps the historical names).
+
+**The fused (whole-program) plane** (ISSUE 16): every bucket x mode x
+precision pair can ALSO lower a fused program taking the raw staged
+uint8 bytes — normalize (and, on int8, activation quantization) runs
+inside XLA via :func:`fused_normalize`/:func:`quant_i8_traced`, both
+bitwise-pinned to their host twins, and the staged buffer is DONATED
+(:meth:`MeshPlacement.jit_fused_forward`). ``CompileLog`` names gain a
+``.fused`` tag after the bucket (``serve_forward_b{b}.fused@{mode}``),
+keeping every ``serve_forward_`` prefix filter working. The split plane
+stays compiled alongside as the bitwise reference (``--no-fuse``).
 """
 
 from __future__ import annotations
@@ -299,6 +309,41 @@ def dequantize_params(tree):
         tree, is_leaf=lambda x: isinstance(x, QuantLeaf))
 
 
+def fused_normalize(raw):
+    """In-XLA MNIST normalize, BITWISE-equal to the host
+    ``normalize_images`` path: raw uint8 ``(N, 28, 28)`` tracer ->
+    normalized f32 ``(N, 28, 28, 1)``.
+
+    The constants hide behind ``optimization_barrier`` because XLA's
+    algebraic simplifier otherwise rewrites ``x / const`` into
+    ``x * (1/const)`` — a ~1-ulp-different result that would break the
+    fused-vs-split bitwise pin. With the barrier the divides are genuine
+    IEEE divides, matching the host's NumPy expression (and the native
+    ``tm_normalize`` kernel, which is pinned bitwise to it) over the
+    entire uint8 domain."""
+    from pytorch_distributed_mnist_tpu.data.mnist import MNIST_MEAN, MNIST_STD
+
+    c255, mean, std = jax.lax.optimization_barrier(
+        (jnp.float32(255.0), jnp.float32(MNIST_MEAN),
+         jnp.float32(MNIST_STD)))
+    y = raw.astype(jnp.float32) / c255
+    y = (y - mean) / std
+    return y[..., None]
+
+
+def quant_i8_traced(x):
+    """In-XLA int8 activation quantization, BITWISE-equal to the host
+    :func:`_quant_i8_host` staging path: multiply by the SAME
+    precomputed f32 reciprocal (barrier-hidden, so XLA cannot re-derive
+    it), round-to-nearest-even, clip to ±127. Normalized pixels are
+    always finite, so the host quantizer's NaN pin has nothing to do
+    here."""
+    inv = jax.lax.optimization_barrier(
+        jnp.float32(np.float32(1.0) / ACT_SCALE))
+    scaled = jax.lax.round(x * inv, jax.lax.RoundingMethod.TO_NEAREST_EVEN)
+    return jnp.clip(scaled, -127.0, 127.0).astype(jnp.int8)
+
+
 def _floating_leaf(leaf) -> bool:
     return jnp.issubdtype(jnp.result_type(leaf), jnp.floating)
 
@@ -411,6 +456,45 @@ class ServePrecision:
 
         return stage_forward
 
+    def wrap_fused_forward(self, forward):
+        """The WHOLE-program transform (ISSUE 16 tentpole): raw staged
+        uint8 bytes -> f32 logits in ONE compiled program. The host
+        preprocess (``tm_normalize``) and the int8 activation staging
+        (``tm_quant_i8``) move into XLA via the bitwise-pinned
+        :func:`fused_normalize` / :func:`quant_i8_traced`, then the math
+        continues through the SAME :meth:`wrap_forward` transform the
+        split plane compiles — the two planes share every op after the
+        normalize, which is what makes the fused-vs-split logit pins
+        bitwise at exact-fit buckets."""
+        spec = self
+        split = self.wrap_forward(forward)
+
+        def fused_forward(params, raw):
+            x = fused_normalize(raw)
+            if spec.int8_activations:
+                x = quant_i8_traced(x)
+            return split(params, x)
+
+        return fused_forward
+
+    def wrap_fused_stage_forward(self, forward, first: bool, last: bool):
+        """The MPMD fusion seam: only stage 0 consumes staged bytes, so
+        only its program prepends the in-XLA normalize (+ int8 quant);
+        later stages keep their :meth:`wrap_stage_forward` programs
+        byte-identical to the split chain."""
+        base = self.wrap_stage_forward(forward, first, last)
+        if not first:
+            return base
+        spec = self
+
+        def fused_stage(params, raw):
+            x = fused_normalize(raw)
+            if spec.int8_activations:
+                x = quant_i8_traced(x)
+            return base(params, x)
+
+        return fused_stage
+
     def stage_host(self, images: np.ndarray, workers: int = 4) -> np.ndarray:
         if not self.int8_activations:
             return images
@@ -521,6 +605,18 @@ class MeshPlacement:
             forward,
             in_shardings=(self.param_shardings, self.input_sharding),
             out_shardings=self.output_sharding,
+        )
+
+    def jit_fused_forward(self, forward):
+        """The fused (whole-program) pjit: same shardings, but the raw
+        staged batch is DONATED — its buffer belongs to XLA after the
+        call, which is why the engine retires (never re-pins) the
+        staging buffer it copied from."""
+        return jax.jit(
+            forward,
+            in_shardings=(self.param_shardings, self.input_sharding),
+            out_shardings=self.output_sharding,
+            donate_argnums=(1,),
         )
 
 
@@ -677,7 +773,8 @@ def build_group_placements(mode: str, model_name: str, devices: Sequence,
 def build_group_engine(mode: str, model_name: str, devices: Sequence,
                        params, name: str, *, apply_fn, buckets,
                        input_shape, serve_log, params_epoch, workers,
-                       model=None, precision: Optional[str] = None):
+                       model=None, precision: Optional[str] = None,
+                       fuse: bool = False):
     """ONE engine spanning ``devices`` for ``mode`` — the single builder
     the pool's boot, regroup, and resize paths all share, which is what
     keeps a registered mode's engine construction from drifting between
@@ -686,7 +783,9 @@ def build_group_engine(mode: str, model_name: str, devices: Sequence,
     (MPMD pipeline) builds its own engine behind the same surface.
     ``name`` arrives with its precision suffix already composed
     (:func:`precision_engine_name`); ``precision`` selects the program/
-    quantization plane."""
+    quantization plane; ``fuse`` turns on the whole-program (raw-bytes
+    -> logits, donated staging) dispatch plane on whatever engine the
+    mode lowers to."""
     spec = _get_mode(mode)
     if spec.engine_factory is not None:
         return spec.engine_factory(
@@ -694,7 +793,7 @@ def build_group_engine(mode: str, model_name: str, devices: Sequence,
             params=params, devices=list(devices), name=name,
             buckets=buckets, input_shape=input_shape, serve_log=serve_log,
             params_epoch=params_epoch, workers=workers,
-            precision=precision)
+            precision=precision, fuse=fuse)
     from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
 
     placement = build_placement(mode, model_name, list(devices), params,
@@ -703,7 +802,7 @@ def build_group_engine(mode: str, model_name: str, devices: Sequence,
         apply_fn, params, buckets=buckets, input_shape=input_shape,
         serve_log=serve_log, params_epoch=params_epoch,
         placement=placement, name=name, workers=workers,
-        precision=precision)
+        precision=precision, fuse=fuse)
 
 
 def check_checkpoint_layout(layout: Optional[dict], mode: str,

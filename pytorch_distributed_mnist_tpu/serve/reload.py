@@ -81,6 +81,13 @@ class CheckpointWatcher:
         # Last path that failed to load: retried only once the listing
         # moves past it, so one corrupt file can't hot-loop the log.
         self._failed: Optional[str] = None
+        # Serializes polls: the background loop and a concurrent caller
+        # (tests drive poll_once directly; /healthz handlers could too)
+        # must not both pass the path==current check and double-install
+        # the same publish — the params swap is epoch-idempotent, but
+        # the second install is a wasted host load + device_put and a
+        # phantom +1 in the reload stats.
+        self._poll_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -90,7 +97,13 @@ class CheckpointWatcher:
 
     def poll_once(self) -> bool:
         """One resolution + (maybe) reload; returns True when new params
-        were installed."""
+        were installed. Serialized against the watcher thread's own
+        polls: a concurrent caller either performs the reload itself or
+        finds ``_current`` already advanced and returns False."""
+        with self._poll_lock:
+            return self._poll_once()
+
+    def _poll_once(self) -> bool:
         path = latest_checkpoint(self.directory)
         if not path or path == self._current or path == self._failed:
             return False

@@ -168,6 +168,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "Quantization happens at param-install time, "
                         "so hot reload stays an atomic swap. Composes "
                         "with every --serve-mode")
+    p.add_argument("--no-fuse", action="store_true",
+                   help="disable whole-program dispatch and serve every "
+                        "request on the SPLIT plane (host-side "
+                        "normalize/quantize/pad, float staging) — the "
+                        "bitwise reference the fused plane is pinned "
+                        "against. Default: fused ON — raw uint8 "
+                        "requests run ONE compiled program per bucket "
+                        "(normalize + quantization inside XLA, staging "
+                        "buffer donated), collapsing host work to a "
+                        "bytes-copy. Use --no-fuse for batch-coupled "
+                        "models whose pad-row semantics must match the "
+                        "host plane exactly (DESIGN.md §7k)")
     p.add_argument("--canary-fraction", type=float, default=0.0,
                    help="shadow-traffic accuracy canary: serve replies "
                         "from the f32 BASELINE while this fraction of "
@@ -396,13 +408,16 @@ class ServeContext:
                  max_inflight: int = 1,
                  serve_mode: str = "replicated",
                  serve_precision: str = "f32",
-                 quotas=None, fair_gate=None) -> None:
+                 quotas=None, fair_gate=None, fused: bool = True) -> None:
         self.planes = planes
         self.default_model = default_model
         self.sink = sink
         self.max_request_images = max_request_images
         self.serve_mode = serve_mode
         self.serve_precision = serve_precision
+        # Which dispatch plane answers raw uint8 requests: fused
+        # whole-program (default) or the --no-fuse split reference.
+        self.fused = fused
         self.quotas = quotas
         self.fair_gate = fair_gate
         self.max_inflight = max_inflight
@@ -516,6 +531,19 @@ class _Handler(BaseHTTPRequestHandler):
         # serving programs lower at — loadgen's report and the
         # --expect-precision smoke read it.
         stats["serve_precision"] = ctx.serve_precision
+        # Always present: which dispatch plane answers raw uint8
+        # requests — True is the fused whole-program plane (raw bytes
+        # -> logits in one XLA program per bucket, donated staging),
+        # False the --no-fuse split reference. loadgen's report and
+        # the --expect-fused smoke read it.
+        stats["fused"] = ctx.fused
+        if ctx.fused:
+            # The donation lifecycle's observable (DESIGN.md §7k):
+            # every fused dispatch donates its staging buffer, which is
+            # then RETIRED — counted here per bucket, summed across the
+            # pool's replicas — never re-listed for reuse.
+            src = plane.pool if plane.pool is not None else plane.engine
+            stats["donated_staging_retired"] = src.fused_staging_retired()
         if plane.canary is not None:
             # The shadow-canary block: state machine position,
             # sampling shape, disagreement counters, logit-delta
@@ -858,7 +886,7 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
     own controller over its own pool)."""
     import jax
 
-    from pytorch_distributed_mnist_tpu.models import get_model
+    from pytorch_distributed_mnist_tpu.models import get_model, model_accepts
     from pytorch_distributed_mnist_tpu.serve.programs import (
         check_checkpoint_layout,
         make_serve_template,
@@ -917,6 +945,13 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
     # --serve-precision to the live registry): a canary only makes
     # sense shadowing a QUANTIZED plane against the f32 baseline.
     serve_precision = getattr(args, "serve_precision", "f32") or "f32"
+    # Whole-program dispatch (ON by default, --no-fuse for the split
+    # reference plane): raw uint8 requests run one fused program per
+    # bucket — normalize/quantize inside XLA, staging donated. Under a
+    # canary BOTH planes fuse (the batcher hands both the same raw
+    # batch; mixed planes would compare different dispatch paths, not
+    # different precisions).
+    fuse = not getattr(args, "no_fuse", False)
     canary_fraction = float(getattr(args, "canary_fraction", 0.0) or 0.0)
     canary_promote_after = int(getattr(args, "canary_promote_after", 200))
     canary_budget = float(getattr(args, "canary_budget", 0.02))
@@ -1040,28 +1075,48 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
     pool = None
     canary = None
 
+    def _model_for(precision: str):
+        """The model instance one precision plane lowers: the int8
+        plane (and only it) gets the MXU-native int8 matmul injected
+        through the model's ``dot_general`` field — PER-PRECISION
+        instances, so a canary's f32 baseline never runs the kernel it
+        is supposed to referee. Params are field-independent: the same
+        checkpoint tree serves both instances."""
+        if precision == "int8" and model_accepts(model_name, "dot_general"):
+            from pytorch_distributed_mnist_tpu.ops.pallas import (
+                int8_dot_general,
+            )
+
+            return get_model(model_name, dot_general=int8_dot_general,
+                             **model_kwargs)
+        return model
+
     def _make_plane(precision: str):
         """ONE data plane at ``precision`` over the resolved shape —
         the single builder both the direct path and the canary's two
         planes go through, so they cannot drift."""
+        plane_model = _model_for(precision)
         if pooled:
             from pytorch_distributed_mnist_tpu.serve.pool import EnginePool
 
             return EnginePool(
-                model.apply, params, devices=devices[:n_devices],
+                plane_model.apply, params, devices=devices[:n_devices],
                 buckets=_parse_buckets(args.buckets), serve_log=serve_log,
                 params_epoch=epoch, workers=getattr(args, "workers", 4),
                 serve_mode=serve_mode, mesh_size=mesh_size,
-                model_name=model_name, model=model,
+                model_name=model_name, model=plane_model,
                 quarantine_after=getattr(args, "quarantine_after", 3),
                 precision=precision, name_prefix=name_prefix,
+                fuse=fuse,
             )
         return InferenceEngine(
-            model.apply, params, buckets=_parse_buckets(args.buckets),
+            plane_model.apply, params,
+            buckets=_parse_buckets(args.buckets),
             serve_log=serve_log, params_epoch=epoch,
             workers=getattr(args, "workers", 4), precision=precision,
             name=precision_engine_name(
                 model_name if multi_model else None, precision),
+            fuse=fuse,
         )
 
     if canary_fraction:
@@ -1143,6 +1198,8 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
         plane = f"{len(engine.buckets)} bucket programs"
     if serve_precision != "f32" and canary is None:
         plane = f"{serve_precision} {plane}"
+    if fuse:
+        plane = f"whole-program fused {plane}"
     print(f"{model_name}: AOT-compiled {plane} "
           f"{list(engine.buckets)} in {time.perf_counter() - t0:.1f}s "
           f"(compile wall {compiled_ms:.0f} ms); steady-state serving "
@@ -1383,7 +1440,8 @@ def create_server(args) -> ThreadingHTTPServer:
         max_request_images=getattr(args, "max_request_images", 1024),
         max_inflight=max_inflight, serve_mode=serve_mode,
         serve_precision=getattr(args, "serve_precision", "f32") or "f32",
-        quotas=quotas, fair_gate=fair_gate)
+        quotas=quotas, fair_gate=fair_gate,
+        fused=not getattr(args, "no_fuse", False))
     return httpd
 
 

@@ -925,3 +925,70 @@ def test_control_module_clean_and_in_lock_graph():
         "AutoScaler._lock", "ClientQuotas._lock", "DrainRate._lock",
         "WeightedFairGate._cv"]
     assert control["order_edges"] == []
+
+
+# -- donation discipline (ISSUE 16) ------------------------------------------
+
+
+def test_fires_on_donated_buffer_re_release():
+    """The whole-program bug signature: one function retires a donated
+    staging buffer AND releases the same buffer back to the free-list —
+    a future batch would stage into memory XLA already owns."""
+    src = """
+class Engine:
+    def dispatch_fused(self, raw):
+        buf = self._fused_staging.acquire(8)
+        out = self._program(self._params, buf)
+        self._fused_staging.retire([(8, buf)])
+        self._fused_staging.release([(8, buf)])
+        return out
+"""
+    (f,) = _findings(src)
+    assert "donation discipline" in f.message
+    assert "'buf'" in f.message
+    assert "use-after-free" in f.message
+    assert "_retire_fused_staging/_release_staging" in f.hint
+
+
+def test_fires_on_shared_buffers_list_routed_both_ways():
+    """Same identity through a shared list variable: routing one
+    ``buffers`` list to both lifecycles fires even without the
+    per-buffer tuple shape."""
+    src = """
+class Engine:
+    def _finish(self, buffers):
+        self._fused_staging.retire(buffers)
+        self._staging.release(buffers)
+"""
+    (f,) = _findings(src)
+    assert "donation discipline" in f.message and "'buffers'" in f.message
+
+
+def test_clean_on_separate_lifecycle_helpers():
+    """The shipped engine shape: retire and release live in separate
+    dedicated helpers, so neither path can reach the other's pool."""
+    src = """
+class Engine:
+    def _release_staging(self, buffers):
+        self._staging.release(buffers)
+
+    def _retire_fused_staging(self, buffers):
+        self._fused_staging.retire(buffers)
+"""
+    assert _findings(src) == []
+
+
+def test_clean_on_distinct_buffers_and_argless_release():
+    """Distinct buffers may take distinct lifecycles in one function,
+    and an argless ``release()`` (semaphores, window tokens) is not a
+    buffer routing."""
+    src = """
+class Engine:
+    def step(self):
+        fused_buf = self._fused_staging.acquire(8)
+        self._fused_staging.retire([(8, fused_buf)])
+        split_buf = self._staging.acquire(8)
+        self._staging.release([(8, split_buf)])
+        self._window.release()
+"""
+    assert _findings(src) == []

@@ -313,3 +313,45 @@ def serve(fn, qparams_spec, image_spec, qparams, staged):
     return exe(qparams, staged)
 """
     assert _findings(src) == []
+
+
+# -- the whole-program plane (ISSUE 16) --------------------------------------
+
+
+def test_fires_on_bucket_literal_into_fused_executable():
+    """The fused plane is ONE AOT program per bucket; threading the
+    bucket size through the compiled program as a scalar argument would
+    re-key it per request — the exact steady-state recompile the fusion
+    exists to delete. Bucket selection belongs OUTSIDE the executable
+    (the per-bucket program table)."""
+    src = """
+class Engine:
+    def warm(self, fused, params_spec, raw_spec):
+        self._fused_fwd = precompile(fused, params_spec, raw_spec,
+                                     program="fwd.fused")
+
+    def dispatch_fused(self, params, staged):
+        return self._fused_fwd(params, staged, 8)
+"""
+    (f,) = _findings(src)
+    assert f.symbol.endswith("dispatch_fused") and "argument 2" in f.message
+
+
+def test_silent_on_donated_fused_dispatch():
+    """The shipped shape: the donated fused program takes arrays only —
+    params tree and the staged raw batch; donation changes buffer
+    ownership, never shapes, so nothing re-keys."""
+    src = """
+import jax
+
+def wrap_fused_forward(fused):
+    return jax.jit(fused, donate_argnums=(1,))
+
+class Engine:
+    def warm(self, fused):
+        self._fused_fwd = wrap_fused_forward(fused)
+
+    def dispatch_fused(self, params, staged):
+        return self._fused_fwd(params, staged)
+"""
+    assert _findings(src) == []

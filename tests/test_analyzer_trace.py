@@ -385,3 +385,46 @@ def make_quant_forward(forward):
     return jax.jit(quant_forward)
 """
     assert _findings(src) == []
+
+
+# -- the whole-program plane (ISSUE 16) --------------------------------------
+
+
+def test_fires_on_host_preprocess_inside_fused_program():
+    """The fused raw->logits program's whole point is moving normalize
+    INTO XLA; an np.asarray on the traced raw batch concretizes the
+    tracer (and silently hands the 'fused' preprocessing back to the
+    host, unfusing the program while keeping the name)."""
+    src = """
+import jax
+import numpy as np
+
+def wrap_fused_forward(forward):
+    def fused(params, raw):
+        x = np.asarray(raw, dtype=np.float32) / 255.0
+        return forward(params, x)
+    return jax.jit(fused, donate_argnums=(1,))
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "fused" and "np.asarray" in f.message
+
+
+def test_silent_on_in_xla_normalize_inside_fused_program():
+    """The shipped fused shape: jnp arithmetic on the traced raw batch
+    with the normalize constants hidden behind an optimization barrier
+    (so constant folding can't perturb the bitwise split-path contract)
+    — trace-clean, donation and all."""
+    src = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def wrap_fused_forward(forward):
+    def fused(params, raw):
+        mean, std = lax.optimization_barrier(
+            (jnp.float32(0.1307), jnp.float32(0.3081)))
+        x = (raw.astype(jnp.float32) / jnp.float32(255.0) - mean) / std
+        return forward(params, x[..., None])
+    return jax.jit(fused, donate_argnums=(1,))
+"""
+    assert _findings(src) == []

@@ -27,6 +27,7 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
         # entries in one process, the exact pattern DESIGN.md 6c bans.
         "BENCH_SERVE_REQUESTS": "64",
         "BENCH_SERVE_POOL_REQUESTS": "64",
+        "BENCH_SERVE_FUSED_REQUESTS": "48",
         "BENCH_SERVE_CONCURRENCY": "8",
         "BENCH_COMPILE_CACHE": "",
         "TPUMNIST_COMPILE_CACHE": "",
@@ -140,6 +141,31 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
     assert any(name.endswith("@bf16") for name in programs)
     assert any("@tensor.int8w" in name for name in programs)
     assert any("@pipeline.int8.s0" in name for name in programs)
+
+    # The whole-program block (ISSUE 16): one fused ViT engine serving
+    # both routes — the ABBA-paired fused-over-split ratio, the
+    # host-work collapse, the staged-bytes ratio (float32 vs raw uint8
+    # = 4x), donated-staging retirement, and the zero-recompile verdict
+    # across BOTH planes. The fused compile rows carry the .fused tag
+    # inside the bucket segment.
+    wp = report["whole_program"]
+    assert wp["model"] == "vit" and wp["images_per_request"] == 8
+    assert wp["fused_over_split_speedup"] > 0
+    assert len(wp["pairs"]) == 4
+    assert wp["requests_per_sec"] > 0
+    host = wp["host_preprocess_ms_per_request"]
+    # The collapse itself: raw passthrough beats host normalization.
+    assert host["fused"] < host["split"]
+    bytes_ = wp["h2d_bytes_per_request"]
+    assert bytes_["split"] == 8 * 28 * 28 * 4
+    assert bytes_["fused"] == 8 * 28 * 28
+    assert bytes_["ratio"] == 4.0
+    assert wp["model_flops_per_image"] > 0
+    assert wp["mfu"] is None  # no honest peak to divide by on CPU
+    assert wp["donated_staging_retired"]["8"] > 0  # JSON keys: strings
+    assert wp["zero_steady_state_recompiles"] is True
+    assert "CPU fallback" in wp["caveat"]
+    assert any(".fused@wp" in name for name in programs)
 
     # The overload block (ISSUE 15): goodput-vs-offered-load curve
     # through the priority batcher, per-class completions + p99, the

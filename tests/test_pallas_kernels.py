@@ -14,6 +14,11 @@ import pytest
 from pytorch_distributed_mnist_tpu.ops.attention import full_attention
 from pytorch_distributed_mnist_tpu.ops.pallas.adam import fused_adam_leaf, pallas_adam
 from pytorch_distributed_mnist_tpu.ops.pallas.flash import flash_attention
+from pytorch_distributed_mnist_tpu.ops.pallas.matmul_i8 import (
+    int8_dot_general,
+    matmul_i8,
+    quantize_dynamic_i8,
+)
 from pytorch_distributed_mnist_tpu.train.state import create_train_state, make_optimizer
 from pytorch_distributed_mnist_tpu.models import get_model
 from pytorch_distributed_mnist_tpu.train.steps import make_train_step
@@ -240,3 +245,99 @@ def test_fused_adam_bf16_grads_keep_f32_moments():
     delta, m1, v1 = fused_adam_leaf(g, m, v, hypers)
     assert delta.dtype == jnp.bfloat16
     assert m1.dtype == jnp.float32 and v1.dtype == jnp.float32
+
+
+# --------------------------------------------------------- int8 MXU matmul
+
+@pytest.mark.parametrize("shape", [(5, 7, 11), (128, 64, 10), (33, 200, 130)])
+def test_matmul_i8_exact_integer_oracle(shape):
+    """int8 x int8 -> int32 is EXACT integer arithmetic (the int32
+    accumulator never rounds), so the kernel must equal np.matmul
+    bit-for-bit — including the unaligned shapes that exercise the
+    (32, 128) tile padding, whose zero rows/lanes contribute nothing."""
+    m, k, n = shape
+    rng = np.random.default_rng(10)
+    a = rng.integers(-127, 128, size=(m, k), dtype=np.int8)
+    b = rng.integers(-127, 128, size=(k, n), dtype=np.int8)
+    out = matmul_i8(jnp.asarray(a), jnp.asarray(b))
+    want = np.matmul(a.astype(np.int32), b.astype(np.int32))
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_matmul_i8_rejects_non_int8_operands():
+    a = jnp.zeros((4, 8), jnp.float32)
+    b = jnp.zeros((8, 4), jnp.int8)
+    with pytest.raises(ValueError, match="int8 operands"):
+        matmul_i8(a, b)
+
+
+def test_quantize_dynamic_i8_roundtrip():
+    """Symmetric per-tensor quantization: values stay in [-127, 127],
+    the dequantized round-trip lands within half a quantization step,
+    and the extremum maps onto the grid end exactly."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    q, scale = quantize_dynamic_i8(x)
+    assert q.dtype == jnp.int8 and float(scale) > 0
+    qn = np.asarray(q, np.int32)
+    assert qn.min() >= -127 and qn.max() <= 127
+    np.testing.assert_allclose(
+        qn.astype(np.float32) * float(scale), np.asarray(x),
+        atol=float(scale) / 2 + 1e-7)
+    assert np.max(np.abs(qn)) == 127  # the extremum pins the grid end
+
+
+def test_int8_dot_general_matches_dequant_oracle():
+    """The Dense contraction through the kernel == quantize-then-f32-
+    matmul, tightly: the int32 accumulation is exact where the f32
+    oracle rounds, so any gap beyond f32 epsilon is a kernel bug. The
+    loose pin vs the unquantized f32 product bounds total quantization
+    error (per-tensor scales over K=64 terms)."""
+    rng = np.random.default_rng(12)
+    lhs = jnp.asarray(rng.normal(size=(4, 6, 64)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(64, 10)), jnp.float32)
+    dn = (((2,), (0,)), ((), ()))
+    out = int8_dot_general(lhs, rhs, dn)
+    assert out.shape == (4, 6, 10) and out.dtype == jnp.float32
+    qa, sa = quantize_dynamic_i8(lhs.reshape(-1, 64))
+    qb, sb = quantize_dynamic_i8(rhs)
+    oracle = (qa.astype(jnp.float32) * sa) @ (qb.astype(jnp.float32) * sb)
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, 10), np.asarray(oracle),
+        rtol=1e-5, atol=1e-5)
+    ref = jax.lax.dot_general(lhs, rhs, dn)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.5)
+
+
+def test_int8_dot_general_falls_back_verbatim_on_batch_dims():
+    """Any contraction that is not the plain Dense shape (here: batched
+    einsum) must be lax.dot_general UNCHANGED — bitwise, not allclose —
+    so wiring the kernel through a model's dot_general field can never
+    miscompute a contraction it wasn't built for."""
+    rng = np.random.default_rng(13)
+    lhs = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)
+    dn = (((2,), (1,)), ((0,), (0,)))
+    out = int8_dot_general(lhs, rhs, dn)
+    ref = jax.lax.dot_general(lhs, rhs, dn)
+    np.testing.assert_array_equal(
+        np.asarray(out).view(np.uint32), np.asarray(ref).view(np.uint32))
+
+
+def test_int8_dot_general_injects_through_model_field():
+    """End-to-end through the serving wiring: get_model(...,
+    dot_general=int8_dot_general) — the int8 plane's injection — keeps
+    the linear model's logits within quantization error of the plain
+    instance on the SAME checkpoint tree, preserving argmax."""
+    model = get_model("linear")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    rng = np.random.default_rng(14)
+    x = jnp.asarray(rng.random(size=(16, 28, 28, 1)), jnp.float32)
+    plain = model.apply({"params": params}, x, train=False)
+    quant = get_model("linear", dot_general=int8_dot_general).apply(
+        {"params": params}, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(quant), np.asarray(plain), atol=0.05)
+    assert float(jnp.mean(
+        jnp.argmax(quant, -1) == jnp.argmax(plain, -1))) >= 0.9

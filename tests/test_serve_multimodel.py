@@ -47,6 +47,11 @@ def _args(model_set, **overrides):
         "--buckets", "1,8",
         "--max-wait-ms", "2", "--max-queue", "64",
         "--poll-interval", "0.1",
+        # Split-plane boots: this suite pins no fused behavior, and the
+        # fused AOT warm would re-pay its compile wall per boot (x replicas)
+        # across the whole file -- tier-1 compile budget. The fused default
+        # is pinned in test_serve_server.py / test_serve_fused.py.
+        "--no-fuse",
     ]
     for k, v in overrides.items():
         flag = "--" + k.replace("_", "-")
@@ -180,7 +185,11 @@ def test_one_models_reload_is_invisible_to_the_other(
     state_new = _publish(d1, "linear", epoch=9, seed=9)
     lin_plane = srv.httpd.ctx.planes["linear"]
     cnn_plane = srv.httpd.ctx.planes["cnn"]
-    assert lin_plane.watcher.poll_once() is True
+    # The background poll thread (0.1s interval) may legitimately win
+    # the race to this publish; poll_once is lock-serialized against it,
+    # so EITHER poll installs — exactly once (the reloads==1 pin below).
+    installed = lin_plane.watcher.poll_once()
+    assert installed or lin_plane.engine.params_epoch == 9
     assert lin_plane.engine.params_epoch == 9
     assert cnn_plane.engine.params_epoch == 7
     # cnn's own watcher sees nothing new.

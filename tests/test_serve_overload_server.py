@@ -91,6 +91,7 @@ def test_overload_acceptance_twin(tmp_path):
         "--autoscale-down-after", "3", "--autoscale-max-devices", "2",
         "--quota-rps", "best_effort=2",
         "--metrics-file", str(metrics),
+        "--no-fuse",  # split-plane boot: nothing fused is pinned here
     ])
     srv = _Server(args)
     try:
@@ -212,6 +213,7 @@ def test_quota_precedence_over_queue_state(tmp_path):
         "--buckets", "1,8", "--max-wait-ms", "2", "--max-queue", "4",
         "--poll-interval", "5",
         "--quota-rps", "interactive=1",
+        "--no-fuse",  # split-plane boot: nothing fused is pinned here
     ])
     srv = _Server(args)
     try:

@@ -48,6 +48,11 @@ def _serve_args(ckpt_dir, **overrides):
         "--buckets", "1,8,32",
         "--max-wait-ms", "2", "--max-queue", "128",
         "--poll-interval", "0.1",
+        # Split-plane boots: this suite pins no fused behavior, and the
+        # fused AOT warm would re-pay its compile wall per boot (x replicas)
+        # across the whole file -- tier-1 compile budget. The fused default
+        # is pinned in test_serve_server.py / test_serve_fused.py.
+        "--no-fuse",
     ]
     for k, v in overrides.items():
         flag = "--" + k.replace("_", "-")

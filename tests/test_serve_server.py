@@ -175,6 +175,45 @@ def test_loadgen_acceptance_zero_recompiles(server):
         assert rec["backend_compiles"] >= 0  # present per bucket
 
 
+def test_stats_fused_flag_and_loadgen_expectation(server):
+    """The whole-program plane is the server default: /stats carries
+    fused=true and ``loadgen --smoke --expect-fused`` passes; a
+    ``--no-fuse`` server reports fused=false and FAILS the same
+    expectation (the flag has teeth)."""
+    srv, _, ckpt = server
+    assert srv.get("/stats")["fused"] is True
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         "--smoke", "--url", srv.url, "--requests", "40",
+         "--concurrency", "4", "--expect-fused"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["fused"] is True  # the advisory shape field rode along
+    # The donation lifecycle's observable (§7k): every fused dispatch
+    # donated-and-retired its staging buffer, so the per-bucket counter
+    # must have kept pace with the traffic just driven.
+    stats = srv.get("/stats")
+    assert sum(stats["donated_staging_retired"].values()) > 0
+
+    nofuse = _Server(_serve_args(ckpt, no_fuse=True))
+    try:
+        nf_stats = nofuse.get("/stats")
+        assert nf_stats["fused"] is False
+        # Nothing donates on the split plane — the key stays absent.
+        assert "donated_staging_retired" not in nf_stats
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+             "--smoke", "--url", nofuse.url, "--requests", "8",
+             "--concurrency", "2", "--expect-fused"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+    finally:
+        nofuse.close()
+
+
 def test_hot_reload_under_live_traffic(server):
     """Publish a new checkpoint while clients hammer /predict: no request
     fails or returns malformed output, and predictions/epoch flip to the
@@ -238,7 +277,6 @@ def test_overload_returns_503(tmp_path):
         engine = srv.httpd.ctx.engine
         release = threading.Event()
         entered = threading.Event()
-        real = dict(engine._compiled)
 
         def gate(fn):
             def gated(params, x):
@@ -247,8 +285,12 @@ def test_overload_returns_503(tmp_path):
                 return fn(params, x)
             return gated
 
-        for b in list(engine._compiled):
-            engine._compiled[b] = gate(real[b])
+        # Wedge BOTH dispatch planes: raw uint8 requests ride the fused
+        # bucket programs (the server default), float input the split
+        # ones — the overload behavior under test is plane-independent.
+        for table in (engine._compiled, engine._fused_compiled):
+            for b, fn in list(table.items()):
+                table[b] = gate(fn)
         images, _ = synthetic_dataset(1, seed=0)
         payload = {"images": images.tolist()}
         results = []

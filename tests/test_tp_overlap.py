@@ -1,0 +1,182 @@
+"""TP collective-matmul overlap (``--tp-overlap``).
+
+The overlapped schedule (``parallel/tensor.py::allgather_matmul`` + the
+sequence-sharded Megatron-SP block) is a SCHEDULING rewrite of the GSPMD
+tensor-parallel path, not a math change: the gather decomposes into ring
+ppermute hops and the matmul into independent row-block steps. These
+tests pin that contract — the per-shard decomposition bitwise-equal to
+gather-then-matmul, the overlapped apply equal to the dense model, and
+the train trajectory equal to the single-device step at the same
+tolerances the plain-TP suite uses (tests/test_tensor_parallel.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_distributed_mnist_tpu.parallel.pipeline_tp import (
+    merge_vit_params_tp,
+)
+from pytorch_distributed_mnist_tpu.parallel.tensor import (
+    allgather_matmul,
+    create_overlap_tp_vit_state,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.train.steps import make_train_step
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(11)
+    return {
+        "image": jnp.asarray(rng.normal(size=(16, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32),
+    }
+
+
+def _f32_vit():
+    # patch 7 -> 16 tokens, divisible by tp=2 (the sequence shard).
+    return get_model("vit", compute_dtype=jnp.float32, patch_size=7)
+
+
+def test_allgather_matmul_bitwise_equals_gather_then_matmul():
+    """Row blocks of a matmul are independent: the per-shard overlapped
+    decomposition must be BITWISE equal to allgather-then-matmul."""
+    mesh = make_mesh(("data", "model"), shape=(2, 4))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 12)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(12, 5)), jnp.float32)
+
+    def ref(xs, ww):
+        full = lax.all_gather(xs, "model", axis=1, tiled=True)
+        return jnp.tensordot(full, ww, axes=([2], [0]))
+
+    def ovl(xs, ww):
+        return allgather_matmul(xs, ww, "model")
+
+    specs = dict(in_specs=(P(None, "model", None), P()), out_specs=P(),
+                 check_vma=False)
+    r = jax.jit(jax.shard_map(ref, mesh=mesh, **specs))(x, w)
+    o = jax.jit(jax.shard_map(ovl, mesh=mesh, **specs))(x, w)
+    np.testing.assert_array_equal(np.asarray(r), np.asarray(o))
+
+
+def test_allgather_matmul_gradients_match(batch):
+    """Grad wrt the weight sums per-chunk contributions (the gather's
+    transpose), so it matches the reference up to reduction order."""
+    mesh = make_mesh(("data", "model"), shape=(2, 4))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 16, 12)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(12, 5)), jnp.float32)
+
+    def make_loss(fn):
+        specs = dict(in_specs=(P(None, "model", None), P()), out_specs=P(),
+                     check_vma=False)
+        sharded = jax.jit(jax.shard_map(fn, mesh=mesh, **specs))
+        return lambda ww: jnp.sum(sharded(x, ww) ** 2)
+
+    def ref(xs, ww):
+        full = lax.all_gather(xs, "model", axis=1, tiled=True)
+        return jnp.tensordot(full, ww, axes=([2], [0]))
+
+    def ovl(xs, ww):
+        return allgather_matmul(xs, ww, "model")
+
+    gr = jax.grad(make_loss(ref))(w)
+    go = jax.grad(make_loss(ovl))(w)
+    np.testing.assert_allclose(np.asarray(go), np.asarray(gr),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_overlap_apply_matches_dense_model(batch):
+    """Same init key -> the head-major overlapped apply reproduces the
+    dense model's logits (f32; psum_scatter reassociation only)."""
+    model = _f32_vit()
+    mesh = make_mesh(("data", "model"), shape=(4, 2))
+    ostate, _ = create_overlap_tp_vit_state(
+        model, jax.random.key(0), mesh, optimizer="sgd")
+    dstate = create_train_state(model, jax.random.key(0), optimizer="sgd")
+
+    ld = dstate.apply_fn(dstate.params, batch["image"], train=False)
+    lo = ostate.apply_fn(ostate.params, batch["image"], train=False)
+    np.testing.assert_allclose(np.asarray(lo), np.asarray(ld),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_overlap_tp_step_equals_single_device_step(batch):
+    """DP(4) x TP(2) overlapped train step == single-device step over a
+    3-step trajectory (SGD; same conventions as the plain-TP test)."""
+    model = _f32_vit()
+    s1 = create_train_state(model, jax.random.key(0), optimizer="sgd")
+    mesh = make_mesh(("data", "model"), shape=(4, 2))
+    so, osh = create_overlap_tp_vit_state(
+        model, jax.random.key(0), mesh, optimizer="sgd")
+
+    step_1d = make_train_step()
+    step_ov = make_train_step(mesh, "data", state_sharding=osh)
+    for _ in range(3):
+        s1, m1 = step_1d(s1, batch)
+        so, mo = step_ov(so, batch)
+
+    np.testing.assert_allclose(float(mo.loss_sum), float(m1.loss_sum),
+                               rtol=1e-4)
+    assert int(mo.correct) == int(m1.correct)
+    merged = merge_vit_params_tp(jax.device_get(so.params))
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(merged)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_cli_tp_overlap_matches_unoverlapped_tp(tmp_path):
+    """--tp-overlap trains through the full driver and matches the plain
+    GSPMD --tensor-parallel run's metrics: the overlap is a schedule, not
+    a math change."""
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    base = [
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--batch-size", "64", "--synthetic-train-size", "256",
+        "--synthetic-test-size", "128", "--seed", "0", "--patch-size", "7",
+        "--tensor-parallel", "2", "--root", str(tmp_path / "data"),
+    ]
+    ov = run(build_parser().parse_args(
+        base + ["--tp-overlap", "--checkpoint-dir", str(tmp_path / "ckpt_o")]))
+    tp = run(build_parser().parse_args(
+        base + ["--checkpoint-dir", str(tmp_path / "ckpt_t")]))
+    assert ov["history"][0]["train_loss"] == pytest.approx(
+        tp["history"][0]["train_loss"], rel=1e-4)
+    assert ov["history"][0]["test_acc"] == pytest.approx(
+        tp["history"][0]["test_acc"], abs=1e-6)
+
+
+def test_cli_tp_overlap_requires_tp(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--patch-size", "7", "--tp-overlap",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="tensor-parallel >= 2"):
+        run(args)
+
+
+def test_cli_tp_overlap_rejects_indivisible_tokens(tmp_path):
+    from pytorch_distributed_mnist_tpu.cli import build_parser, run
+
+    args = build_parser().parse_args([
+        "--dataset", "synthetic", "--model", "vit", "--epochs", "1",
+        "--tensor-parallel", "2", "--tp-overlap",  # patch 4 -> 49 tokens
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--root", str(tmp_path / "data"),
+    ])
+    with pytest.raises(SystemExit, match="patch-size 7"):
+        run(args)

@@ -22,6 +22,20 @@ and two rules kept PR 3/4 honest:
 
 Condition variables are exempt from rule 1 for their own ``wait``/
 ``notify`` — ``with cv: cv.wait()`` IS the pattern.
+
+3. **Donation discipline** (ISSUE 16). The whole-program serving plane
+   DONATES its staging buffer to the fused executable
+   (``donate_argnums``): XLA owns that memory after dispatch. A donated
+   buffer must therefore be ``retire()``d — counted and dropped — never
+   ``release()``d back onto the staging free-list, where a future batch
+   would stage into memory the program may already have overwritten (a
+   use-after-free in staging clothing, racing under the very staging
+   lock that is supposed to protect the pool). One function routing the
+   SAME buffer expression to both ``retire()`` and ``release()`` is the
+   signature of that bug and fires; the shipped engine keeps the two
+   paths in separate dedicated helpers
+   (``_retire_fused_staging``/``_release_staging``) so neither can
+   reach the other's pool.
 """
 
 from __future__ import annotations
@@ -243,10 +257,47 @@ def _order_cycles(pairs) -> List[List[str]]:
     return cycles
 
 
+def _donation_discipline(module: Module,
+                         findings: List[Finding]) -> None:
+    """Rule 3: the same buffer expression routed to BOTH ``retire()``
+    and ``release()`` inside one function. Name leaves of the first
+    argument are the identity (covers ``buf``, ``[(bucket, buf)]``,
+    and a shared ``buffers`` list alike)."""
+    for fn, qual, _classname in iter_functions(module.tree):
+        routed: Dict[str, Dict[str, int]] = {"retire": {}, "release": {}}
+        for node in walk_in_scope(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in routed and node.args):
+                continue
+            for leaf in ast.walk(node.args[0]):
+                if isinstance(leaf, ast.Name):
+                    routed[node.func.attr].setdefault(leaf.id,
+                                                      node.lineno)
+        for name in sorted(set(routed["retire"]) & set(routed["release"])):
+            line = max(routed["retire"][name], routed["release"][name])
+            findings.append(Finding(
+                checker=CHECKER_ID, path=module.path, line=line, col=0,
+                symbol=qual,
+                message=(
+                    f"donation discipline: buffer {name!r} is routed to "
+                    f"both retire() (line {routed['retire'][name]}) and "
+                    f"release() (line {routed['release'][name]}) in one "
+                    f"function — a DONATED buffer re-entering the "
+                    f"free-list hands a future batch memory XLA already "
+                    f"owns (use-after-free in staging clothing)"),
+                hint=("keep the donated and pooled lifecycles in "
+                      "separate dedicated helpers (the engine's "
+                      "_retire_fused_staging/_release_staging split): "
+                      "retired buffers are dropped, never re-listed"),
+            ))
+
+
 def run(modules: List[Module]) -> CheckerResult:
     findings: List[Finding] = []
     report: Dict[str, Dict] = {}
     for module in modules:
+        _donation_discipline(module, findings)
         locks = _collect_locks(module)
         if not locks:
             continue

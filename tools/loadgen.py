@@ -421,6 +421,11 @@ def main(argv=None) -> int:
                         "quantized --serve-precision plane; the report "
                         "always carries serve_precision, and the "
                         "canary block when a shadow canary is active)")
+    p.add_argument("--expect-fused", action="store_true",
+                   help="smoke: additionally require /stats to report "
+                        "fused=true (the whole-program serving plane — "
+                        "raw bytes to logits in one XLA program; the "
+                        "server's default unless started --no-fuse)")
     p.add_argument("--expect-mode", type=str, default=None,
                    help="smoke: additionally require /stats to report "
                         "this serve_mode (e.g. 'tensor' — the sharded "
@@ -479,7 +484,7 @@ def main(argv=None) -> int:
     # otherwise best-effort — a server predating the fields (or an
     # unreachable /stats) just omits them.
     def _shape_fields(stats: dict) -> None:
-        for key in ("serve_mode", "serve_precision", "canary",
+        for key in ("serve_mode", "serve_precision", "fused", "canary",
                     "serve_devices", "mesh_devices",
                     "mesh_groups", "pipeline_stages", "max_inflight",
                     "topology_generation", "groups", "active_groups",
@@ -542,6 +547,10 @@ def main(argv=None) -> int:
                     and stats.get("serve_precision")
                     == args.expect_precision
                 )
+            if args.expect_fused:
+                # The whole-program plane really is live: /stats says
+                # raw requests ride the fused bucket programs.
+                smoke_ok = smoke_ok and stats.get("fused") is True
             if args.expect_mode:
                 # The sharded data plane really is the requested one:
                 # /stats names the mode, and sharded modes carry their
