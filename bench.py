@@ -1870,6 +1870,266 @@ def main_serve() -> None:
                 "verdict failure")
             overload_block["goodput_holds_at_overload"] = False
 
+        # -- fleet (ISSUE 17): the federation tier's own cost and
+        # behavior — two real loopback backends behind a real router,
+        # all in-process, driven over real HTTP. Three verdicts:
+        # (1) router overhead: ABBA-paired direct-vs-routed closed-loop
+        #     drives (the BENCH_r04 pairing discipline — alternation
+        #     cancels thermal/scheduler drift), reported as the paired
+        #     median p50/p99 ratio;
+        # (2) goodput at ~10x measured fleet capacity offered open-loop
+        #     THROUGH the router (the ISSUE 15 overload methodology one
+        #     tier up): the router must shed/refuse, never collapse —
+        #     goodput at the top point holds >= 70% of the curve's
+        #     peak, the same rule the single-process block enforces
+        #     (96% measured there at seed time);
+        # (3) zero steady-state recompiles across every routed drive
+        #     (the backends share this process's compile log, so a
+        #     per-backend recompile shows up in the delta).
+        import shutil as _shutil
+        import tempfile as _tempfile
+        import urllib.request as _urlreq
+
+        from pytorch_distributed_mnist_tpu.serve.router import (
+            build_parser as _router_parser,
+        )
+        from pytorch_distributed_mnist_tpu.serve.router import create_router
+        from pytorch_distributed_mnist_tpu.serve.server import (
+            build_parser as _serve_parser,
+        )
+        from pytorch_distributed_mnist_tpu.serve.server import create_server
+        from pytorch_distributed_mnist_tpu.train.checkpoint import (
+            save_checkpoint,
+        )
+        from tools.loadgen import _make_images, run_closed, run_open
+        from tools.loadgen import report as _loadgen_report
+
+        def _drive_closed(url, n, conc, *, seed):
+            t_d = time.perf_counter()
+            col = run_closed(url, n, conc, bodies, timeout=30.0,
+                             seed=seed)
+            return _loadgen_report(col, time.perf_counter() - t_d,
+                                   "closed")
+
+        fleet_failures: list = []
+        fleet_block: dict = {"backends": 2}
+        fleet_seconds = float(os.environ.get("BENCH_FLEET_SECONDS", "1.0"))
+        fleet_pairs = int(os.environ.get("BENCH_FLEET_PAIRS", "3"))
+        fleet_reqs = int(os.environ.get("BENCH_FLEET_REQUESTS", "40"))
+        fleet_dirs: list = []
+        fleet_servers: list = []
+        fleet_router = None
+
+        def _boot_httpd(httpd):
+            th = threading.Thread(target=httpd.serve_forever, daemon=True)
+            th.start()
+            host, port = httpd.server_address[:2]
+            return {"httpd": httpd, "thread": th,
+                    "url": f"http://{host}:{port}",
+                    "name": f"{host}:{port}"}
+
+        def _stop_httpd(srv):
+            srv["httpd"].shutdown()
+            srv["httpd"].ctx.close()
+            srv["httpd"].server_close()
+            srv["thread"].join(10.0)
+
+        def _router_json(path):
+            with _urlreq.urlopen(fleet_router["url"] + path,
+                                 timeout=10) as r:
+                return json.loads(r.read())
+
+        try:
+            # Linear backends on purpose: the block measures ROUTING
+            # (the wire + the routing tier), not model capacity, and
+            # linear keeps the two extra engines' compiles cheap.
+            fleet_model = get_model("linear", compute_dtype=jnp.float32)
+            fleet_state = create_train_state(fleet_model,
+                                             jax.random.key(7))
+            for i in range(2):
+                d = _tempfile.mkdtemp(prefix=f"bench-fleet-b{i}-")
+                fleet_dirs.append(d)
+                save_checkpoint(fleet_state, epoch=0, best_acc=0.0,
+                                is_best=False, directory=d,
+                                process_index=0)
+                fleet_servers.append(_boot_httpd(create_server(
+                    _serve_parser().parse_args([
+                        "--checkpoint-dir", d, "--model", "linear",
+                        "--dtype", "f32", "--host", "127.0.0.1",
+                        "--port", "0", "--buckets", "1,8",
+                        "--max-wait-ms", "2", "--max-queue", "256",
+                        "--poll-interval", "0.5"]))))
+            fleet_router = _boot_httpd(create_router(
+                _router_parser().parse_args([
+                    "--backends",
+                    ",".join(s["name"] for s in fleet_servers),
+                    "--host", "127.0.0.1", "--port", "0",
+                    "--health-interval", "0.2",
+                    "--connect-timeout", "2.0"])))
+            deadline = time.perf_counter() + 30.0
+            while time.perf_counter() < deadline:
+                try:
+                    if _router_json("/healthz").get("routable") == 2:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(
+                    "router never saw both backends routable")
+
+            bodies = _make_images(8, 8, seed=5)
+            # loadgen appends /predict itself: base URLs here.
+            direct_url = fleet_servers[0]["url"]
+            routed_url = fleet_router["url"]
+            # Warm every program (both backends, both buckets) and the
+            # routed path before anything is measured.
+            for url in (direct_url, routed_url, routed_url):
+                warm = _drive_closed(url, 16, 4, seed=1)
+                if warm["ok"] != 16:
+                    raise RuntimeError(
+                        f"fleet warmup failed against {url}: {warm}")
+            before_fleet = _serve_program_compiles()
+
+            # (1) Router overhead, ABBA-paired: per pair one direct and
+            # one routed drive, order alternating; the overhead ratio
+            # is the median of per-pair routed/direct p50 (and p99).
+            pair_rows = []
+            for pair in range(fleet_pairs):
+                order = [("direct", direct_url), ("routed", routed_url)]
+                if pair % 2:
+                    order.reverse()
+                row = {}
+                for label, url in order:
+                    rep = _drive_closed(url, fleet_reqs, 4,
+                                        seed=100 + pair)
+                    if rep["ok"] != fleet_reqs:
+                        fleet_failures.append(
+                            f"overhead drive ({label}, pair {pair}) "
+                            f"lost requests: {rep}")
+                    row[label] = rep["latency_ms"]
+                pair_rows.append(row)
+
+            def _median(vals):
+                vals = sorted(vals)
+                mid = len(vals) // 2
+                return (vals[mid] if len(vals) % 2
+                        else 0.5 * (vals[mid - 1] + vals[mid]))
+
+            overhead = {
+                "pairs": fleet_pairs,
+                "direct_p50_ms": _median(
+                    [r["direct"]["p50"] for r in pair_rows]),
+                "routed_p50_ms": _median(
+                    [r["routed"]["p50"] for r in pair_rows]),
+                "direct_p99_ms": _median(
+                    [r["direct"]["p99"] for r in pair_rows]),
+                "routed_p99_ms": _median(
+                    [r["routed"]["p99"] for r in pair_rows]),
+                "p50_overhead_ratio": round(_median(
+                    [r["routed"]["p50"] / max(r["direct"]["p50"], 1e-9)
+                     for r in pair_rows]), 3),
+                "p99_overhead_ratio": round(_median(
+                    [r["routed"]["p99"] / max(r["direct"]["p99"], 1e-9)
+                     for r in pair_rows]), 3),
+            }
+            fleet_block["router_overhead"] = overhead
+
+            # (2) Goodput through the router: closed-loop capacity
+            # first, then open-loop points at 1x and ~10x (offered rate
+            # clamped so the thread-per-request client stays honest —
+            # the EFFECTIVE multiple is recorded, not the target).
+            cap = _drive_closed(routed_url, 3 * fleet_reqs, 8, seed=7)
+            fleet_capacity = max(cap["throughput_rps"], 1e-9)
+            goodput_points = []
+            for mult in (1, 10):
+                rate = min(fleet_capacity * mult, 1500.0)
+                col = run_open(routed_url, rate, fleet_seconds, bodies,
+                               timeout=10.0, seed=40 + mult)
+                rep = _loadgen_report(col, fleet_seconds, "open")
+                goodput_points.append({
+                    "offered_x": round(rate / max(fleet_capacity, 1e-9),
+                                       2),
+                    "offered_rps": round(rate, 1),
+                    "completed": rep["ok"],
+                    "shed": rep["rejected"],
+                    "not_launched": rep["not_launched"],
+                    "goodput_rps": round(rep["ok"] / fleet_seconds, 1),
+                })
+                if rep["transport_errors"] or rep["conn_refused"]:
+                    fleet_failures.append(
+                        f"requests dropped on the floor at "
+                        f"{mult}x through the router: {rep}")
+            peak_fleet = max(pt["goodput_rps"] for pt in goodput_points)
+            top_fleet = goodput_points[-1]
+            goodput_frac = round(
+                top_fleet["goodput_rps"] / max(peak_fleet, 1e-9), 3)
+            fleet_block["goodput"] = {
+                "capacity_rps": round(fleet_capacity, 1),
+                "points": goodput_points,
+                "peak_goodput_rps": peak_fleet,
+                "goodput_at_top_fraction_of_peak": goodput_frac,
+                "single_process_fraction_of_peak": overload_block.get(
+                    "goodput_at_top_fraction_of_peak"),
+            }
+            goodput_holds_fleet = (
+                top_fleet["goodput_rps"] >= 0.7 * peak_fleet)
+            fleet_block["goodput"]["holds_at_overload"] = \
+                goodput_holds_fleet
+            if not goodput_holds_fleet:
+                fleet_failures.append(
+                    f"fleet goodput collapsed through the router: "
+                    f"{top_fleet['goodput_rps']} rps at "
+                    f"{top_fleet['offered_x']}x vs peak {peak_fleet} "
+                    f"rps (< 70%)")
+
+            # (3) No routed drive recompiled a backend program.
+            delta_fleet = _recompile_delta(before_fleet,
+                                           _serve_program_compiles())
+            fleet_block["zero_steady_state_recompiles_per_backend"] = \
+                not delta_fleet
+            if delta_fleet:
+                fleet_failures.append(
+                    f"steady-state serving recompiled behind the "
+                    f"router: {delta_fleet}")
+
+            stats = _router_json("/stats")
+            fleet_block["router_stats"] = {
+                "routable": sum(1 for row in stats.get("backends", [])
+                                if row.get("routable")),
+                "failovers": stats.get("fleet", {}).get("failovers"),
+                "retries": stats.get("fleet", {}).get("retries"),
+            }
+        except Exception as exc:  # noqa: BLE001 - the block fails loudly, the bench still emits JSON
+            fleet_failures.append(f"fleet block crashed: {exc!r}")
+        finally:
+            if fleet_router is not None:
+                try:
+                    _stop_httpd(fleet_router)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            for srv in fleet_servers:
+                try:
+                    _stop_httpd(srv)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            for d in fleet_dirs:
+                _shutil.rmtree(d, ignore_errors=True)
+        if device.platform != "tpu":
+            fleet_block["caveat"] = (
+                "CPU fallback (the BENCH_r05 convention): absolute "
+                "overhead and capacity are the host's loopback stack, "
+                "not a real fabric — the RATIOS (routed vs direct, "
+                "goodput held at the top point) and the recompile "
+                "verdict are the meaningful part here")
+        if os.environ.get("BENCH_FLEET_INJECT_FAIL"):
+            # Test hook: pin the fails-loudly path (mirrors
+            # BENCH_OVERLOAD_INJECT_FAIL).
+            fleet_failures.append(
+                "BENCH_FLEET_INJECT_FAIL set: injected fleet verdict "
+                "failure")
+        fleet_block["ok"] = not fleet_failures
+
         value = requests / wall
         out.update({
             "value": round(value, 1),
@@ -1890,6 +2150,7 @@ def main_serve() -> None:
             "precision_sweep": precision_block,
             "whole_program": whole_program_block,
             "overload": overload_block,
+            "fleet": fleet_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
             "pool_requests": pool_requests,
@@ -1909,10 +2170,13 @@ def main_serve() -> None:
               and not recompiled_replicas and not sharded_recompiles
               and not pipeline_recompiles and not precision_recompiles
               and not fused_recompiles and not wp_failures
-              and not overload_failures)
+              and not overload_failures and not fleet_failures)
         if overload_failures:
             out["error"] = ("overload block failed: "
                             + "; ".join(overload_failures))
+        elif fleet_failures:
+            out["error"] = ("fleet block failed: "
+                            + "; ".join(fleet_failures))
         elif fused_recompiles:
             out["error"] = ("steady-state WHOLE-PROGRAM serving "
                             "recompiled (fused plane): "

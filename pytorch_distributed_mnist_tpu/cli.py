@@ -1913,6 +1913,21 @@ def main(argv: Optional[list] = None) -> None:
 
         serve_main(argv[1:])
         return
+    if argv and argv[0] == "route":
+        # The fleet tier: `tpu-mnist route --backends host:port,...`
+        # boots the pure-stdlib routing front-end over N backend serve
+        # processes — health-gated failover, consistent-hash client
+        # affinity, rolling deploys + fleet canaries via POST /rollout,
+        # and the two-tier fleet autoscaler (serve/router.py). Kept a
+        # subcommand for the same reason `serve` is: its own flag
+        # surface and lifecycle, and it must import NONE of the jax
+        # stack (a router shares no fate with its data plane).
+        from pytorch_distributed_mnist_tpu.serve.router import (
+            main as route_main,
+        )
+
+        route_main(argv[1:])
+        return
     args = build_parser().parse_args(argv)
     if args.elastic and not args.spawn:
         raise SystemExit(

@@ -33,6 +33,11 @@ device submission):
 - ``POST /resize`` — the admin topology dial (pooled servers):
   ``{"serve_devices": N?, "serve_mesh": M?}`` re-shapes the pool under
   live traffic with zero dropped requests (``serve/pool.py::resize``).
+- ``POST /drain`` — the fleet primitive: ``{"drain": true|false}``
+  closes/reopens /predict admission (503 + Retry-After) while in-flight
+  requests complete; ``/healthz`` and ``/stats`` expose ``draining`` so
+  a router's rolling reload (``serve/router.py``) can publish against a
+  quiescent backend and rejoin it afterwards.
 
 The deliberately boring transport (no asyncio, no framework dep) is the
 point: the serving smarts live in engine/batcher/reload, which are all
@@ -43,6 +48,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -422,6 +428,15 @@ class ServeContext:
         self.fair_gate = fair_gate
         self.max_inflight = max_inflight
         self.t_start = time.time()
+        # Drain state (POST /drain): while draining, /predict admission
+        # rejects new work with Retry-After and in-flight requests run
+        # to completion — the primitive a fleet router's rolling reload
+        # and scale-down both sequence on. `_active_predicts` counts
+        # every /predict handler past the drain gate, so `draining &&
+        # active_requests == 0` means no request can still be executing.
+        self.draining = False
+        self._drain_lock = threading.Lock()
+        self._active_predicts = 0
         default = planes[default_model]
         # Single-model aliases (the historical surface).
         self.model_name = default.model_name
@@ -459,6 +474,26 @@ class ServeContext:
                 f"unknown model {model!r}; this server serves "
                 f"{sorted(self.planes)}")
         return plane
+
+    def predict_begin(self) -> None:
+        with self._drain_lock:
+            self._active_predicts += 1
+
+    def predict_end(self) -> None:
+        with self._drain_lock:
+            self._active_predicts -= 1
+
+    def active_requests(self) -> int:
+        with self._drain_lock:
+            return self._active_predicts
+
+    def set_draining(self, draining: bool) -> bool:
+        """Flip the drain gate; returns the previous state. Idempotent —
+        a second drain (or undrain) is a no-op, so a router retrying the
+        admin call cannot wedge the state."""
+        with self._drain_lock:
+            prev, self.draining = self.draining, bool(draining)
+        return prev
 
     def write_all_stats(self, **extra) -> None:
         for plane in self.planes.values():
@@ -597,6 +632,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "model_epoch": ctx.engine.params_epoch,
                 "checkpoint": ctx.checkpoint_path,
                 "uptime_s": round(time.time() - ctx.t_start, 3),
+                # Drain state rides on /healthz (not a separate probe):
+                # a draining backend is ALIVE but not routable — the
+                # router must distinguish "drain in progress" from
+                # "dead" or it would quarantine every rolling deploy.
+                "draining": ctx.draining,
             }
             if ctx.multi_model:
                 payload["models"] = {
@@ -618,6 +658,10 @@ class _Handler(BaseHTTPRequestHandler):
                     stats["fair_dispatch"] = ctx.fair_gate.snapshot()
             if ctx.quotas is not None:
                 stats["quota"] = ctx.quotas.snapshot()
+            # Drain observables: the rolling-reload sequencer polls
+            # `draining && active_requests == 0` before publishing.
+            stats["draining"] = ctx.draining
+            stats["active_requests"] = ctx.active_requests()
             self._reply(200, stats)
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
@@ -626,9 +670,78 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/resize":
             self._do_resize()
             return
+        if self.path == "/drain":
+            self._do_drain()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": f"no route {self.path!r}"})
             return
+        ctx = self.ctx
+        # The active counter brackets the WHOLE predict path (parse
+        # included) and the drain gate sits inside it, so once a drain
+        # observer sees `draining && active_requests == 0` no handler
+        # can still be ahead of the gate — publish-after-drain never
+        # races a request that slipped past a narrower window.
+        ctx.predict_begin()
+        try:
+            if ctx.draining:
+                self._reject_draining()
+                return
+            self._do_predict()
+        finally:
+            ctx.predict_end()
+
+    def _reject_draining(self) -> None:
+        """503 while the drain gate is closed: same admission-control
+        contract as overload shedding — Retry-After derived from the
+        batcher's measured drain rate, so the client's back-off tracks
+        how long the in-flight work plausibly takes to finish."""
+        ctx = self.ctx
+        length = int(self.headers.get("Content-Length", 0))
+        if 0 < length <= MAX_BODY_BYTES:
+            # Drain the request body so the reply lands on a clean
+            # socket instead of a client-side broken pipe.
+            self.rfile.read(length)
+        depth = sum(p.batcher.queue_depth() for p in ctx.planes.values())
+        rate = max(p.batcher.drain_rps() for p in ctx.planes.values())
+        retry_after = min(30.0, max(1.0, depth / rate if rate > 0 else 1.0))
+        self._reply(
+            503,
+            {"error": "draining", "draining": True,
+             "retry_after_s": round(retry_after, 3)},
+            headers={"Retry-After": max(1, round(retry_after))})
+
+    def _do_drain(self) -> None:
+        """``POST /drain`` — the fleet primitive: body ``{"drain":
+        true|false}`` (default true) closes/reopens the /predict
+        admission gate. In-flight requests complete; ``/stats`` exposes
+        ``draining`` + ``active_requests`` so a rolling reload can wait
+        for quiescence before publishing."""
+        ctx = self.ctx
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_BODY_BYTES:
+            self._reply(413, {"error": "oversized /drain body"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            drain = payload.get("drain", True)
+            if not isinstance(drain, bool):
+                raise ValueError("'drain' must be a boolean")
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        prev = ctx.set_draining(drain)
+        if prev != drain:
+            ctx.serve_log.record_pool_event(
+                "serve_drain", draining=drain,
+                active_requests=ctx.active_requests())
+        self._reply(200, {"ok": True, "draining": drain,
+                          "was_draining": prev,
+                          "active_requests": ctx.active_requests()})
+
+    def _do_predict(self) -> None:
         ctx = self.ctx
         t0 = time.perf_counter()
         length = int(self.headers.get("Content-Length", 0))

@@ -540,6 +540,25 @@ def record_world_grown(old_members, new_members, generation) -> Dict:
         generation=int(generation))
 
 
+def record_fleet_event(sink, kind: str, **fields) -> None:
+    """Fleet-router lifecycle line (``fleet_quarantine`` /
+    ``fleet_failover`` / ``fleet_rollout_*`` / ``fleet_canary_*`` /
+    ``fleet_scale_*``) into a :class:`JsonlSink`.
+
+    The sibling of :meth:`ServeLog.record_pool_event` one level up, but
+    a free function taking the sink explicitly: the router
+    (``serve/router.py``) is deliberately pure-stdlib and owns no
+    ServeLog — it imports this lazily, only when ``--metrics-file``
+    gave it a sink, so a router that never logs never touches the jax
+    import chain. ``source: "router"`` keys the fleet tier's lines
+    apart from the per-backend ``serve_*`` events riding the same
+    stream."""
+    if sink is None:
+        return
+    sink.try_write({"t": round(time.time(), 3), "kind": kind,
+                    "source": "router", **fields})
+
+
 def _percentile(sorted_vals: list, q: float) -> float:
     """Nearest-rank percentile over an already-sorted list (0 when empty).
     Nearest-rank (not interpolated) so p99 of a small sample is a latency

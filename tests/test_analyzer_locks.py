@@ -992,3 +992,77 @@ class Engine:
         self._window.release()
 """
     assert _findings(src) == []
+
+# -- ISSUE 17: the fleet router (serve/router.py) ----------------------------
+
+
+def test_fires_on_dispatch_under_routing_table_lock():
+    """The federation bug signature: holding the routing-table lock
+    across the backend HTTP exchange serializes the WHOLE fleet behind
+    one slow backend — every concurrent /predict waits on the read
+    timeout of whichever dispatch went first. Routing decisions are
+    arithmetic; the wire is not."""
+    src = """
+import threading
+import urllib.request
+
+class Fleet:
+    def __init__(self, backends):
+        self._lock = threading.Lock()
+        self._backends = backends
+
+    def dispatch(self, name, body):
+        with self._lock:
+            backend = self._backends[name]
+            backend.total_inflight += 1
+            return urllib.request.urlopen(backend.url, body)
+"""
+    (f,) = _findings(src)
+    assert "network IO" in f.message and "Fleet._lock" in f.message
+
+
+def test_silent_on_routing_snapshot_then_dispatch():
+    """The shipped shape (serve/router.py::Fleet.acquire + the predict
+    handler): the complete routing decision AND the in-flight
+    reservation happen under the lock, the HTTP exchange strictly after
+    release."""
+    src = """
+import threading
+import urllib.request
+
+class Fleet:
+    def __init__(self, backends):
+        self._lock = threading.Lock()
+        self._backends = backends
+
+    def acquire(self, name):
+        with self._lock:
+            backend = self._backends[name]
+            backend.total_inflight += 1
+            return backend.url
+
+    def dispatch(self, name, body):
+        url = self.acquire(name)
+        return urllib.request.urlopen(url, body)
+"""
+    assert _findings(src) == []
+
+
+def test_router_module_clean_and_in_lock_graph():
+    """ISSUE 17: the router holds its locks for routing arithmetic and
+    sweep bookkeeping only — every backend HTTP exchange, health probe,
+    and rollout step runs outside them. Clean under EVERY checker (the
+    module is also stdlib-pure, so trace-purity has nothing to flag),
+    and its locks are graph nodes with no nesting edges: the routing
+    table lock must never nest with the poller's or the canary's."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve",
+                      "router.py")],
+        baseline=None)
+    assert result.findings == []
+    graph = result.reports["lock-discipline"]["lock_graph"]
+    router = graph["pytorch_distributed_mnist_tpu/serve/router.py"]
+    assert router["locks"] == [
+        "Fleet._lock", "FleetCanary._lock", "HealthPoller._lock",
+        "RouterContext._rollout_lock", "RouterLog._lock"]
+    assert router["order_edges"] == []

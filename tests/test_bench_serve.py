@@ -29,6 +29,9 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
         "BENCH_SERVE_POOL_REQUESTS": "64",
         "BENCH_SERVE_FUSED_REQUESTS": "48",
         "BENCH_SERVE_CONCURRENCY": "8",
+        "BENCH_FLEET_SECONDS": "0.6",
+        "BENCH_FLEET_PAIRS": "2",
+        "BENCH_FLEET_REQUESTS": "24",
         "BENCH_COMPILE_CACHE": "",
         "TPUMNIST_COMPILE_CACHE": "",
     })
@@ -194,6 +197,34 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
         "scale_up", "scale_down"]
     assert "CPU fallback" in over["caveat"]
 
+    # The fleet block (ISSUE 17): two real loopback backends behind a
+    # real router — the ABBA-paired routed-vs-direct overhead, the
+    # open-loop goodput curve THROUGH the router (same 70%-of-peak
+    # shed-not-collapse rule as the single-process block), and the
+    # per-backend zero-recompile verdict across every routed drive.
+    fleet = report["fleet"]
+    assert fleet["ok"] is True
+    assert fleet["backends"] == 2
+    over_f = fleet["router_overhead"]
+    assert over_f["pairs"] == 2
+    assert over_f["direct_p50_ms"] > 0
+    assert over_f["routed_p50_ms"] > 0
+    assert over_f["p50_overhead_ratio"] > 0
+    assert over_f["p99_overhead_ratio"] > 0
+    good = fleet["goodput"]
+    assert good["capacity_rps"] > 0
+    assert len(good["points"]) == 2
+    assert good["points"][0]["offered_x"] == 1.0
+    assert good["points"][-1]["offered_x"] > 1.0
+    assert all(pt["goodput_rps"] > 0 for pt in good["points"])
+    assert good["holds_at_overload"] is True
+    # Side-by-side with the single-process overload verdict.
+    assert good["single_process_fraction_of_peak"] == \
+        over["goodput_at_top_fraction_of_peak"]
+    assert fleet["zero_steady_state_recompiles_per_backend"] is True
+    assert fleet["router_stats"]["routable"] == 2
+    assert "CPU fallback" in fleet["caveat"]
+
 
 def test_bench_serve_overload_verdicts_fail_loudly():
     """The overload verdicts really carry teeth: the injected failure
@@ -211,6 +242,10 @@ def test_bench_serve_overload_verdicts_fail_loudly():
         "BENCH_OVERLOAD_SECONDS": "0.5",
         "BENCH_OVERLOAD_POINTS": "1,2",
         "BENCH_OVERLOAD_INJECT_FAIL": "1",
+        "BENCH_FLEET_SECONDS": "0.5",
+        "BENCH_FLEET_PAIRS": "2",
+        "BENCH_FLEET_REQUESTS": "16",
+        "BENCH_FLEET_INJECT_FAIL": "1",
         "BENCH_COMPILE_CACHE": "",
         "TPUMNIST_COMPILE_CACHE": "",
     })
@@ -223,3 +258,6 @@ def test_bench_serve_overload_verdicts_fail_loudly():
     report = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "overload" in report["error"]
     assert report["overload"]["goodput_holds_at_overload"] is False
+    # The fleet injection hook carries teeth too (the overload error
+    # outranks it in the message, but the verdict and exit gate hold).
+    assert report["fleet"]["ok"] is False

@@ -38,7 +38,7 @@ def test_known_markers_really_parse():
     a regex that matched nothing would make the wrapper vacuous."""
     pyproject = _TESTS.parent / "pyproject.toml"
     registered = registered_markers(pyproject.read_text())
-    assert {"slow", "chaos", "serve", "lint"} <= registered
+    assert {"slow", "chaos", "serve", "lint", "fleet"} <= registered
 
 
 def test_wrapper_fails_on_a_misspelled_marker(tmp_path):

@@ -403,6 +403,68 @@ def test_predict_reports_epoch_of_computing_params(server):
                     {"images": images.tolist()})["model_epoch"] == 4
 
 
+def test_drain_rejects_with_retry_after(server):
+    """POST /drain closes admission: new /predict bounces 503 with a
+    Retry-After header and a body naming the draining state, /healthz
+    and /stats both expose draining=true, and /stats active_requests
+    reaches zero (the rolling-reload wait-for-quiescent contract)."""
+    srv, _, _ = server
+    images, _ = synthetic_dataset(2, seed=1)
+    payload = {"images": images.tolist()}
+    assert len(srv.post("/predict", payload)["predictions"]) == 2
+
+    reply = srv.post("/drain", {"drain": True})
+    assert reply["ok"] and reply["draining"] and not reply["was_draining"]
+    assert srv.get("/healthz")["draining"] is True
+    stats = srv.get("/stats")
+    assert stats["draining"] is True
+    assert stats["active_requests"] == 0  # nothing in flight = quiescent
+
+    try:
+        srv.post("/predict", payload)
+        code, headers, body = 200, {}, {}
+    except urllib.error.HTTPError as exc:
+        code = exc.code
+        headers = exc.headers
+        body = json.loads(exc.read())
+    assert code == 503
+    assert body["draining"] is True and body["error"] == "draining"
+    assert int(headers["Retry-After"]) >= 1  # the back-off contract
+
+    # Idempotent: draining an already-draining server reports it was.
+    assert srv.post("/drain", {"drain": True})["was_draining"] is True
+
+
+def test_drain_then_rejoin_serves_again(server):
+    """Undrain reopens admission with no restart: the same server that
+    just bounced traffic answers again — the rolling reload's rejoin
+    step is a state flip, not a process bounce."""
+    srv, _, _ = server
+    images, _ = synthetic_dataset(2, seed=4)
+    payload = {"images": images.tolist()}
+    srv.post("/drain", {"drain": True})
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        srv.post("/predict", payload)
+    exc_info.value.read()
+    assert exc_info.value.code == 503
+
+    reply = srv.post("/drain", {"drain": False})
+    assert reply["ok"] and not reply["draining"] and reply["was_draining"]
+    assert srv.get("/healthz")["draining"] is False
+    assert len(srv.post("/predict", payload)["predictions"]) == 2
+    assert srv.get("/stats")["draining"] is False
+
+    # Malformed drain bodies are a client error, not a state change.
+    bad = urllib.request.Request(
+        srv.url + "/drain", data=b'{"drain": "yes"}',
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(bad, timeout=30)
+    exc_info.value.read()
+    assert exc_info.value.code == 400
+    assert srv.get("/healthz")["draining"] is False
+
+
 def test_boot_falls_back_past_corrupt_latest(tmp_path):
     """A corrupt latest checkpoint must not turn a server restart into
     an outage: boot walks to the next-older epoch (the serving analog of

@@ -81,6 +81,20 @@ deaths instead of monkeypatches:
     # goodput — one abuser cannot starve the rest
     python tools/chaos.py --quota-abuse --cpu-devices 2 --quota-rps 20
 
+    # FLEET: a real router over 3 real backends; SIGKILL backend 1
+    # mid-loadgen — zero DROPPED requests (failover + bounded client
+    # retry), quarantine, then probation re-admission after a restart
+    python tools/chaos.py --fleet 3 --kill-backend 1 --cpu-devices 1
+
+    # fleet-wide rolling deploy under live traffic: every backend on
+    # the new epoch, zero drops
+    python tools/chaos.py --fleet 3 --rolling-reload --cpu-devices 1
+
+    # a publish that fails the fleet canary rolls back with the
+    # baseline weights republished and still serving
+    python tools/chaos.py --fleet 2 --fleet-canary-rollback \\
+        --cpu-devices 1
+
 Fault host indices are process RANKS within the world that reads the
 plan — in an elastic run each rebuilt generation renumbers its ranks
 0..W'-1, so a spec aimed at rank 2 cannot re-fire once the world is
@@ -148,6 +162,12 @@ SERVE_FAULT_ENV = "TPUMNIST_SERVE_FAULT"
 # comparison fails the budget.
 CANARY_FAULT_ENV = "TPUMNIST_CANARY_FAULT"
 
+# serve/router.py::FLEET_FAULT_ENV, spelled out for the same
+# jax-import-free reason (pinned equal by tests/test_serve_router.py):
+# the --fleet-canary-rollback twin sets it to "canary_disagree" in the
+# ROUTER's environment so every fleet-canary cohort row disagrees.
+FLEET_FAULT_ENV = "TPUMNIST_FLEET_FAULT"
+
 # parallel/mesh.py::DCN_SLICES_ENV, spelled out for the same
 # jax-import-free reason (pinned equal by tests/test_hier_mesh.py).
 DCN_SLICES_ENV = "TPUMNIST_DCN_SLICES"
@@ -210,16 +230,20 @@ def _serve_env(args) -> dict:
     return env
 
 
-def _boot_serve(env: dict, flags: list, timeout: float):
+def _boot_serve(env: dict, flags: list, timeout: float,
+                ckpt_dir: str = None, port: int = 0):
     """Boot one `tpu-mnist serve` subprocess on a fresh-init checkpoint
     dir; returns ``(server, log, ckpt_dir, url)`` (url None = never came
-    up; caller prints the log tail and bails). Caller owns teardown."""
-    ckpt_dir = tempfile.mkdtemp(prefix="tpumnist-serve-chaos-")
+    up; caller prints the log tail and bails). Caller owns teardown.
+    ``ckpt_dir``/``port`` let the fleet twins RESTART a killed backend
+    on its old port with its old checkpoints (the re-admission leg)."""
+    if ckpt_dir is None:
+        ckpt_dir = tempfile.mkdtemp(prefix="tpumnist-serve-chaos-")
     log = tempfile.NamedTemporaryFile(mode="w+", suffix=".log",
                                       delete=False)
     cmd = [sys.executable, "-m", "pytorch_distributed_mnist_tpu", "serve",
            "--checkpoint-dir", ckpt_dir, "--host", "127.0.0.1",
-           "--port", "0"] + flags
+           "--port", str(port)] + flags
     _say(f"booting serve twin: {' '.join(cmd)}")
     server = subprocess.Popen(cmd, env=env, stdout=log,
                               stderr=subprocess.STDOUT)
@@ -248,6 +272,42 @@ def _kill_serve(server, log, ckpt_dir) -> None:
     log.close()
     os.unlink(log.name)
     shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _boot_router(env: dict, backend_urls: list, timeout: float,
+                 extra_flags: list = ()):
+    """Boot one `tpu-mnist route` subprocess over the given backends;
+    returns ``(router, log, url)`` (url None = never came up). Tight
+    health cadence on purpose: the twins want quarantine/probation
+    transitions inside their wall-clock budget, not production's."""
+    log = tempfile.NamedTemporaryFile(mode="w+", suffix=".log",
+                                      delete=False)
+    cmd = [sys.executable, "-m", "pytorch_distributed_mnist_tpu", "route",
+           "--backends", ",".join(u.split("//")[-1] for u in backend_urls),
+           "--host", "127.0.0.1", "--port", "0",
+           "--health-interval", "0.2", "--quarantine-after", "2",
+           "--probation-successes", "2",
+           "--connect-timeout", "2.0"] + list(extra_flags)
+    _say(f"booting router: {' '.join(cmd)}")
+    router = subprocess.Popen(cmd, env=env, stdout=log,
+                              stderr=subprocess.STDOUT)
+    url = None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and url is None:
+        if router.poll() is not None:
+            break
+        log.flush()
+        with open(log.name) as f:
+            m = re.search(r"routing on (http://\S+)", f.read())
+        if m:
+            url = m.group(1).rstrip("/")
+        else:
+            time.sleep(0.2)
+    if url is None:
+        with open(log.name) as f:
+            print(f.read()[-4000:], file=sys.stderr)
+        _say("router never came up")
+    return router, log, url
 
 
 def _loadgen_report(proc_out: str) -> dict:
@@ -621,6 +681,266 @@ def run_serve_chaos(args) -> int:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+def _seed_checkpoint(env: dict, directory: str, epoch: int) -> str:
+    """Save a real linear-model checkpoint_{epoch}.npz into
+    ``directory`` via a subprocess (chaos itself stays jax-import-free)
+    and return its path."""
+    code = (
+        "import sys, jax, jax.numpy as jnp\n"
+        "from pytorch_distributed_mnist_tpu.models import get_model\n"
+        "from pytorch_distributed_mnist_tpu.train.state import "
+        "create_train_state\n"
+        "from pytorch_distributed_mnist_tpu.train.checkpoint import "
+        "save_checkpoint\n"
+        "m = get_model('linear', compute_dtype=jnp.float32)\n"
+        "s = create_train_state(m, jax.random.key(7))\n"
+        "save_checkpoint(s, epoch=int(sys.argv[2]), best_acc=0.5,\n"
+        "                is_best=False, directory=sys.argv[1],\n"
+        "                process_index=0)\n")
+    subprocess.run([sys.executable, "-c", code, directory, str(epoch)],
+                   env=env, check=True, timeout=300)
+    return os.path.join(directory, f"checkpoint_{epoch}.npz")
+
+
+def run_fleet_chaos(args) -> int:
+    """The fleet-federation twins (ISSUE 17): a REAL router subprocess
+    over --fleet N real single-chip serve subprocesses.
+
+    --kill-backend K: SIGKILL backend K mid-loadgen; every request must
+    still be answered (router failover + the loadgen's bounded
+    --retry-transport = zero DROPPED), the corpse must quarantine, and
+    a restart on its old port must walk probation back to healthy.
+
+    --rolling-reload: POST /rollout publishes a new epoch to the whole
+    fleet one backend at a time under live loadgen — zero drops, every
+    backend on the new epoch afterward.
+
+    --fleet-canary-rollback: publish behind a fleet canary with
+    TPUMNIST_FLEET_FAULT=canary_disagree injected into the router —
+    the canary must roll back (baseline weights republished) while
+    every request is still answered."""
+    env = _serve_env(args)
+    router_env = dict(env)
+    if args.fleet_canary_rollback:
+        router_env[FLEET_FAULT_ENV] = "canary_disagree"
+    else:
+        router_env.pop(FLEET_FAULT_ENV, None)
+    backend_flags = ["--model", "linear", "--buckets", "1,8",
+                     "--max-wait-ms", "2", "--max-queue", "256",
+                     "--poll-interval", "0.2"]
+    backends = []  # (server, log, ckpt_dir, url)
+    router = router_log = None
+    staging = tempfile.mkdtemp(prefix="tpumnist-fleet-staging-")
+    try:
+        for i in range(args.fleet):
+            server, log, ckpt_dir, url = _boot_serve(
+                env, backend_flags, args.timeout)
+            if url is None:
+                return 1
+            backends.append([server, log, ckpt_dir, url])
+        _say(f"fleet up: {[b[3] for b in backends]}")
+        router, router_log, url = _boot_router(
+            router_env, [b[3] for b in backends], args.timeout)
+        if url is None:
+            return 1
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if _get_json(url, "/healthz").get("routable") == args.fleet:
+                break
+            time.sleep(0.2)
+        _say(f"router up at {url}, {args.fleet} backends routable")
+        dirs_body = {b[3].split("//")[-1]: b[2] for b in backends}
+
+        if args.kill_backend is not None:
+            victim = backends[args.kill_backend]
+            duration = 6.0
+            loadgen = subprocess.Popen(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "loadgen.py"),
+                 "--mode", "open", "--rate", "80",
+                 "--duration", str(duration), "--retry-transport", "2",
+                 "--url", url],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            time.sleep(duration * 0.35)
+            _say(f"SIGKILL backend {args.kill_backend} ({victim[3]})")
+            victim[0].kill()
+            victim[0].wait()
+            out, _ = loadgen.communicate(timeout=args.timeout)
+            report = _loadgen_report(out)
+            answered = sum(report.get("status_counts", {}).values())
+            dropped = (report.get("transport_errors", 0)
+                       + report.get("conn_refused", 0))
+            if loadgen.returncode != 0 or dropped or \
+                    report.get("ok") != answered or answered < 100:
+                _say(f"DROPPED requests through the kill: ok="
+                     f"{report.get('ok')}/{answered}, dropped={dropped}")
+                return 1
+            _say(f"{answered} requests answered through the kill, zero "
+                 f"dropped ({report.get('transport_retries')} client "
+                 f"retries)")
+            stats = _get_json(url, "/stats")
+            victim_name = victim[3].split("//")[-1]
+            rows = {r["name"]: r for r in stats["backends"]}
+            if rows[victim_name]["state"] != "quarantined" or \
+                    not stats["fleet"]["failovers"]:
+                _say(f"expected quarantine+failover, got state="
+                     f"{rows[victim_name]['state']}, failovers="
+                     f"{stats['fleet']['failovers']}")
+                return 1
+            _say(f"victim quarantined; failovers="
+                 f"{stats['fleet']['failovers']}, merged fleet p99="
+                 f"{stats['fleet']['window']['p99_ms']}ms")
+            # Restart on the old port: probation -> healthy, no
+            # operator action at the router.
+            port = int(victim[3].rsplit(":", 1)[1])
+            victim[1].close()
+            os.unlink(victim[1].name)
+            server, log, ckpt_dir, burl = _boot_serve(
+                env, backend_flags, args.timeout,
+                ckpt_dir=victim[2], port=port)
+            victim[0], victim[1], victim[3] = server, log, burl or ""
+            if burl is None:
+                return 1
+            deadline = time.monotonic() + args.timeout
+            row = {}
+            while time.monotonic() < deadline:
+                stats = _get_json(url, "/stats")
+                row = {r["name"]: r
+                       for r in stats["backends"]}[victim_name]
+                if row["state"] == "healthy":
+                    break
+                time.sleep(0.2)
+            if row.get("state") != "healthy" or not row.get("readmissions"):
+                _say(f"victim never re-admitted: {row}")
+                return 1
+            _say(f"victim re-admitted through probation "
+                 f"(readmissions={row['readmissions']}); fleet whole "
+                 f"again")
+            return 0
+
+        if args.rolling_reload:
+            source = _seed_checkpoint(env, staging, epoch=1)
+            loadgen = subprocess.Popen(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "loadgen.py"),
+                 "--mode", "open", "--rate", "60", "--duration", "8",
+                 "--retry-transport", "2", "--url", url],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            time.sleep(1.0)
+            reply = _post_json(url, "/rollout",
+                               {"source": source, "dirs": dirs_body})
+            if not reply.get("ok") or \
+                    len(reply.get("updated", [])) != args.fleet:
+                _say(f"rolling reload failed: {reply}")
+                return 1
+            _say(f"rolled epoch 1 onto {reply['updated']}")
+            out, _ = loadgen.communicate(timeout=args.timeout)
+            report = _loadgen_report(out)
+            answered = sum(report.get("status_counts", {}).values())
+            dropped = (report.get("transport_errors", 0)
+                       + report.get("conn_refused", 0))
+            if loadgen.returncode != 0 or dropped or \
+                    report.get("ok") != answered:
+                _say(f"DROPPED requests through the rollout: ok="
+                     f"{report.get('ok')}/{answered}, dropped={dropped}")
+                return 1
+            for _, _, _, burl in backends:
+                health = _get_json(burl, "/healthz")
+                if health.get("model_epoch") != 1 or health.get("draining"):
+                    _say(f"backend {burl} not on epoch 1 post-rollout: "
+                         f"{health}")
+                    return 1
+            _say(f"{answered} requests answered through the fleet-wide "
+                 f"publish, zero dropped; every backend on epoch 1")
+            return 0
+
+        if args.fleet_canary_rollback:
+            # Baseline first: the whole fleet on a real epoch 1, so the
+            # rollback has baseline WEIGHTS to restore.
+            source = _seed_checkpoint(env, staging, epoch=1)
+            reply = _post_json(url, "/rollout",
+                               {"source": source, "dirs": dirs_body})
+            if not reply.get("ok"):
+                _say(f"baseline publish failed: {reply}")
+                return 1
+            target = _seed_checkpoint(env, staging, epoch=2)
+            canary_name = backends[0][3].split("//")[-1]
+            reply = _post_json(url, "/rollout", {
+                "source": target, "dirs": dirs_body,
+                "canary": {"fraction": 1.0, "budget": 0.0,
+                           "promote_after": 100000,
+                           "backends": [canary_name]}})
+            if not reply.get("ok"):
+                _say(f"canary publish failed: {reply}")
+                return 1
+            # client_id puts every request in the (fraction-1.0)
+            # cohort; the injected fault disagrees every row, so the
+            # FIRST cohort reply must roll the fleet canary back.
+            proc = subprocess.run(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "loadgen.py"),
+                 "--requests", str(args.requests), "--concurrency", "4",
+                 "--retry-transport", "2", "--client-id", "canary-probe",
+                 "--url", url],
+                capture_output=True, text=True, timeout=args.timeout)
+            report = _loadgen_report(proc.stdout)
+            answered = sum(report.get("status_counts", {}).values())
+            dropped = (report.get("transport_errors", 0)
+                       + report.get("conn_refused", 0))
+            if dropped or report.get("ok") != answered:
+                _say(f"DROPPED requests during the canary: ok="
+                     f"{report.get('ok')}/{answered}, dropped={dropped}")
+                return 1
+            deadline = time.monotonic() + args.timeout
+            can = {}
+            while time.monotonic() < deadline:
+                can = _get_json(url, "/stats").get("fleet_canary") or {}
+                if can.get("state") == "rolled_back":
+                    break
+                time.sleep(0.2)
+            if can.get("state") != "rolled_back":
+                _say(f"expected fleet canary rolled_back under injected "
+                     f"disagreement, got {can.get('state')!r}")
+                return 1
+            # The rollback republishes the BASELINE weights (as the
+            # next epoch number — epochs are publish sequence numbers);
+            # wait for the canary backend to swap onto them.
+            deadline = time.monotonic() + args.timeout
+            epoch = None
+            while time.monotonic() < deadline:
+                epoch = _get_json(backends[0][3],
+                                  "/healthz").get("model_epoch")
+                if epoch == 3:
+                    break
+                time.sleep(0.2)
+            if epoch != 3:
+                _say(f"canary backend never restored baseline weights "
+                     f"(epoch {epoch}, want 3 = baseline republished)")
+                return 1
+            _say(f"fleet canary rolled back "
+                 f"({can.get('disagreed_rows')} disagreeing rows of "
+                 f"{can.get('compared_rows')}); baseline weights "
+                 f"republished, {answered} requests answered, zero "
+                 f"dropped")
+            return 0
+
+        _say("--fleet needs one of --kill-backend K / --rolling-reload "
+             "/ --fleet-canary-rollback")
+        return 2
+    finally:
+        if router is not None:
+            router.kill()
+            router.wait()
+        if router_log is not None:
+            router_log.close()
+            os.unlink(router_log.name)
+        for server, log, ckpt_dir, _ in backends:
+            _kill_serve(server, log, ckpt_dir)
+        shutil.rmtree(staging, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos",
@@ -780,6 +1100,29 @@ def main(argv=None) -> int:
                         "backend with this many fake devices (local "
                         "rehearsal on accelerator-less boxes; 0 = "
                         "leave the environment alone)")
+    # -- the fleet-federation twins (router over N backends) -----------
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="fleet twin: boot a real `tpu-mnist route` "
+                        "router over N real single-chip serve "
+                        "subprocesses; combine with --kill-backend / "
+                        "--rolling-reload / --fleet-canary-rollback")
+    p.add_argument("--kill-backend", type=int, default=None,
+                   metavar="K",
+                   help="fleet twin: SIGKILL backend K mid-loadgen — "
+                        "zero DROPPED requests (router failover + "
+                        "loadgen --retry-transport), quarantine, then "
+                        "probation re-admission after a restart on the "
+                        "old port")
+    p.add_argument("--rolling-reload", action="store_true",
+                   help="fleet twin: POST /rollout a new epoch across "
+                        "the whole fleet under live loadgen — zero "
+                        "drops, every backend on the new epoch after")
+    p.add_argument("--fleet-canary-rollback", action="store_true",
+                   help="fleet twin: publish behind a fleet canary "
+                        f"with {FLEET_FAULT_ENV}=canary_disagree "
+                        "injected into the router — the canary must "
+                        "roll back (baseline weights republished) "
+                        "while every request is still answered")
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="arguments after -- go to tpu-mnist verbatim")
     args = p.parse_args(argv)
@@ -788,6 +1131,16 @@ def main(argv=None) -> int:
         list_fault_points()
         return 0
 
+    if args.fleet:
+        if args.fleet < 2:
+            raise SystemExit("--fleet N needs N >= 2 (a 1-backend "
+                             "fleet has no failure domain to survive)")
+        return run_fleet_chaos(args)
+    if args.kill_backend is not None or args.rolling_reload \
+            or args.fleet_canary_rollback:
+        raise SystemExit("--kill-backend/--rolling-reload/"
+                         "--fleet-canary-rollback are fleet twins; "
+                         "add --fleet N")
     if args.autoscale_spike:
         return run_autoscale_spike(args)
     if args.quota_abuse:

@@ -30,7 +30,15 @@ a multi-model server.
 
 Report: one JSON line — throughput, p50/p95/p99/mean/max latency, status
 counts, rejection count, `retry_after_seen` (429/503 replies carrying a
-Retry-After header — the back-off contract). `--smoke` is the CI entry:
+Retry-After header — the back-off contract). Transport failures split
+two ways: `conn_refused` (nothing listening — a killed/restarting
+backend, never an executed request) vs `transport_errors` (reset,
+timeout, everything else). `--retry-transport N` re-fires a request up
+to N times after a transport failure or a 502 (inline in the same fire
+thread, so the open-loop schedule is untouched) and counts each re-fire
+in `transport_retries` — the fleet chaos twins assert "zero DROPPED"
+(`transport_errors == 0` after bounded retries), not "zero transport
+blips". `--smoke` is the CI entry:
 closed-loop burst with tight defaults, nonzero exit unless every request
 succeeded and the server's /stats and /healthz answer;
 `--expect-models N` additionally requires the multi-model /stats block.
@@ -171,6 +179,8 @@ class Collector:
         self.latencies = []
         self.status = {}
         self.errors = 0
+        self.conn_refused = 0
+        self.transport_retries = 0
         self.not_launched = 0
         self.retry_after_seen = 0
         self.classes = {}
@@ -197,9 +207,20 @@ class Collector:
                 if status == 200:
                     rec["latencies"].append(latency_s)
 
-    def record_error(self) -> None:
+    def record_error(self, refused: bool = False) -> None:
+        """``refused=True`` = connection refused: nothing was listening,
+        so the request was provably never executed — a different animal
+        from a reset/timeout (which MAY have reached a handler). The
+        fleet chaos twins assert on the two counters separately."""
         with self.lock:
-            self.errors += 1
+            if refused:
+                self.conn_refused += 1
+            else:
+                self.errors += 1
+
+    def record_retry(self) -> None:
+        with self.lock:
+            self.transport_retries += 1
 
     def record_not_launched(self) -> None:
         """Open loop only: the schedule fired but the CLIENT could not
@@ -209,24 +230,47 @@ class Collector:
             self.not_launched += 1
 
 
+def _is_refused(exc) -> bool:
+    """Connection refused, unwrapping urllib's URLError envelope."""
+    if isinstance(exc, urllib.error.URLError):
+        exc = exc.reason
+    return isinstance(exc, ConnectionRefusedError)
+
+
 def _one_request(url: str, body: bytes, timeout: float,
-                 collector: Collector, klass=None) -> None:
+                 collector: Collector, klass=None,
+                 retries: int = 0) -> None:
+    """Fire one request; with ``retries`` > 0, transport failures and
+    502s (the router's "backend failed" surface — the reply that says
+    re-sending is a NEW request, not a double-dispatch) are re-fired
+    inline in the same thread, so the open-loop schedule stays a
+    schedule. Exactly one terminal outcome is recorded per call."""
     req = urllib.request.Request(
         url + "/predict", data=body,
         headers={"Content-Type": "application/json"})
-    t0 = time.perf_counter()
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            resp.read()
-            collector.record(resp.status, time.perf_counter() - t0,
-                             klass=klass)
-    except urllib.error.HTTPError as exc:
-        exc.read()
-        collector.record(
-            exc.code, time.perf_counter() - t0, klass=klass,
-            retry_after=exc.headers.get("Retry-After") is not None)
-    except Exception:  # noqa: BLE001 - connection/timeout errors
-        collector.record_error()
+    for attempt in range(retries + 1):
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                resp.read()
+                collector.record(resp.status, time.perf_counter() - t0,
+                                 klass=klass)
+                return
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            if exc.code == 502 and attempt < retries:
+                collector.record_retry()
+                continue
+            collector.record(
+                exc.code, time.perf_counter() - t0, klass=klass,
+                retry_after=exc.headers.get("Retry-After") is not None)
+            return
+        except Exception as exc:  # noqa: BLE001 - connection/timeout
+            if attempt < retries:
+                collector.record_retry()
+                continue
+            collector.record_error(refused=_is_refused(exc))
+            return
 
 
 def _pick_body(bodies, mix, rng, i):
@@ -238,7 +282,8 @@ def _pick_body(bodies, mix, rng, i):
 
 
 def run_closed(url: str, requests: int, concurrency: int, bodies,
-               timeout: float, mix=None, seed: int = 0) -> Collector:
+               timeout: float, mix=None, seed: int = 0,
+               retries: int = 0) -> Collector:
     collector = Collector()
     counter = {"next": 0}
     lock = threading.Lock()
@@ -252,7 +297,8 @@ def run_closed(url: str, requests: int, concurrency: int, bodies,
                     return
                 counter["next"] = i + 1
                 klass, body = _pick_body(bodies, mix, rng, i)
-            _one_request(url, body, timeout, collector, klass=klass)
+            _one_request(url, body, timeout, collector, klass=klass,
+                         retries=retries)
 
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(concurrency)]
@@ -266,7 +312,7 @@ def run_closed(url: str, requests: int, concurrency: int, bodies,
 def run_open(url: str, rate: float, duration: float, bodies,
              timeout: float, max_outstanding: int = 512,
              shape: str = "constant", spike_mult: float = 5.0,
-             mix=None, seed: int = 0) -> Collector:
+             mix=None, seed: int = 0, retries: int = 0) -> Collector:
     collector = Collector()
     sem = threading.Semaphore(max_outstanding)
     threads = []
@@ -291,7 +337,8 @@ def run_open(url: str, rate: float, duration: float, bodies,
 
         def fire(body=body, klass=klass):
             try:
-                _one_request(url, body, timeout, collector, klass=klass)
+                _one_request(url, body, timeout, collector, klass=klass,
+                             retries=retries)
             finally:
                 sem.release()
 
@@ -325,6 +372,8 @@ def report(collector: Collector, wall_s: float, mode: str) -> dict:
         "status_counts": {str(k): v
                           for k, v in sorted(collector.status.items())},
         "transport_errors": collector.errors,
+        "conn_refused": collector.conn_refused,
+        "transport_retries": collector.transport_retries,
         "not_launched": collector.not_launched,
         "throughput_rps": round(ok / wall_s, 2) if wall_s > 0 else 0.0,
         "latency_ms": {
@@ -404,6 +453,15 @@ def main(argv=None) -> int:
                         "there)")
     p.add_argument("--images-per-request", type=int, default=1)
     p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--retry-transport", type=int, default=0,
+                   metavar="N",
+                   help="re-fire a request up to N times after a "
+                        "transport failure or a 502 (bounded, inline in "
+                        "the same fire thread so the open-loop schedule "
+                        "is preserved); each re-fire counts in "
+                        "transport_retries — lets fleet chaos twins "
+                        "assert zero DROPPED requests rather than zero "
+                        "transport blips")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
                    help="CI gate: closed-loop burst; exit nonzero unless "
@@ -470,11 +528,13 @@ def main(argv=None) -> int:
         collector = run_open(url, args.rate, args.duration, bodies,
                              args.timeout, shape=args.shape,
                              spike_mult=args.spike_mult, mix=mix,
-                             seed=args.seed)
+                             seed=args.seed,
+                             retries=args.retry_transport)
     else:
         collector = run_closed(url, args.requests, args.concurrency,
                                bodies, args.timeout, mix=mix,
-                               seed=args.seed)
+                               seed=args.seed,
+                               retries=args.retry_transport)
     out = report(collector, time.perf_counter() - t0,
                  "closed" if args.smoke else args.mode)
     # Data-plane shape from /stats on EVERY run (not just smoke): a
@@ -520,6 +580,7 @@ def main(argv=None) -> int:
                 health.get("ok") is True
                 and out["ok"] == args.requests
                 and out["transport_errors"] == 0
+                and out["conn_refused"] == 0
                 and "p50" in plane.get("latency_ms", {})
                 and "p99" in plane.get("latency_ms", {})
                 and plane.get("batch_histogram")
