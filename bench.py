@@ -3063,6 +3063,237 @@ def main_zero() -> None:
         sys.exit(1)
 
 
+def main_publish() -> None:
+    """``--mode publish``: the delta-distribution BENCH line (ISSUE 18).
+
+    Measures what a checkpoint publish COSTS and how fast a fleet
+    becomes consistent, delta vs whole-file:
+
+    - **publisher side**: whole-file npz bytes + write time vs the
+      cold (first) delta publish vs an ADJACENT publish (one leaf
+      changed — the training-loop steady state); the adjacent publish's
+      new chunk bytes over the whole-file bytes is the headline ratio.
+    - **fleet side**: three in-process ``DeltaFetcher`` "backends" over
+      one published manifest — backend 0 fetches from the source
+      directory and seeds a real loopback ``/chunks/<hash>`` HTTP
+      server (the gossip plane); backends 1-2 list it as a peer, so
+      their bytes must arrive peer-first (``bytes_source == 0``).
+      Cold-start fetch (a new backend joins: every params chunk moves,
+      but never the optimizer moments) and adjacent fetch (only the
+      dirty leaf's chunks move) each get bytes + time-to-fleet-
+      consistency, and the adjacent fleet bytes must land under 30% of
+      shipping the whole file to every backend — the ISSUE 18
+      acceptance bar, asserted here so it fails loudly.
+
+    Filesystem + loopback-HTTP only (no device program in the measured
+    path), so absolute times are the host's; the byte counts and
+    ratios are platform-independent. ``BENCH_PUBLISH_INJECT_FAIL``
+    pins the fails-loudly path for tests."""
+    import shutil as _shutil
+    import tempfile
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_mnist_tpu.distrib.cas import ChunkStore
+    from pytorch_distributed_mnist_tpu.distrib.fetch import DeltaFetcher
+    from pytorch_distributed_mnist_tpu.distrib.publish import publish_state
+    from pytorch_distributed_mnist_tpu.models import get_model
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        save_checkpoint,
+    )
+    from pytorch_distributed_mnist_tpu.train.state import create_train_state
+
+    chunk_mb = float(os.environ.get("BENCH_PUBLISH_CHUNK_MB", "0.25"))
+    n_backends = int(os.environ.get("BENCH_PUBLISH_BACKENDS", "3"))
+    device = jax.devices()[0]
+    out = {
+        "metric": "mnist_delta_publish_adjacent_fleet_bytes_fraction",
+        "unit": "fraction of whole-file x backends bytes",
+        "baseline": "whole-file npz publish copied to every backend",
+        "backend": device.platform,
+        "device_kind": device.device_kind,
+    }
+    failures = []
+    dirs = [tempfile.mkdtemp(prefix="bench-publish-") for _ in range(3)]
+    whole_dir, source_dir, fleet_root = dirs
+    backend_dirs = [os.path.join(fleet_root, f"b{i}")
+                    for i in range(n_backends)]
+    httpd = None
+    try:
+        model = get_model("linear", compute_dtype=jnp.float32)
+        state = create_train_state(model, jax.random.key(7))
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        small = min(range(len(leaves)), key=lambda j: leaves[j].size)
+
+        def _adjacent(epoch):
+            shifted = list(leaves)
+            shifted[small] = leaves[small] + epoch * 1e-3
+            return state.replace(
+                params=jax.tree_util.tree_unflatten(treedef, shifted))
+
+        def _dir_bytes(d):
+            chunks = os.path.join(d, "chunks")
+            if not os.path.isdir(chunks):
+                return 0
+            return sum(os.path.getsize(os.path.join(chunks, f))
+                       for f in os.listdir(chunks))
+
+        # -- publisher side ---------------------------------------------
+        t0 = time.perf_counter()
+        save_checkpoint(state, epoch=1, best_acc=0.5, is_best=False,
+                        directory=whole_dir, process_index=0)
+        whole_s = time.perf_counter() - t0
+        whole_path = os.path.join(whole_dir, "checkpoint_1.npz")
+        whole_bytes = os.path.getsize(whole_path)
+
+        t0 = time.perf_counter()
+        manifest1 = publish_state(state, epoch=1, best_acc=0.5,
+                                  directory=source_dir, chunk_mb=chunk_mb,
+                                  process_index=0)
+        cold_s = time.perf_counter() - t0
+        cold_bytes = _dir_bytes(source_dir)
+
+        t0 = time.perf_counter()
+        manifest2 = publish_state(_adjacent(2), epoch=2, best_acc=0.5,
+                                  directory=source_dir, chunk_mb=chunk_mb,
+                                  process_index=0)
+        adj_s = time.perf_counter() - t0
+        adj_bytes = _dir_bytes(source_dir) - cold_bytes
+        publish_ratio = adj_bytes / whole_bytes
+
+        # -- fleet side: loopback gossip over real HTTP -----------------
+        seed_store = ChunkStore(backend_dirs[0])
+
+        class _ChunkHandler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                digest = self.path.rsplit("/", 1)[-1]
+                if not seed_store.has(digest):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = seed_store.get(digest)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # noqa: D102 - quiet bench server
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _ChunkHandler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        peer_url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        # Backend 0 pulls from the source dir and thereby SEEDS the
+        # gossip endpoint; the rest list it as their (only) peer with
+        # the source dir as fallback — peer-first is then observable as
+        # bytes_source == 0 on every non-seed backend.
+        fetchers = [DeltaFetcher(backend_dirs[0], source_dir=source_dir)]
+        fetchers += [DeltaFetcher(d, peers=(peer_url,),
+                                  source_dir=source_dir)
+                     for d in backend_dirs[1:]]
+
+        def _fleet_load(path, want_epoch):
+            t0 = time.perf_counter()
+            for fetcher in fetchers:
+                _, epoch = fetcher.load(path, state)
+                if epoch != want_epoch:
+                    failures.append(
+                        f"fetcher returned epoch {epoch}, want "
+                        f"{want_epoch} from {path}")
+            return time.perf_counter() - t0
+
+        cold_fleet_s = _fleet_load(manifest1, 1)
+        cold_fetch_bytes = sum(f.last["bytes_fetched"] for f in fetchers)
+        adj_fleet_s = _fleet_load(manifest2, 2)
+        adj_fetch_bytes = sum(f.last["bytes_fetched"] for f in fetchers)
+        peer_bytes = sum(f.total["bytes_peer"] for f in fetchers[1:])
+        source_bytes_nonseed = sum(f.total["bytes_source"]
+                                   for f in fetchers[1:])
+        dirty = [f.last["dirty_leaves"] for f in fetchers]
+        clean = [f.last["clean_leaves"] for f in fetchers]
+
+        fleet_ratio = adj_fetch_bytes / (whole_bytes * n_backends)
+        if fleet_ratio >= 0.30:
+            failures.append(
+                f"adjacent delta fetch moved {adj_fetch_bytes}B to "
+                f"{n_backends} backends = {fleet_ratio:.3f} of "
+                f"whole-file x backends; the ISSUE 18 bar is < 0.30")
+        if peer_bytes <= 0:
+            failures.append(
+                "gossip never moved a byte: non-seed backends should "
+                "fetch from the peer endpoint")
+        if source_bytes_nonseed:
+            failures.append(
+                f"non-seed backends pulled {source_bytes_nonseed}B from "
+                f"the source dir despite a complete peer (peers must be "
+                f"tried first)")
+        if any(d != dirty[0] for d in dirty) or \
+                any(c != clean[0] for c in clean):
+            failures.append(
+                f"backends disagree on the diff: dirty={dirty}, "
+                f"clean={clean}")
+        if os.environ.get("BENCH_PUBLISH_INJECT_FAIL"):
+            # Test hook: pin the fails-loudly path (mirrors
+            # BENCH_FLEET_INJECT_FAIL).
+            failures.append("BENCH_PUBLISH_INJECT_FAIL set: injected "
+                            "publish verdict failure")
+
+        out.update({
+            "value": round(fleet_ratio, 5),
+            "vs_baseline": round(
+                (whole_bytes * n_backends) / max(adj_fetch_bytes, 1), 1),
+            "publish": {
+                "chunk_mb": chunk_mb,
+                "whole_file_bytes": whole_bytes,
+                "whole_file_publish_s": round(whole_s, 4),
+                "cold_chunk_bytes": cold_bytes,
+                "cold_publish_s": round(cold_s, 4),
+                "adjacent_new_chunk_bytes": adj_bytes,
+                "adjacent_publish_s": round(adj_s, 4),
+                "adjacent_publish_bytes_fraction": round(
+                    publish_ratio, 5),
+            },
+            "fleet": {
+                "backends": n_backends,
+                "cold_fetch_bytes": cold_fetch_bytes,
+                "cold_time_to_consistency_s": round(cold_fleet_s, 4),
+                "adjacent_fetch_bytes": adj_fetch_bytes,
+                "adjacent_time_to_consistency_s": round(adj_fleet_s, 4),
+                "adjacent_fleet_bytes_fraction": round(fleet_ratio, 5),
+                "gossip_peer_bytes": peer_bytes,
+                "non_seed_source_bytes": source_bytes_nonseed,
+                "dirty_leaves": dirty[0],
+                "clean_leaves": clean[0],
+                "delta_under_30pct_of_whole_file": fleet_ratio < 0.30,
+            },
+            "caveat": (
+                "filesystem + loopback HTTP on this host: absolute "
+                "publish/fetch times are not a fabric's (the BENCH_r05 "
+                "convention) — the byte counts, the ratios, and the "
+                "peer-vs-source split are the meaningful part"),
+        })
+        if failures:
+            out["error"] = "; ".join(failures)
+    except Exception as exc:  # noqa: BLE001 - bench must always emit JSON
+        out.update({"value": 0.0, "vs_baseline": 0.0, "error": repr(exc)})
+        failures.append(repr(exc))
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        for d in dirs:
+            _shutil.rmtree(d, ignore_errors=True)
+    out["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(json.dumps(out))
+    if failures:
+        sys.exit(1)
+
+
 def bench_torch_reference() -> float:
     """Reference-style per-batch torch loop (same CNN, Adam), CPU."""
     import torch
@@ -3199,9 +3430,12 @@ if __name__ == "__main__":
         main_input()
     elif mode == "zero":
         main_zero()
+    elif mode == "publish":
+        main_publish()
     elif mode not in (None, "train"):
-        print(json.dumps({"error": f"unknown --mode {mode!r}; "
-                                   f"expected train, serve, input or zero"}))
+        print(json.dumps({"error": f"unknown --mode {mode!r}; expected "
+                                   f"train, serve, input, zero or "
+                                   f"publish"}))
         sys.exit(2)
     elif "--vit" in argv:
         main_vit()

@@ -340,6 +340,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "serve process hot-reloading from this directory "
                         "can never have its in-progress load deleted "
                         "(train/checkpoint.py ordering guarantee)")
+    p.add_argument("--publish", type=str, default="full",
+                   choices=["full", "delta"],
+                   help="checkpoint publish format: 'full' writes the "
+                        "whole npz/sharded file per epoch (default); "
+                        "'delta' writes content-addressed chunks plus a "
+                        "small manifest (distrib/) — adjacent epochs "
+                        "share unchanged chunks, so each publish costs "
+                        "O(changed bytes) and a serve fleet fetches only "
+                        "what moved. Requires fully-addressable (or "
+                        "replicated) leaves; sharded multi-host layouts "
+                        "publish .ckpt and convert via "
+                        "publish_from_checkpoint")
+    p.add_argument("--chunk-mb", type=float, default=4.0, metavar="MB",
+                   help="delta publish chunk budget in MiB (fixed "
+                        "per-leaf byte boundaries, so a small weight "
+                        "change dirties one chunk, not the file). "
+                        "Default 4")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="write checkpoints on a background thread, "
                         "overlapping file I/O with the next epoch "
@@ -1805,6 +1822,8 @@ def _run_body(args, epoch_callback=None) -> dict:
                 # the matching --serve-mode, not silently replicated.
                 parallel_layout={"tensor": tp, "sequence": sp,
                                  "expert": ep, "pipeline": pp},
+                publish=getattr(args, "publish", None) or "full",
+                chunk_mb=getattr(args, "chunk_mb", 4.0),
             )
             if saver is not None:
                 # The annotated span is the drain of the PREVIOUS epoch's

@@ -1,0 +1,37 @@
+"""Delta weight distribution: content-addressed checkpoints.
+
+The distribution subsystem (DESIGN.md §7m): checkpoints become a
+MANIFEST (the atomic publish unit, a small JSON file) plus
+content-addressed chunks in a write-once store, so publishing epoch
+N+1 after epoch N moves only the chunks that changed — O(changed
+bytes), not O(replicas x full file). Three modules:
+
+- ``cas.py``    — the chunk store + deterministic chunk planning +
+                  manifest read/write (the format layer);
+- ``publish.py``— the trainer side: delta publish wired into
+                  ``train/checkpoint.py`` (``--publish delta``), chunk
+                  GC extending the prune window rule;
+- ``fetch.py``  — the serve side: the ``CheckpointWatcher`` loader
+                  that diffs a manifest against the local inventory,
+                  fetches only missing chunks (peer backends first,
+                  source dir fallback), patches leaves, and
+                  re-quantizes only dirtied ones.
+"""
+
+from pytorch_distributed_mnist_tpu.distrib.cas import (  # noqa: F401
+    ChunkStore,
+    MANIFEST_SUFFIX,
+    chunk_leaf,
+    load_manifest_arrays,
+    manifest_digests,
+    read_manifest,
+    write_manifest,
+)
+from pytorch_distributed_mnist_tpu.distrib.fetch import (  # noqa: F401
+    DeltaFetcher,
+)
+from pytorch_distributed_mnist_tpu.distrib.publish import (  # noqa: F401
+    gc_chunks,
+    publish_arrays,
+    publish_from_checkpoint,
+)

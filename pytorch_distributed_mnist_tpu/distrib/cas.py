@@ -1,0 +1,266 @@
+"""Content-addressed chunk store + manifest format.
+
+A checkpoint leaf's canonical bytes (C-order ``tobytes()``) are split
+into chunks at FIXED byte offsets of a ``--chunk-mb`` budget, each chunk
+named by its sha256 and written once into ``<dir>/chunks/``. The leaf
+traversal order reuses the PR 7 ``bucket_plan`` packing discipline
+(largest-first, flat-index tie-break) — the same deterministic ordering
+the ZeRO buckets pin — so for a model of fixed shapes the chunk
+boundaries, the traversal, and therefore every UNCHANGED leaf's chunk
+list are identical across epochs. That stability is what makes a delta
+publish a set-difference: chunks already in the store are never
+rewritten (write-once), and a fetcher's diff of manifest-vs-inventory
+is exact.
+
+The MANIFEST is the atomic publish unit: ``checkpoint_{e}.manifest``,
+a JSON file carrying the same meta the npz/sharded layouts stamp
+(``epoch`` as ``epoch+1``, ``best_acc``, ``leaf_names``, ``world``,
+``parallel_layout``) plus per-leaf ``{shape, dtype, chunks, lengths}``.
+It is written tmp+rename AFTER every chunk it references is on disk,
+so a reader that can parse a manifest can (absent external deletion)
+assemble it. A torn manifest is a ``json.JSONDecodeError`` — already
+classified content-level damage by ``is_corrupt_checkpoint_error``, so
+resume quarantines it and the serve watcher permanent-skips it exactly
+like a torn npz today. A MISSING CHUNK raises a ValueError (message
+``missing chunk``, deliberately distinct from the sharded layout's
+``missing shards`` stale-NFS case): absence-level, permanent for that
+publish at the watcher, loud abort at resume.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.parallel.zero_overlap import bucket_plan
+
+MANIFEST_SUFFIX = ".manifest"
+CHUNK_DIR = "chunks"
+MANIFEST_VERSION = 1
+
+_DIGEST_RE = re.compile(r"[0-9a-f]{64}")
+
+
+def is_manifest(path: str) -> bool:
+    return path.endswith(MANIFEST_SUFFIX)
+
+
+def _digest(data) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class ChunkStore:
+    """Write-once sha256-named chunk files under ``<directory>/chunks/``.
+
+    ``directory`` is the CHECKPOINT directory — chunks live beside the
+    manifests that reference them, so the prune window and the chunk GC
+    see one consistent namespace. ``put`` verifies content against the
+    digest (a fetcher installs peer-supplied bytes through here, so a
+    corrupt peer can never poison the store) and is tmp+rename atomic;
+    an already-present digest is never rewritten.
+    """
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        self.root = os.path.join(directory, CHUNK_DIR)
+
+    def path(self, digest: str) -> str:
+        return os.path.join(self.root, digest)
+
+    def has(self, digest: str) -> bool:
+        return os.path.isfile(self.path(digest))
+
+    def put(self, digest: str, data: bytes) -> bool:
+        """Store ``data`` under ``digest``; returns True when bytes were
+        written (False: already present — the write-once fast path that
+        makes adjacent-epoch publishes cheap)."""
+        if self.has(digest):
+            return False
+        if _digest(data) != digest:
+            raise ValueError(
+                f"chunk content does not match its digest {digest} — "
+                f"refusing to store corrupt bytes")
+        os.makedirs(self.root, exist_ok=True)
+        path = self.path(digest)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return True
+
+    def get(self, digest: str) -> bytes:
+        path = self.path(digest)
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise ValueError(
+                f"missing chunk {digest} in {self.root} — the manifest "
+                f"references a chunk this store does not hold") from None
+
+    def digests(self) -> set:
+        if not os.path.isdir(self.root):
+            return set()
+        return {name for name in os.listdir(self.root)
+                if _DIGEST_RE.fullmatch(name)}
+
+    def gc(self, referenced: set) -> int:
+        """Delete chunk files not in ``referenced``; returns bytes freed."""
+        freed = 0
+        for digest in self.digests() - set(referenced):
+            path = self.path(digest)
+            try:
+                freed += os.path.getsize(path)
+                os.remove(path)
+            except OSError:
+                pass  # raced by a concurrent publish's put: keep it
+        return freed
+
+
+def chunk_budget_bytes(chunk_mb: float) -> int:
+    if chunk_mb <= 0:
+        raise ValueError(f"chunk_mb must be > 0, got {chunk_mb}")
+    return int(chunk_mb * (1 << 20))
+
+
+def chunk_leaf(data: bytes, budget: int) -> Tuple[List[str], List[int]]:
+    """Split a leaf's canonical bytes at fixed ``budget`` offsets.
+
+    Boundaries depend only on the leaf's byte length and the budget —
+    never on content — so an unchanged leaf reproduces the identical
+    (digests, lengths) across epochs and a changed leaf dirties only
+    the chunks whose bytes actually differ."""
+    digests, lengths = [], []
+    for off in range(0, max(len(data), 1), budget):
+        piece = data[off:off + budget]
+        digests.append(_digest(piece))
+        lengths.append(len(piece))
+    return digests, lengths
+
+
+def leaf_bytes(arr: np.ndarray) -> bytes:
+    """The leaf's canonical chunk-stream representation: C-order raw
+    bytes of the host array (dtype preserved — the manifest records it,
+    so assembly is a ``frombuffer`` + ``reshape``, no re-encode)."""
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def plan_order(arrays: Sequence[np.ndarray], chunk_mb: float) -> List[int]:
+    """The deterministic leaf traversal: ``bucket_plan``'s size-ordered
+    packing (largest-first, flat-index tie-break) flattened back to one
+    index sequence. Reusing the ZeRO bucket planner — rather than a
+    second ad-hoc sort — is what the chunk-boundary stability test pins:
+    the distribution plane and the communication plane order leaves by
+    the SAME rule, so neither can drift without the other noticing."""
+    return [i for bucket in bucket_plan(arrays, chunk_mb) for i in bucket]
+
+
+def build_manifest(
+    named: Sequence[Tuple[str, np.ndarray]],
+    *,
+    epoch: int,
+    best_acc: float,
+    chunk_mb: float,
+    world: Optional[Dict[str, int]] = None,
+    parallel_layout: Optional[Dict[str, Any]] = None,
+) -> Tuple[Dict[str, Any], List[Tuple[str, bytes]]]:
+    """Chunk every leaf; returns ``(manifest, chunk_stream)`` where
+    ``chunk_stream`` is ``[(digest, bytes), ...]`` in the deterministic
+    plan order (duplicates removed — identical leaves share chunks).
+
+    ``named`` is ``[(leaf_name, host_array), ...]`` in flat (leaf_names)
+    order; the manifest's ``leaves`` list keeps that order so assembly
+    mirrors the npz layout's ``leaf_i`` indexing."""
+    budget = chunk_budget_bytes(chunk_mb)
+    arrays = [np.asarray(v) for _, v in named]
+    records: List[Dict[str, Any]] = []
+    by_digest: Dict[str, bytes] = {}
+    per_leaf: List[List[str]] = []
+    for name, arr in zip((k for k, _ in named), arrays):
+        data = leaf_bytes(arr)
+        digests, lengths = chunk_leaf(data, budget)
+        per_leaf.append(digests)
+        for j, (dg, ln) in enumerate(zip(digests, lengths)):
+            if dg not in by_digest:
+                by_digest[dg] = data[j * budget:j * budget + ln]
+        records.append({
+            "name": name,
+            "shape": list(arr.shape),
+            "dtype": arr.dtype.name,
+            "chunks": digests,
+            "lengths": lengths,
+        })
+    manifest = {
+        "epoch": epoch + 1,
+        "best_acc": float(best_acc),
+        "leaf_names": [k for k, _ in named],
+        "format_version": MANIFEST_VERSION,
+        "chunk_mb": float(chunk_mb),
+        "leaves": records,
+    }
+    if world is not None:
+        manifest["world"] = dict(world)
+    if parallel_layout is not None:
+        manifest["parallel_layout"] = dict(parallel_layout)
+    # Chunk write order follows the plan: leaves largest-first, each
+    # leaf's chunks in offset order, each distinct digest once.
+    stream: List[Tuple[str, bytes]] = []
+    emitted = set()
+    for i in plan_order(arrays, chunk_mb):
+        for dg in per_leaf[i]:
+            if dg not in emitted:
+                emitted.add(dg)
+                stream.append((dg, by_digest[dg]))
+    return manifest, stream
+
+
+def write_manifest(manifest: Dict[str, Any], directory: str,
+                   epoch: int) -> str:
+    """Atomic manifest publish: tmp + rename, same as the npz writer.
+    Callers must have stored every referenced chunk FIRST — the rename
+    is the instant the epoch becomes visible to watchers."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"checkpoint_{epoch}{MANIFEST_SUFFIX}")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    """Parse a manifest; a torn/truncated one raises ``JSONDecodeError``
+    — content-level damage under ``is_corrupt_checkpoint_error``, so the
+    resume path quarantines it and the watcher permanent-skips it."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def manifest_digests(manifest: Dict[str, Any]) -> set:
+    return {dg for rec in manifest["leaves"] for dg in rec["chunks"]}
+
+
+def assemble_leaf(rec: Dict[str, Any], store: ChunkStore) -> np.ndarray:
+    """One leaf from its ordered chunk list; a missing chunk raises the
+    absence-level ValueError documented on ``ChunkStore.get``."""
+    data = b"".join(store.get(dg) for dg in rec["chunks"])
+    arr = np.frombuffer(data, dtype=np.dtype(rec["dtype"]))
+    return arr.reshape(rec["shape"])
+
+
+def load_manifest_arrays(
+    path: str, store: Optional[ChunkStore] = None,
+) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+    """Whole-file assembly of a manifest: ``(manifest, arrays)`` in
+    leaf_names order — the ``load_checkpoint`` branch, so resume and
+    serve boot read manifests through the exact same
+    restore-onto-template path as npz files."""
+    manifest = read_manifest(path)
+    if store is None:
+        store = ChunkStore(os.path.dirname(os.path.abspath(path)))
+    return manifest, [assemble_leaf(rec, store) for rec in manifest["leaves"]]

@@ -1,0 +1,222 @@
+"""Serve-side delta fetch: the ``CheckpointWatcher``'s manifest loader.
+
+``DeltaFetcher.load`` has the exact ``load_params_for_serving``
+signature — ``(path, template_state) -> (params, epoch)`` — and plugs
+into the watcher's ``loader=`` seam, so manifest discovery, the epoch
+ordering rule, the validate_fn layout gate, and the one atomic
+``swap_params`` install are all UNCHANGED machinery; only the
+bytes-acquisition step differs:
+
+- diff the manifest's chunk lists against the local store inventory
+  and the previous install's per-leaf hashes;
+- fetch ONLY missing chunks — peer backends first (``GET
+  /chunks/<hash>``, the gossip plane: a fleet publish costs the source
+  O(chunks), not O(replicas)), source directory as fallback — each
+  verified against its digest before entering the local store;
+- patch only the DIRTY leaves of the cached host tree and re-quantize
+  only those (clean leaves ride through as the previous install's
+  ``QuantLeaf``/cast leaves — PR 13's idempotent
+  ``ServePrecision.quantize`` passes them through untouched, which the
+  requantize pin test asserts by object identity);
+- serving fetches only ``params`` leaves: optimizer moments never ship
+  to the fleet (two thirds of an Adam checkpoint's bytes).
+
+Failure taxonomy: a torn manifest raises ``JSONDecodeError`` (content
+damage -> watcher permanent-skip, resume quarantine); a chunk missing
+from every peer AND the source raises a ValueError whose message says
+``missing chunk`` — absence for THIS publish, permanent-skip at the
+watcher until a newer manifest appears, exactly the ISSUE's
+torn-publish contract. The server keeps answering on its installed
+params throughout.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.distrib.cas import (
+    ChunkStore,
+    _digest,
+    is_manifest,
+    read_manifest,
+)
+
+PARAMS_PREFIX = "['params']"
+
+
+def fetch_chunk_http(base_url: str, digest: str,
+                     timeout_s: float = 5.0) -> bytes:
+    """One peer chunk GET; raises on any transport/HTTP failure (the
+    caller falls through to the next peer / the source dir)."""
+    url = f"{base_url.rstrip('/')}/chunks/{digest}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read()
+
+
+def _zeroed() -> Dict[str, int]:
+    return {"dirty_leaves": 0, "clean_leaves": 0, "chunks_fetched": 0,
+            "bytes_fetched": 0, "bytes_peer": 0, "bytes_source": 0,
+            "bytes_local": 0, "full_loads": 0, "delta_loads": 0}
+
+
+class DeltaFetcher:
+    """Stateful manifest loader for one watch directory.
+
+    ``directory`` is the watcher's checkpoint directory: manifests
+    arrive there (trainer publish on a shared fs, or a router
+    ``/rollout`` manifest copy) and fetched chunks are installed into
+    ``<directory>/chunks/`` — which is exactly what this backend's own
+    ``GET /chunks/<hash>`` endpoint serves, so every fetcher is also a
+    gossip seeder the moment its fetch completes.
+
+    ``precision`` (a ``ServePrecision``) opts into fetch-side
+    quantization: the returned tree carries the previous install's
+    quantized leaves for clean params and raw f32 for dirty ones, so
+    the engine's ``_place`` (idempotent quantize) re-quantizes only
+    what changed. Leave it None when multiple planes share the loader
+    (a shadow canary's f32 baseline must never receive pre-quantized
+    leaves); the delta fetch itself still applies.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        precision=None,
+        peers: Sequence[str] = (),
+        source_dir: Optional[str] = None,
+        workers: int = 4,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self.store = ChunkStore(directory)
+        self.peers = [p for p in peers if p]
+        self.source = ChunkStore(source_dir) if source_dir else None
+        self._precision = precision
+        self._workers = workers
+        self._timeout = timeout_s
+        # Per-leaf state from the previous successful manifest load:
+        # chunk-hash tuple (the diff key) and the installed leaf value
+        # (QuantLeaf / cast array / f32 array — whatever the precision
+        # hook produced), keyed by manifest leaf name.
+        self._hashes: Dict[str, tuple] = {}
+        self._values: Dict[str, object] = {}
+        self.total = _zeroed()
+        self.last = _zeroed()
+
+    # -- chunk acquisition --------------------------------------------------
+
+    def _obtain(self, digest: str, stats: Dict[str, int]) -> None:
+        """Ensure ``digest`` is in the local store: local hit, else peers
+        (rotation keyed by the digest spreads a fleet's pulls across
+        seeders), else the source directory. Verified-on-put, so corrupt
+        peer bytes read as a miss, not an install."""
+        if self.store.has(digest):
+            return
+        n = len(self.peers)
+        start = int(digest[:8], 16) % n if n else 0
+        for k in range(n):
+            peer = self.peers[(start + k) % n]
+            try:
+                data = fetch_chunk_http(peer, digest, self._timeout)
+                if _digest(data) != digest:
+                    raise ValueError("digest mismatch")
+                self.store.put(digest, data)
+                stats["chunks_fetched"] += 1
+                stats["bytes_fetched"] += len(data)
+                stats["bytes_peer"] += len(data)
+                return
+            except Exception:  # noqa: BLE001 - any peer failure: next
+                continue
+        if self.source is not None and self.source.has(digest):
+            data = self.source.get(digest)
+            self.store.put(digest, data)
+            stats["chunks_fetched"] += 1
+            stats["bytes_fetched"] += len(data)
+            stats["bytes_source"] += len(data)
+            return
+        raise ValueError(
+            f"missing chunk {digest}: not in the local store, "
+            f"{len(self.peers)} peer(s), or the source dir — skipping "
+            f"this publish until a newer manifest appears")
+
+    # -- the loader seam ----------------------------------------------------
+
+    def load(self, path: str, template_state) -> Tuple[object, int]:
+        """The ``CheckpointWatcher`` loader: delta path for manifests,
+        byte-identical fallback (and cache reset) for npz/``.ckpt``."""
+        if not is_manifest(path):
+            from pytorch_distributed_mnist_tpu.serve.engine import (
+                load_params_for_serving,
+            )
+
+            self._hashes, self._values = {}, {}
+            self.total["full_loads"] += 1
+            return load_params_for_serving(path, template_state)
+        manifest = read_manifest(path)  # torn -> JSONDecodeError
+        stats = _zeroed()
+        records = {rec["name"]: rec for rec in manifest["leaves"]}
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            template_state.params)
+        leaves, hashes = [], {}
+        for kpath, tmpl in flat:
+            name = PARAMS_PREFIX + jax.tree_util.keystr(kpath)
+            rec = records.get(name)
+            if rec is None:
+                raise ValueError(
+                    f"{path}: no leaf {name!r} in manifest — "
+                    f"model/checkpoint mismatch")
+            key = tuple(rec["chunks"])
+            hashes[name] = key
+            if self._hashes.get(name) == key and name in self._values:
+                leaves.append(self._values[name])
+                stats["clean_leaves"] += 1
+                continue
+            for dg in rec["chunks"]:
+                self._obtain(dg, stats)
+            from pytorch_distributed_mnist_tpu.distrib.cas import (
+                assemble_leaf,
+            )
+
+            arr = assemble_leaf(rec, self.store)
+            if tuple(arr.shape) != tuple(np.shape(tmpl)):
+                raise ValueError(
+                    f"{path}: leaf {name} shape {arr.shape} != expected "
+                    f"{tuple(np.shape(tmpl))}")
+            stats["bytes_local"] += arr.nbytes
+            if hasattr(tmpl, "dtype"):
+                arr = arr.astype(tmpl.dtype, copy=False)
+            leaves.append(arr)
+            stats["dirty_leaves"] += 1
+        params = jax.tree_util.tree_unflatten(treedef, leaves)
+        if self._precision is not None and not self._precision.identity:
+            # Quantize HERE so clean leaves keep their previous
+            # QuantLeaf objects (idempotent passthrough) and only dirty
+            # leaves pay the quantization — then cache per leaf for the
+            # next manifest's diff.
+            params = self._precision.quantize(params, workers=self._workers)
+        out_flat, _ = jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=_is_precision_leaf)
+        values = {PARAMS_PREFIX + jax.tree_util.keystr(p): v
+                  for p, v in out_flat}
+        self._hashes, self._values = hashes, values
+        stats["delta_loads"] = 1
+        self.last = stats
+        for k, v in stats.items():
+            self.total[k] += v
+        print(f"delta fetch: {path!r} {stats['dirty_leaves']} dirty / "
+              f"{stats['clean_leaves']} clean leaves, "
+              f"{stats['chunks_fetched']} chunks fetched "
+              f"({stats['bytes_peer']}B peer, {stats['bytes_source']}B "
+              f"source)", flush=True)
+        return params, int(manifest["epoch"]) - 1
+
+
+def _is_precision_leaf(x) -> bool:
+    from pytorch_distributed_mnist_tpu.serve.programs import QuantLeaf
+
+    return isinstance(x, QuantLeaf)

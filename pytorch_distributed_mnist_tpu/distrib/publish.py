@@ -1,0 +1,177 @@
+"""Trainer-side delta publish: chunks absent from the store + manifest.
+
+``publish_arrays`` is the core: given host arrays in flat leaf order it
+stores only the chunks the store does not already hold (adjacent
+training epochs share most bytes, so this is the O(changed bytes)
+step), then publishes the manifest tmp+rename — the one atomic instant
+— then prunes manifests by the SAME window rule npz/sharded layouts
+use (``prune_checkpoints``; the shared ``_epoch_checkpoints`` pattern
+now matches ``.manifest`` too) and extends that window to chunks:
+``gc_chunks`` deletes only chunks referenced by NO manifest still on
+disk. A chunk referenced by any manifest inside the keep-last window —
+including one a watcher is mid-fetch on — therefore survives exactly
+as long as the manifest does, the PR 3 ordering guarantee carried down
+one level.
+
+``publish_from_checkpoint`` converts an already-published npz or
+sharded ``.ckpt`` checkpoint into a manifest in place (or into another
+directory) — the router's ``/rollout`` path, so a fleet deploy ships a
+few-KB manifest instead of copying the whole file per backend.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_distributed_mnist_tpu.distrib.cas import (
+    ChunkStore,
+    MANIFEST_SUFFIX,
+    build_manifest,
+    manifest_digests,
+    read_manifest,
+    write_manifest,
+)
+
+
+def gc_chunks(directory: str) -> int:
+    """Delete chunks referenced by no manifest in ``directory``; returns
+    bytes freed. The referenced set is computed from every ``*.manifest``
+    still on disk — per-epoch manifests the prune window kept AND the
+    ``model_best`` copy — so the window rule protects chunks exactly as
+    long as it protects the manifest referencing them. Quarantined
+    manifests (``.corrupt`` suffix) are unreadable provenance, not live
+    references; their chunks are collectable once no live manifest
+    shares them."""
+    referenced: set = set()
+    for path in glob.glob(os.path.join(directory, f"*{MANIFEST_SUFFIX}")):
+        try:
+            referenced |= manifest_digests(read_manifest(path))
+        except Exception:  # noqa: BLE001 - a torn manifest pins nothing
+            continue
+    return ChunkStore(directory).gc(referenced)
+
+
+def publish_arrays(
+    named: Sequence[Tuple[str, np.ndarray]],
+    *,
+    epoch: int,
+    best_acc: float,
+    directory: str,
+    chunk_mb: float = 4.0,
+    is_best: bool = False,
+    keep_last: int = 0,
+    world: Optional[Dict[str, int]] = None,
+    parallel_layout: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Chunk + store + manifest publish; returns the manifest path.
+
+    Ordering is the atomicity argument: every referenced chunk is on
+    disk (write-once, tmp+rename each) BEFORE the manifest rename makes
+    the epoch visible, so a watcher that resolves the manifest can
+    assemble it; a crash between chunk writes and the rename leaves
+    only unreferenced chunks, collected by the next publish's GC."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        prune_checkpoints,
+    )
+
+    store = ChunkStore(directory)
+    manifest, stream = build_manifest(
+        named, epoch=epoch, best_acc=best_acc, chunk_mb=chunk_mb,
+        world=world, parallel_layout=parallel_layout)
+    written = 0
+    for digest, data in stream:
+        if store.put(digest, data):
+            written += len(data)
+    path = write_manifest(manifest, directory, epoch)
+    total = sum(rec_len for _, data in stream for rec_len in (len(data),))
+    print(f"delta publish: epoch {epoch} -> {path} "
+          f"({written}/{total} chunk bytes new)", flush=True)
+    if is_best:
+        best = os.path.join(directory, f"model_best{MANIFEST_SUFFIX}")
+        tmp = best + ".tmp"
+        import shutil
+
+        shutil.copyfile(path, tmp)
+        os.replace(tmp, best)
+    prune_checkpoints(directory, keep_last)
+    if keep_last > 0:
+        gc_chunks(directory)
+    return path
+
+
+def publish_state(
+    state,
+    *,
+    epoch: int,
+    best_acc: float,
+    directory: str,
+    chunk_mb: float = 4.0,
+    is_best: bool = False,
+    keep_last: int = 0,
+    process_index: Optional[int] = None,
+    parallel_layout: Optional[Dict[str, Any]] = None,
+) -> Optional[str]:
+    """Delta-publish a live train state (the ``--publish delta`` path).
+
+    Process-0-only, like the npz layout it replaces: every leaf must be
+    fully addressable or replicated from this process. A genuinely
+    cross-host-sharded state (multi-host TP/EP/ZeRO) has no single-host
+    byte stream to chunk — publish the sharded layout and convert with
+    ``publish_from_checkpoint`` instead; that mismatch aborts loudly
+    here rather than silently chunking one host's shard view."""
+    import jax
+
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        _leaves_with_names,
+        _npz_saveable,
+        _state_tree,
+        _world_stamp,
+    )
+
+    named = _leaves_with_names(_state_tree(state))
+    bad = [k for k, v in named if not _npz_saveable(v)]
+    if bad:
+        raise ValueError(
+            f"--publish delta requires fully-addressable (or replicated) "
+            f"leaves; {bad[:3]} span non-addressable devices — save the "
+            f"sharded layout and convert via publish_from_checkpoint")
+    pid = jax.process_index() if process_index is None else process_index
+    if pid != 0:
+        return None
+    host = [(k, np.asarray(v)) for k, v in named]
+    return publish_arrays(
+        host, epoch=epoch, best_acc=best_acc, directory=directory,
+        chunk_mb=chunk_mb, is_best=is_best, keep_last=keep_last,
+        world=_world_stamp(), parallel_layout=parallel_layout)
+
+
+def publish_from_checkpoint(
+    path: str,
+    directory: Optional[str] = None,
+    *,
+    chunk_mb: float = 4.0,
+    keep_last: int = 0,
+) -> str:
+    """Convert a published npz/``.ckpt`` checkpoint (or re-publish an
+    existing manifest) into a manifest in ``directory`` (default: the
+    checkpoint's own directory). Epoch, best_acc, world, and
+    parallel_layout carry over from the source meta, so the layout gate
+    and epoch ordering see the converted manifest exactly as they saw
+    the source file."""
+    from pytorch_distributed_mnist_tpu.train.checkpoint import (
+        read_checkpoint_arrays,
+    )
+
+    meta, arrays = read_checkpoint_arrays(path)
+    directory = directory or os.path.dirname(os.path.abspath(path))
+    return publish_arrays(
+        list(zip(meta["leaf_names"], arrays)),
+        epoch=int(meta["epoch"]) - 1,
+        best_acc=float(meta.get("best_acc", 0.0)),
+        directory=directory, chunk_mb=chunk_mb, keep_last=keep_last,
+        world=meta.get("world"),
+        parallel_layout=meta.get("parallel_layout"))

@@ -63,6 +63,7 @@ class CheckpointWatcher:
         serve_log=None,
         current_path: Optional[str] = None,
         validate_fn: Optional[Callable] = None,
+        loader: Optional[Callable] = None,
     ) -> None:
         self.directory = directory
         self.poll_interval_s = float(poll_interval_s)
@@ -77,6 +78,13 @@ class CheckpointWatcher:
         # mesh-committed (sharded) pool especially must never receive
         # params whose training layout contradicts its serve mode.
         self._validate = validate_fn
+        # The loader seam: ``loader(path, template) -> (params, epoch)``.
+        # Default is the whole-file ``load_params_for_serving``; the
+        # delta-distribution plane passes ``DeltaFetcher.load`` here so
+        # manifests are satisfied by fetching only missing chunks —
+        # resolution, the failure taxonomy below, and the install
+        # callback are identical either way.
+        self._loader = loader
         self._current = current_path
         # Last path that failed to load: retried only once the listing
         # moves past it, so one corrupt file can't hot-loop the log.
@@ -111,10 +119,11 @@ class CheckpointWatcher:
             load_params_for_serving,
         )
 
+        loader = self._loader or load_params_for_serving
         try:
             if self._validate is not None:
                 self._validate(path)  # ValueError routes to "permanent"
-            params, epoch = load_params_for_serving(path, self._template)
+            params, epoch = loader(path, self._template)
         except Exception as exc:  # noqa: BLE001 - serving must survive
             # Serving always survives a failed reload — but retry policy
             # follows the PR-2 damage taxonomy

@@ -6,9 +6,12 @@ serving surface down. ``tpu-mnist route --backends host:port,...`` puts
 a pure-stdlib routing tier above N backend serve processes and makes a
 BACKEND the failure domain, not the system:
 
-- **Discovery + health.** A static ``--backends`` list plus a
-  background ``/healthz`` poller running the pool-heal state machine
-  one level up (serve/pool.py, PR 10): ``--quarantine-after``
+- **Discovery + health.** A static ``--backends`` list and/or dynamic
+  ``--backends-dir`` discovery (serve processes started with
+  ``--register-dir`` drop/remove ``backend_*.json`` records; the sweep
+  reconciles joins on probation and reaps only what it discovered),
+  plus a background ``/healthz`` poller running the pool-heal state
+  machine one level up (serve/pool.py, PR 10): ``--quarantine-after``
   consecutive failures quarantine a backend (not routable, still
   probed), a successful probe re-admits it on PROBATION (routable, one
   strike re-quarantines), ``--probation-successes`` clean results make
@@ -989,15 +992,29 @@ class HealthPoller:
     backend list under the table lock (Fleet.backends), probe each one
     OUTSIDE any lock, then write results back through Fleet.note_*.
     The poller's own ``_lock`` guards only its sweep bookkeeping
-    (last-sweep clock + per-backend probe ages for /stats)."""
+    (last-sweep clock + per-backend probe ages for /stats).
+
+    ``backends_dir`` adds dynamic discovery: backends started with
+    ``--register-dir DIR`` drop a ``backend_*.json`` record there
+    (tmp+rename; removed on drain and on shutdown), and every sweep
+    reconciles first — a new record joins the fleet on PROBATION (a
+    discovered process earns HEALTHY exactly like a spawned or healed
+    one), a vanished record removes the backend IF this poller
+    discovered it (static ``--backends`` members and scaler-spawned
+    processes are never reaped by discovery)."""
 
     def __init__(self, fleet: Fleet, interval_s: float = 0.5,
                  connect_timeout: float = 0.5,
-                 read_timeout: float = 2.0) -> None:
+                 read_timeout: float = 2.0,
+                 backends_dir: Optional[str] = None) -> None:
         self.fleet = fleet
         self.interval_s = float(interval_s)
         self.connect_timeout = float(connect_timeout)
         self.read_timeout = float(read_timeout)
+        self.backends_dir = backends_dir
+        # Backend names THIS poller added from records — the only ones
+        # a vanished record may remove.
+        self._discovered: Set[str] = set()
         self._lock = threading.Lock()
         self._last_sweep_t: Optional[float] = None
         self._probes: Dict[str, float] = {}
@@ -1005,9 +1022,52 @@ class HealthPoller:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def sync_backends_dir(self) -> None:
+        """Reconcile the fleet against the registration records. All
+        file IO outside any lock; Fleet.add/remove take the table lock
+        briefly per mutation, the sweep-then-dispatch rule intact."""
+        if not self.backends_dir:
+            return
+        urls: List[str] = []
+        try:
+            entries = sorted(os.listdir(self.backends_dir))
+        except OSError:
+            entries = []
+        for entry in entries:
+            if not (entry.startswith("backend_")
+                    and entry.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.backends_dir, entry)) as f:
+                    url = json.load(f).get("url")
+            except Exception:  # noqa: BLE001 - torn record: next sweep
+                continue
+            if url:
+                urls.append(url)
+        present: Set[str] = set()
+        for url in urls:
+            try:
+                parsed = urllib.parse.urlsplit(
+                    url if "//" in url else f"http://{url}")
+                name = f"{parsed.hostname}:{parsed.port}"
+            except ValueError:
+                continue
+            present.add(name)
+            if name in self._discovered or self.fleet.get(name) is not None:
+                continue
+            self.fleet.add(url)
+            self._discovered.add(name)
+            self.fleet.admit_probation(name)
+        for name in sorted(self._discovered - present):
+            self._discovered.discard(name)
+            self.fleet.remove(name)
+
     def sweep_once(self) -> None:
         """One full probe pass — public and thread-free so tests drive
-        re-admission deterministically."""
+        re-admission deterministically. Discovery reconciles FIRST, so
+        a just-registered backend is probed in the same sweep that
+        admits it."""
+        self.sync_backends_dir()
         for backend in self.fleet.backends():
             name, url = backend.name, backend.url
             try:
@@ -1055,13 +1115,15 @@ class HealthPoller:
 
 
 def epoch_of_checkpoint(path: str) -> int:
-    """Epoch from a publish filename (``checkpoint_{e}.npz``/``.ckpt``
-    — train/checkpoint.py's naming contract)."""
+    """Epoch from a publish filename (``checkpoint_{e}.npz``/``.ckpt``/
+    ``.manifest`` — train/checkpoint.py's naming contract; a delta
+    manifest rides the same pattern, so rollouts ship manifests with no
+    router-side special case)."""
     match = _EPOCH_RE.search(os.path.basename(path))
     if not match:
         raise ValueError(
             f"cannot parse an epoch from {path!r}; publishes are named "
-            f"checkpoint_EPOCH.npz/.ckpt (train/checkpoint.py)")
+            f"checkpoint_EPOCH.npz/.ckpt/.manifest (train/checkpoint.py)")
     return int(match.group(1))
 
 
@@ -1110,9 +1172,19 @@ def republish_with_epoch(source: str, dest: str, epoch: int) -> None:
     backend refuses the "older" params and keeps serving the bad ones.
     An npz is a zip of npy members; only ``__meta__.npy`` changes, every
     array member is copied byte-for-byte. Sharded ``.ckpt`` directories
-    get the same edit on ``meta.json``. Write-then-replace, atomic
-    either way."""
+    get the same edit on ``meta.json``; a delta ``.manifest`` is plain
+    JSON — same edit, chunk references untouched (the fetchers pull the
+    SAME bytes, only the swap-ordering epoch moves). Write-then-replace,
+    atomic either way."""
     tmp = dest + ".tmp"
+    if source.endswith(".manifest"):
+        with open(source) as f:
+            meta = json.load(f)
+        meta["epoch"] = epoch + 1
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, dest)
+        return
     if os.path.isdir(source):
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
@@ -1894,6 +1966,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated host:port list of backend "
                         "serve processes (the static fleet; the health "
                         "poller owns their state from here on)")
+    p.add_argument("--backends-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="dynamic discovery: watch DIR for backend_*.json "
+                        "records written by serve processes started with "
+                        "--register-dir DIR; new records join the fleet "
+                        "on probation, vanished records leave (static "
+                        "--backends members are never reaped). Composes "
+                        "with --backends")
     p.add_argument("--host", type=str, default="127.0.0.1")
     p.add_argument("--port", type=int, default=8100,
                    help="router port (0 = ephemeral). Default 8100")
@@ -1963,10 +2043,13 @@ def create_router(args) -> ThreadingHTTPServer:
     boot on port 0 in-process). ``server.ctx.close()`` tears it down."""
     backends = [tok.strip() for tok in (args.backends or "").split(",")
                 if tok.strip()]
-    if not backends and not (args.fleet_min and args.spawn_backend):
+    backends_dir = getattr(args, "backends_dir", None)
+    if not backends and not backends_dir \
+            and not (args.fleet_min and args.spawn_backend):
         raise SystemExit(
-            "--backends host:port,... is required (or --fleet-min N "
-            "with --spawn-backend to boot an all-spawned fleet)")
+            "--backends host:port,... is required (or --backends-dir "
+            "DIR for dynamic discovery, or --fleet-min N with "
+            "--spawn-backend to boot an all-spawned fleet)")
     sink = None
     if getattr(args, "metrics_file", None):
         from pytorch_distributed_mnist_tpu.utils.profiling import JsonlSink
@@ -1986,7 +2069,8 @@ def create_router(args) -> ThreadingHTTPServer:
         fleet.add(url)
     poller = HealthPoller(fleet, interval_s=args.health_interval,
                           connect_timeout=args.connect_timeout,
-                          read_timeout=max(2.0, args.connect_timeout))
+                          read_timeout=max(2.0, args.connect_timeout),
+                          backends_dir=backends_dir)
     scaler = None
     if args.fleet_min:
         if not args.fleet_max:

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -316,6 +317,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-reload", action="store_true",
                    help="serve the boot-time checkpoint forever (no "
                         "directory watching)")
+    p.add_argument("--chunk-peers", type=str, default=None, metavar="URLS",
+                   help="comma-separated peer backend base URLs "
+                        "(http://host:port) to gossip checkpoint chunks "
+                        "from: a delta-published manifest's missing "
+                        "chunks are pulled from peers' GET /chunks/<hash> "
+                        "before the --chunk-source fallback, so a fleet "
+                        "publish costs the source O(chunks), not "
+                        "O(replicas)")
+    p.add_argument("--chunk-source", type=str, default=None, metavar="DIR",
+                   help="source chunk-store directory (the trainer's "
+                        "--checkpoint-dir) to fall back to when no peer "
+                        "holds a chunk; defaults to the watch directory "
+                        "itself, which a shared filesystem already covers")
+    p.add_argument("--register-dir", type=str, default=None, metavar="DIR",
+                   help="fleet registration directory: write a backend "
+                        "record (tmp+rename JSON naming this server's "
+                        "URL) on boot, remove it while draining and on "
+                        "shutdown — a router's --backends-dir polls it "
+                        "for dynamic join/leave without a restart")
     p.add_argument("--require-checkpoint", action="store_true",
                    help="refuse to start without a published checkpoint "
                         "(default: warn and serve fresh-init params, "
@@ -437,6 +457,13 @@ class ServeContext:
         self.draining = False
         self._drain_lock = threading.Lock()
         self._active_predicts = 0
+        # Fleet registration (--register-dir): the record announcing
+        # this backend to a router's --backends-dir poller. Written on
+        # boot, removed while draining (a draining backend must leave
+        # the discovered set BEFORE the next health sweep routes to
+        # it), re-written on undrain, removed on close.
+        self._register_path: Optional[str] = None
+        self._register_url: Optional[str] = None
         default = planes[default_model]
         # Single-model aliases (the historical surface).
         self.model_name = default.model_name
@@ -493,17 +520,60 @@ class ServeContext:
         admin call cannot wedge the state."""
         with self._drain_lock:
             prev, self.draining = self.draining, bool(draining)
+        if prev != draining and self._register_path is not None:
+            # Registration follows the drain gate (file IO outside the
+            # lock): a drained backend un-registers so a dynamic router
+            # drops it at the next sweep; undrain re-announces it.
+            if draining:
+                _remove_register_record(self._register_path)
+            else:
+                _write_register_record(self._register_path,
+                                       self._register_url)
         return prev
+
+    def chunk_dirs(self) -> list:
+        """Every plane's checkpoint directory — where the local chunk
+        stores live; the ``GET /chunks/<hash>`` route searches them in
+        plane order (digests are content-addressed, so a hit in any
+        store is THE chunk)."""
+        return [p.checkpoint_dir for p in self.planes.values()
+                if p.checkpoint_dir]
+
+    def enable_registration(self, register_dir: str, url: str) -> None:
+        os.makedirs(register_dir, exist_ok=True)
+        safe = url.split("//", 1)[-1].replace(":", "_").replace("/", "_")
+        self._register_path = os.path.join(
+            register_dir, f"backend_{safe}.json")
+        self._register_url = url
+        _write_register_record(self._register_path, url)
+        print(f"registered backend {url} in {register_dir}", flush=True)
 
     def write_all_stats(self, **extra) -> None:
         for plane in self.planes.values():
             plane.serve_log.write_stats(**extra)
 
     def close(self) -> None:
+        if self._register_path is not None:
+            _remove_register_record(self._register_path)
+            self._register_path = None
         for plane in self.planes.values():
             plane.close()
         if self.sink is not None:
             self.write_all_stats(final=True)
+
+
+def _write_register_record(path: str, url: Optional[str]) -> None:
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"url": url}, f)
+    os.replace(tmp, path)
+
+
+def _remove_register_record(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass  # already gone (double drain, shutdown after drain)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -663,8 +733,43 @@ class _Handler(BaseHTTPRequestHandler):
             stats["draining"] = ctx.draining
             stats["active_requests"] = ctx.active_requests()
             self._reply(200, stats)
+        elif self.path.startswith("/chunks/"):
+            self._do_chunk(self.path[len("/chunks/"):])
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
+
+    def _do_chunk(self, digest: str) -> None:
+        """``GET /chunks/<sha256>`` — the gossip plane: serve one chunk
+        from this backend's local store(s) so peers fetch a publish's
+        bytes from each other instead of all hammering the source.
+        Content-addressed, so the reply needs no freshness logic: a hex
+        digest either resolves to its immutable bytes or 404s. NOT
+        gated by drain: a draining backend stops taking predict traffic
+        but keeps seeding chunks — a rolling reload is exactly when
+        peers need them."""
+        import re as _re
+
+        if not _re.fullmatch(r"[0-9a-f]{64}", digest):
+            self._reply(404, {"error": "malformed chunk digest"})
+            return
+        ctx = self.ctx
+        for directory in ctx.chunk_dirs():
+            path = os.path.join(directory, "chunks", digest)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError:
+                pass  # client went away mid-transfer
+            return
+        self._reply(404, {"error": f"no chunk {digest}"})
 
     def do_POST(self) -> None:  # noqa: N802 - stdlib name
         if self.path == "/resize":
@@ -1331,11 +1436,37 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
             check_checkpoint_layout(
                 checkpoint_parallel_layout(path), serve_mode, model_name)
 
+        # The delta-distribution loader: manifests are satisfied by
+        # fetching only missing chunks (peers first, source dir
+        # fallback) and patching/re-quantizing only dirty leaves; npz
+        # and .ckpt paths fall through to the byte-identical whole-file
+        # load, so directories that never see a manifest behave exactly
+        # as before. Fetch-side quantization only when ONE plane owns
+        # the loader's output — a canary's f32 baseline must never
+        # receive pre-quantized leaves.
+        from pytorch_distributed_mnist_tpu.distrib.fetch import DeltaFetcher
+        from pytorch_distributed_mnist_tpu.serve.programs import (
+            get_precision,
+        )
+
+        peers = [u.strip() for u in
+                 (getattr(args, "chunk_peers", None) or "").split(",")
+                 if u.strip()]
+        fetcher = DeltaFetcher(
+            checkpoint_dir,
+            precision=(get_precision(serve_precision)
+                       if canary is None else None),
+            peers=peers,
+            source_dir=getattr(args, "chunk_source", None),
+            workers=getattr(args, "workers", 4),
+        )
         watcher = CheckpointWatcher(
             checkpoint_dir, template, engine.swap_params,
             poll_interval_s=args.poll_interval, serve_log=serve_log,
             current_path=boot_path, validate_fn=_validate_reload,
+            loader=fetcher.load,
         ).start()
+        watcher.fetcher = fetcher  # observability: chaos/bench read stats
 
     autoscaler = None
     if getattr(args, "autoscale", False):
@@ -1555,6 +1686,16 @@ def create_server(args) -> ThreadingHTTPServer:
         serve_precision=getattr(args, "serve_precision", "f32") or "f32",
         quotas=quotas, fair_gate=fair_gate,
         fused=not getattr(args, "no_fuse", False))
+    register_dir = getattr(args, "register_dir", None)
+    if register_dir:
+        # Announce AFTER the socket is bound (the real port is known —
+        # port 0 boots included) and the planes are warm: a router that
+        # discovers this record can route to it immediately.
+        port = httpd.server_address[1]
+        adv_host = args.host if args.host not in ("", "0.0.0.0", "::") \
+            else "127.0.0.1"
+        httpd.ctx.enable_registration(
+            register_dir, f"http://{adv_host}:{port}")
     return httpd
 
 

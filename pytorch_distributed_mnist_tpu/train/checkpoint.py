@@ -119,6 +119,8 @@ def save_checkpoint(
     layout: Optional[str] = None,
     keep_last: int = 0,
     parallel_layout: Optional[Dict[str, Any]] = None,
+    publish: Optional[str] = None,
+    chunk_mb: float = 4.0,
 ) -> Optional[str]:
     """Write ``checkpoint_{epoch}.npz`` (+ best copy); returns the path.
 
@@ -140,6 +142,31 @@ def save_checkpoint(
     """
     if layout not in (None, "npz", "sharded"):
         raise ValueError(f"unknown checkpoint layout {layout!r}")
+    if publish not in (None, "full", "delta"):
+        raise ValueError(f"unknown publish mode {publish!r}")
+    if publish == "delta":
+        # Content-addressed delta publish (``--publish delta``): chunks
+        # absent from the store + an atomic manifest INSTEAD of the npz
+        # file. Resume, watcher resolution, and pruning all already
+        # treat the manifest as a first-class checkpoint via the shared
+        # ``_epoch_checkpoints`` pattern. An explicit sharded-layout
+        # request is contradictory (the manifest replaces the npz
+        # layout) and cross-host sharded states are rejected loudly
+        # inside ``publish_state`` — both route the caller to: save the
+        # sharded layout, then convert with ``publish_from_checkpoint``.
+        if layout == "sharded":
+            raise ValueError(
+                "--publish delta replaces the npz layout and cannot "
+                "write layout='sharded'; save the sharded layout and "
+                "convert via publish_from_checkpoint")
+        from pytorch_distributed_mnist_tpu.distrib.publish import (
+            publish_state,
+        )
+
+        return publish_state(
+            state, epoch=epoch, best_acc=best_acc, directory=directory,
+            chunk_mb=chunk_mb, is_best=is_best, keep_last=keep_last,
+            process_index=process_index, parallel_layout=parallel_layout)
     pid = jax.process_index() if process_index is None else process_index
     named = _leaves_with_names(_state_tree(state))
     if layout == "sharded" or (
@@ -484,6 +511,19 @@ def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
     incomplete filesystem view it is, naming how many index files the
     saving world wrote versus how many are visible here.
     """
+    meta, globals_np = _stitch_sharded(path)
+    new_state = _restore_onto_template(
+        path, meta["leaf_names"], globals_np, state
+    )
+    return new_state, int(meta["epoch"]), float(meta["best_acc"])
+
+
+def _stitch_sharded(path: str) -> Tuple[Dict[str, Any], list]:
+    """The sharded layout's host-side stitch: ``(meta, global arrays)``
+    assembled from the per-process shard index — shared by the restore
+    path and by ``read_checkpoint_arrays`` (the delta publish converter
+    reads a ``.ckpt`` dir through this, so a multi-host sharded save
+    can be republished as a manifest without a template state)."""
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     n_leaves = len(meta["leaf_names"])
@@ -525,11 +565,7 @@ def _load_sharded(path: str, state) -> Tuple[Any, int, float]:
                 f"{path}: leaf {meta['leaf_names'][i]} is missing shards "
                 f"({total}/{arr.size} elements present){world}"
             )
-
-    new_state = _restore_onto_template(
-        path, meta["leaf_names"], globals_np, state
-    )
-    return new_state, int(meta["epoch"]), float(meta["best_acc"])
+    return meta, globals_np
 
 
 def _restore_onto_template(path, leaf_names, arrays, state):
@@ -579,15 +615,47 @@ def load_checkpoint(path: str, state) -> Tuple[Any, int, float]:
     exactly as at save time — the ``load_state_dict`` contract, ``:209-210``).
     Each saved leaf is ``device_put`` with the template leaf's sharding:
     restore-time resharding across mesh shapes. Directory paths are the
-    sharded layout; files are the npz layout.
+    sharded layout; ``.manifest`` files are the content-addressed delta
+    layout (assembled from the adjacent chunk store — so resume and
+    serve boot read a delta-published run with no extra code path);
+    other files are the npz layout.
     """
     if os.path.isdir(path):
         return _load_sharded(path, state)
+    if path.endswith(".manifest"):
+        from pytorch_distributed_mnist_tpu.distrib.cas import (
+            load_manifest_arrays,
+        )
+
+        manifest, arrays = load_manifest_arrays(path)
+        new_state = _restore_onto_template(
+            path, manifest["leaf_names"], arrays, state)
+        return new_state, int(manifest["epoch"]), float(manifest["best_acc"])
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         saved = [z[f"leaf_{i}"] for i in range(len(meta["leaf_names"]))]
     new_state = _restore_onto_template(path, meta["leaf_names"], saved, state)
     return new_state, int(meta["epoch"]), float(meta["best_acc"])
+
+
+def read_checkpoint_arrays(path: str) -> Tuple[Dict[str, Any], list]:
+    """``(meta, host arrays in leaf_names order)`` for ANY layout — npz
+    file, sharded ``.ckpt`` dir (stitched), or manifest (assembled) —
+    with no template state: the byte-level read the delta publish
+    converter (``distrib/publish.py::publish_from_checkpoint``) and the
+    round-trip tests build on."""
+    if os.path.isdir(path):
+        return _stitch_sharded(path)
+    if path.endswith(".manifest"):
+        from pytorch_distributed_mnist_tpu.distrib.cas import (
+            load_manifest_arrays,
+        )
+
+        return load_manifest_arrays(path)
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        return meta, [z[f"leaf_{i}"]
+                      for i in range(len(meta["leaf_names"]))]
 
 
 def _read_meta(path: str) -> Dict[str, Any]:
@@ -597,6 +665,11 @@ def _read_meta(path: str) -> Dict[str, Any]:
     container change lands once."""
     if os.path.isdir(path):
         with open(os.path.join(path, "meta.json")) as f:
+            return json.load(f)
+    if path.endswith(".manifest"):
+        # The manifest IS meta (plus chunk refs): same epoch/world/
+        # parallel_layout keys, so every inspection gate reads it as-is.
+        with open(path) as f:
             return json.load(f)
     with np.load(path) as z:
         return json.loads(bytes(z["__meta__"]).decode())
@@ -690,8 +763,10 @@ def _epoch_checkpoints(directory: str) -> list:
     """All published per-epoch checkpoints in ``directory`` as sorted
     ``(epoch, path)`` pairs. The single source of the eligibility rule for
     both resume selection and pruning (so they can never disagree about
-    what counts as a checkpoint). Both layouts match (``.npz`` file,
-    ``.ckpt`` dir); the atomic writers' in-flight ``.tmp`` names never do,
+    what counts as a checkpoint). All three layouts match (``.npz`` file,
+    ``.ckpt`` dir, ``.manifest`` delta publish — so manifests ride the
+    same resolution, watcher polling, and prune window with no second
+    rule); the atomic writers' in-flight ``.tmp`` names never do,
     so a crash mid-save can only ever expose the last *published* file —
     the restart-from-checkpoint recovery model SURVEY.md section 5
     prescribes."""
@@ -699,7 +774,7 @@ def _epoch_checkpoints(directory: str) -> list:
         return []
     out = []
     for name in os.listdir(directory):
-        m = re.fullmatch(r"checkpoint_(\d+)\.(npz|ckpt)", name)
+        m = re.fullmatch(r"checkpoint_(\d+)\.(npz|ckpt|manifest)", name)
         if m:
             out.append((int(m.group(1)), os.path.join(directory, name)))
     return sorted(out)
@@ -798,7 +873,21 @@ class AsyncCheckpointer:
         layout = kwargs.pop("layout", None)
         if layout not in (None, "npz", "sharded"):
             raise ValueError(f"unknown checkpoint layout {layout!r}")
-        if layout == "sharded" or (
+        if kwargs.get("publish") == "delta":
+            # The async delta path rides the npz machinery below: a
+            # pid-0 host snapshot inline, chunking + manifest write on
+            # the writer thread (``save_checkpoint`` routes on the
+            # ``publish`` kwarg it keeps in ``kwargs``). Sharded states
+            # must fail HERE — silently falling through to the sharded
+            # layout would drop the requested delta publish.
+            if layout == "sharded" or not all(
+                _npz_saveable(v) for _, v in named
+            ):
+                raise ValueError(
+                    "--publish delta requires fully-addressable (or "
+                    "replicated) leaves; save the sharded layout and "
+                    "convert via publish_from_checkpoint")
+        elif layout == "sharded" or (
             layout is None and not all(_npz_saveable(v) for _, v in named)
         ):
             self._save_sharded_async(named, kwargs)

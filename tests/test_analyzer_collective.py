@@ -659,3 +659,35 @@ def maybe_dcn_agree(ok, n_slices):
     return allgather_records("dcn_shard_allreduce", ok)
 """
     assert _findings(src) == []
+
+
+# -- ISSUE 18: manifest publish agreement ------------------------------------
+
+
+def test_fires_on_manifest_agreement_under_process_index():
+    """FIRING twin: confirming a delta publish with a collective only
+    on the writing host — every other host blocks in the agreement
+    process 0 never enters (or vice versa). The structural-hang class
+    the delta publish must not reintroduce."""
+    src = """
+def publish_manifest(manifest, epoch, ok):
+    if process_index() == 0:
+        write_manifest(manifest, epoch)
+        return allgather_records("manifest_published", ok)
+"""
+    (f,) = _findings(src)
+    assert "host-dependent" in f.message
+
+
+def test_silent_on_rank0_manifest_write_with_symmetric_agreement():
+    """NON-FIRING twin: the sanctioned shape (publish_state's gate) —
+    process 0 alone does the local file work, then EVERY host runs the
+    same agreement on the outcome. The branch guards pure IO; the
+    collective is unconditional."""
+    src = """
+def publish_manifest(manifest, epoch, ok):
+    if process_index() == 0:
+        write_manifest(manifest, epoch)
+    return allgather_records("manifest_published", ok)
+"""
+    assert _findings(src) == []

@@ -1066,3 +1066,62 @@ def test_router_module_clean_and_in_lock_graph():
         "Fleet._lock", "FleetCanary._lock", "HealthPoller._lock",
         "RouterContext._rollout_lock", "RouterLog._lock"]
     assert router["order_edges"] == []
+
+
+# -- ISSUE 18: the delta distribution plane (distrib/) -----------------------
+
+
+def test_fires_on_chunk_store_io_under_watcher_lock():
+    """FIRING twin: pulling chunk bytes (file IO — and over gossip it
+    is a network round-trip) inside the watcher's poll lock would stall
+    every concurrent poller for a whole fetch; the checker must flag
+    the IO under the lock."""
+    src = """
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def install(self, manifest):
+        with self._lock:
+            for digest in manifest.chunks:
+                with open(self.store.path(digest), "rb") as f:
+                    self.buf[digest] = f.read()
+"""
+    (f,) = _findings(src)
+    assert "file IO" in f.message and "Watcher._lock" in f.message
+
+
+def test_silent_on_fetch_hash_assemble_then_install_under_lock():
+    """NON-FIRING twin: the shipped shape (DeltaFetcher.load feeding the
+    engine's swap) — chunk fetch, digest verification, and leaf assembly
+    all run lock-free; only the one reference swap takes the lock."""
+    src = """
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def install(self, path):
+        params, epoch = self.fetcher.load(path, self.template)
+        with self._lock:
+            self._params, self._epoch = params, epoch
+"""
+    assert _findings(src) == []
+
+
+def test_distrib_package_clean_and_lock_free():
+    """ISSUE 18 acceptance: the delta plane (cas/publish/fetch) does
+    every hash, chunk write, and peer fetch WITHOUT holding any lock —
+    serialization lives in the watcher's poll lock and the engine's
+    params lock, both outside this package. Clean under every behavior
+    checker, and the lock graph has no distrib node at all."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "distrib")],
+        checkers=["lock-discipline", "trace-purity", "collective-symmetry",
+                  "agreement-except-breadth", "recompile-hazard"],
+        baseline=None)
+    assert result.findings == []
+    assert result.reports["lock-discipline"]["lock_graph"] == {}

@@ -1,0 +1,542 @@
+"""Delta weight distribution (ISSUE 18): content-addressed chunk
+store, manifest publish, GC window, serve-side delta fetch, and the
+router/watcher seams — all in-process.
+
+The contracts pinned here:
+- a manifest round-trips BITWISE against the whole-file layouts, for
+  npz and for sharded directories converted across world sizes;
+- chunk boundaries are deterministic: adjacent publishes share every
+  unchanged leaf's chunks, and a one-leaf change dirties exactly that
+  leaf's chunk list;
+- the GC window is the prune window: chunks live exactly as long as a
+  manifest on disk references them;
+- the DeltaFetcher re-quantizes ONLY dirtied leaves (clean leaves keep
+  the previous install's QuantLeaf by OBJECT IDENTITY);
+- gossip pulls peers-before-source, falling back per chunk;
+- the CheckpointWatcher's failure taxonomy extends to delta damage: a
+  torn manifest and a missing chunk are both permanent-for-that-publish
+  skips, and the next clean publish recovers with no restart.
+
+The loopback-HTTP integration (the /chunks endpoint, --register-dir /
+--backends-dir discovery, manifest /rollout) lives in
+tests/test_serve_delta_fleet.py; the process-boundary twins in
+tools/chaos.py --torn-manifest and --fleet --delta-publish.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_mnist_tpu.distrib import cas as cas_mod
+from pytorch_distributed_mnist_tpu.distrib import fetch as fetch_mod
+from pytorch_distributed_mnist_tpu.distrib.cas import (
+    ChunkStore,
+    build_manifest,
+    chunk_leaf,
+    read_manifest,
+)
+from pytorch_distributed_mnist_tpu.distrib.fetch import DeltaFetcher
+from pytorch_distributed_mnist_tpu.distrib.publish import (
+    gc_chunks,
+    publish_arrays,
+    publish_from_checkpoint,
+    publish_state,
+)
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.programs import (
+    QuantLeaf,
+    get_precision,
+)
+from pytorch_distributed_mnist_tpu.serve.reload import CheckpointWatcher
+from pytorch_distributed_mnist_tpu.train import checkpoint as ck
+from pytorch_distributed_mnist_tpu.train.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+
+pytestmark = pytest.mark.distrib
+
+
+def _fresh(seed: int = 0):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    return create_train_state(model, jax.random.key(seed))
+
+
+def _gathered(state):
+    return [np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(ck._state_tree(state))]
+
+
+def _perturbed(state, delta: float):
+    """The SMALLEST params leaf shifted — adjacent-epoch steady state."""
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    small = min(range(len(leaves)), key=lambda j: leaves[j].size)
+    leaves = list(leaves)
+    leaves[small] = leaves[small] + delta
+    return state.replace(
+        params=jax.tree_util.tree_unflatten(treedef, leaves))
+
+
+# ---------------------------------------------------------------------------
+# The chunk store.
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_store_write_once_and_verified(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    data = b"chunk bytes"
+    digest = cas_mod._digest(data)
+    assert store.put(digest, data) is True
+    assert store.has(digest) and store.get(digest) == data
+    # Write-once: a second put of the same content is a no-op.
+    assert store.put(digest, data) is False
+    # Verified-on-put: corrupt bytes under a wrong name never land
+    # (fresh digest — an already-present one short-circuits write-once).
+    with pytest.raises(ValueError):
+        store.put(cas_mod._digest(b"expected"), b"other bytes")
+    assert not store.has(cas_mod._digest(b"expected"))
+    with pytest.raises(ValueError, match="missing chunk"):
+        store.get("0" * 64)
+
+
+def test_chunk_leaf_fixed_boundaries():
+    data = bytes(range(256)) * 40  # 10240 B
+    digests, lengths = chunk_leaf(data, 4096)
+    assert lengths == [4096, 4096, 2048]
+    assert b"".join([data[0:4096], data[4096:8192],
+                     data[8192:]]) == data
+    # Empty/scalar leaves still get exactly one chunk (a manifest leaf
+    # with zero chunks would be unreconstructable).
+    digests0, lengths0 = chunk_leaf(b"", 4096)
+    assert len(digests0) == 1 and lengths0 == [0]
+
+
+# ---------------------------------------------------------------------------
+# Manifest round-trips: bitwise vs the whole-file layouts.
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_round_trip_bitwise_vs_npz(tmp_path):
+    state = _fresh(seed=0)
+    npz = save_checkpoint(state, epoch=3, best_acc=0.25, is_best=False,
+                          directory=str(tmp_path), process_index=0)
+    manifest = publish_from_checkpoint(npz)
+    assert manifest.endswith("checkpoint_3.manifest")
+    via_npz = load_checkpoint(npz, _fresh(seed=1))
+    via_manifest = load_checkpoint(manifest, _fresh(seed=2))
+    assert via_manifest[1:] == via_npz[1:]  # (start_epoch, best_acc)
+    for a, b in zip(_gathered(via_npz[0]), _gathered(via_manifest[0])):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("w_save,w_load", [(8, 4), (4, 8)])
+def test_manifest_round_trip_sharded_cross_world(tmp_path, w_save, w_load):
+    """A sharded .ckpt directory saved at world W converts to a manifest
+    that loads at world W' bitwise — the delta plane composes with the
+    elastic reshard contract instead of replacing it."""
+    mesh = Mesh(np.array(jax.devices()[:w_save]), ("data",))
+    state = jax.device_put(_fresh(seed=0), NamedSharding(mesh, P()))
+    ckpt = save_checkpoint(state, epoch=2, best_acc=0.5, is_best=False,
+                           directory=str(tmp_path), layout="sharded")
+    manifest = publish_from_checkpoint(ckpt, str(tmp_path / "out"))
+    load_mesh = Mesh(np.array(jax.devices()[:w_load]), ("data",))
+    template = jax.device_put(_fresh(seed=1),
+                              NamedSharding(load_mesh, P()))
+    loaded, start_epoch, best_acc = load_checkpoint(manifest, template)
+    assert start_epoch == 3 and best_acc == 0.5
+    for want, got in zip(_gathered(state), _gathered(loaded)):
+        np.testing.assert_array_equal(want, got)
+
+
+def test_manifest_rides_resolution_and_meta_gates(tmp_path):
+    """latest_checkpoint resolves manifests by the shared epoch pattern
+    (npz wins a same-epoch tie), and the meta readers see manifest
+    provenance exactly as they see npz provenance."""
+    state = _fresh()
+    publish_state(state, epoch=4, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert latest_checkpoint(str(tmp_path)).endswith(
+        "checkpoint_4.manifest")
+    save_checkpoint(state, epoch=4, best_acc=0.5, is_best=False,
+                    directory=str(tmp_path), process_index=0)
+    assert latest_checkpoint(str(tmp_path)).endswith("checkpoint_4.npz")
+    publish_state(state, epoch=5, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    path = latest_checkpoint(str(tmp_path))
+    assert path.endswith("checkpoint_5.manifest")
+    assert ck.checkpoint_world(path) == {
+        "processes": jax.process_count(),
+        "devices": jax.device_count()}
+
+
+# ---------------------------------------------------------------------------
+# Chunk-boundary stability + the GC window.
+# ---------------------------------------------------------------------------
+
+
+def test_adjacent_publishes_share_unchanged_chunks(tmp_path):
+    state = _fresh()
+    store = ChunkStore(str(tmp_path))
+    publish_state(state, epoch=1, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    before = store.digests()
+    publish_state(_perturbed(state, 1e-3), epoch=2, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    m1 = read_manifest(str(tmp_path / "checkpoint_1.manifest"))
+    m2 = read_manifest(str(tmp_path / "checkpoint_2.manifest"))
+    rec1 = {r["name"]: r["chunks"] for r in m1["leaves"]}
+    rec2 = {r["name"]: r["chunks"] for r in m2["leaves"]}
+    changed = [n for n in rec1 if rec1[n] != rec2[n]]
+    # Exactly the perturbed params leaf differs — the optimizer moments
+    # and every other leaf keep their chunk lists verbatim.
+    assert len(changed) == 1 and "'params'" in changed[0]
+    # And the store grew by exactly the dirty leaf's chunks.
+    new = store.digests() - before
+    assert new == set(rec2[changed[0]]) - set(rec1[changed[0]])
+
+
+def test_chunk_boundaries_independent_of_history(tmp_path):
+    """The same arrays chunk to the same digests no matter what was
+    published before — boundaries are a pure function of the bytes and
+    the budget, never of the previous manifest."""
+    state = _fresh(seed=3)
+    named = [(k, np.asarray(v)) for k, v in
+             ck._leaves_with_names(ck._state_tree(state))]
+    m_a, _ = build_manifest(named, epoch=1, best_acc=0.0, chunk_mb=0.25)
+    m_b, _ = build_manifest(named, epoch=9, best_acc=0.9, chunk_mb=0.25)
+    assert ([r["chunks"] for r in m_a["leaves"]]
+            == [r["chunks"] for r in m_b["leaves"]])
+
+
+def test_gc_protects_exactly_the_windowed_manifests(tmp_path):
+    state = _fresh()
+    store = ChunkStore(str(tmp_path))
+    for epoch in range(1, 4):
+        publish_state(_perturbed(state, epoch * 1e-3), epoch=epoch,
+                      best_acc=0.5, directory=str(tmp_path),
+                      keep_last=1, process_index=0)
+    names = sorted(p for p in os.listdir(str(tmp_path))
+                   if p.endswith(".manifest"))
+    # keep_last=1: the window holds the latest epoch and one before it.
+    assert names == ["checkpoint_2.manifest", "checkpoint_3.manifest"]
+    referenced = set()
+    for name in names:
+        referenced |= cas_mod.manifest_digests(
+            read_manifest(str(tmp_path / name)))
+    assert store.digests() == referenced
+    # Both survivors still assemble: the window rule really protected
+    # every chunk a kept manifest references.
+    for name in names:
+        cas_mod.load_manifest_arrays(str(tmp_path / name))
+
+
+def test_torn_manifest_pins_no_chunks(tmp_path):
+    publish_arrays([("leaf", np.arange(8, dtype=np.float32))],
+                   epoch=1, best_acc=0.0, directory=str(tmp_path))
+    store = ChunkStore(str(tmp_path))
+    assert len(store.digests()) == 1
+    os.remove(str(tmp_path / "checkpoint_1.manifest"))
+    with open(str(tmp_path / "checkpoint_2.manifest"), "w") as f:
+        f.write('{"epoch": 3, "leaves": [')  # torn mid-write
+    assert gc_chunks(str(tmp_path)) > 0
+    assert store.digests() == set()
+
+
+# ---------------------------------------------------------------------------
+# save_checkpoint --publish delta.
+# ---------------------------------------------------------------------------
+
+
+def test_save_checkpoint_publish_delta_resumes_bitwise(tmp_path):
+    state = _fresh()
+    path = save_checkpoint(state, epoch=2, best_acc=0.75, is_best=True,
+                           directory=str(tmp_path), process_index=0,
+                           publish="delta")
+    assert path.endswith("checkpoint_2.manifest")
+    assert not os.path.exists(str(tmp_path / "checkpoint_2.npz"))
+    assert os.path.exists(str(tmp_path / "model_best.manifest"))
+    loaded, start_epoch, best_acc = load_checkpoint(path, _fresh(seed=1))
+    assert start_epoch == 3 and best_acc == 0.75
+    for a, b in zip(_gathered(state), _gathered(loaded)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_publish_delta_rejects_sharded_layout(tmp_path):
+    with pytest.raises(ValueError, match="publish_from_checkpoint"):
+        save_checkpoint(_fresh(), epoch=0, best_acc=0.0, is_best=False,
+                        directory=str(tmp_path), process_index=0,
+                        layout="sharded", publish="delta")
+
+
+def test_async_saver_delta_rejects_sharded_loudly(tmp_path):
+    saver = ck.AsyncCheckpointer()
+    with saver:
+        with pytest.raises(ValueError):
+            saver.save(_fresh(), epoch=0, best_acc=0.0, is_best=False,
+                       directory=str(tmp_path), layout="sharded",
+                       publish="delta")
+        saver.save(_fresh(), epoch=1, best_acc=0.5, is_best=False,
+                   directory=str(tmp_path), publish="delta")
+    assert os.path.exists(str(tmp_path / "checkpoint_1.manifest"))
+
+
+# ---------------------------------------------------------------------------
+# The DeltaFetcher: dirty-leaf-only requantize + gossip ordering.
+# ---------------------------------------------------------------------------
+
+
+def test_requantize_touches_only_dirty_leaves(tmp_path):
+    """The PR 13 idempotent-quantize contract carried into the fetch
+    path: a clean leaf's QuantLeaf rides through BY OBJECT IDENTITY, so
+    only dirtied leaves pay quantization on an adjacent publish."""
+    state = _fresh()
+    p1 = publish_state(state, epoch=1, best_acc=0.5,
+                       directory=str(tmp_path), process_index=0)
+    p2 = publish_state(_perturbed(state, 1e-3), epoch=2, best_acc=0.5,
+                       directory=str(tmp_path), process_index=0)
+    fetcher = DeltaFetcher(str(tmp_path),
+                           precision=get_precision("int8w"))
+    params1, epoch1 = fetcher.load(p1, state)
+    assert epoch1 == 1 and fetcher.last["dirty_leaves"] == 2
+    flat1 = jax.tree_util.tree_leaves(
+        params1, is_leaf=lambda x: isinstance(x, QuantLeaf))
+    assert all(isinstance(leaf, QuantLeaf) for leaf in flat1)
+    params2, epoch2 = fetcher.load(p2, state)
+    assert epoch2 == 2
+    assert fetcher.last["dirty_leaves"] == 1
+    assert fetcher.last["clean_leaves"] == 1
+    flat2 = jax.tree_util.tree_leaves(
+        params2, is_leaf=lambda x: isinstance(x, QuantLeaf))
+    identical = [a is b for a, b in zip(flat1, flat2)]
+    assert sorted(identical) == [False, True]
+
+
+def test_fetch_pulls_params_only(tmp_path):
+    """Serving never ships optimizer moments: the fetch bytes are the
+    params leaves', not the full Adam state's."""
+    state = _fresh()
+    path = publish_state(state, epoch=1, best_acc=0.5,
+                         directory=str(tmp_path), process_index=0)
+    local = str(tmp_path / "backend")
+    fetcher = DeltaFetcher(local, source_dir=str(tmp_path))
+    fetcher.load(path, state)
+    params_bytes = sum(np.asarray(leaf).nbytes for leaf in
+                       jax.tree_util.tree_leaves(state.params))
+    state_bytes = sum(a.nbytes for a in _gathered(state))
+    assert fetcher.last["bytes_fetched"] == params_bytes < state_bytes
+
+
+def test_gossip_peers_before_source(tmp_path, monkeypatch):
+    state = _fresh()
+    path = publish_state(state, epoch=1, best_acc=0.5,
+                         directory=str(tmp_path), process_index=0)
+    source_store = ChunkStore(str(tmp_path))
+    calls = []
+
+    def fake_fetch(base_url, digest, timeout_s=5.0):
+        calls.append((base_url, digest))
+        if base_url == "http://dead":
+            raise OSError("connection refused")
+        return source_store.get(digest)
+
+    monkeypatch.setattr(fetch_mod, "fetch_chunk_http", fake_fetch)
+    fetcher = DeltaFetcher(str(tmp_path / "b1"),
+                           peers=("http://dead", "http://live"),
+                           source_dir=str(tmp_path))
+    fetcher.load(path, state)
+    # Every chunk was attempted over gossip (both peers reachable in
+    # rotation order) and none fell through to the source dir.
+    assert calls and fetcher.last["bytes_peer"] > 0
+    assert fetcher.last["bytes_source"] == 0
+    # Peer failure per chunk falls back to the source, still loading.
+    monkeypatch.setattr(
+        fetch_mod, "fetch_chunk_http",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("down")))
+    fetcher2 = DeltaFetcher(str(tmp_path / "b2"),
+                            peers=("http://dead",),
+                            source_dir=str(tmp_path))
+    fetcher2.load(path, state)
+    assert fetcher2.last["bytes_source"] > 0
+    assert fetcher2.last["bytes_peer"] == 0
+
+
+def test_missing_chunk_error_is_absence_not_corruption(tmp_path):
+    state = _fresh()
+    path = publish_state(state, epoch=1, best_acc=0.5,
+                         directory=str(tmp_path), process_index=0)
+    # Simulate a sabotaged publish: one referenced chunk vanishes and
+    # no peer/source has it.
+    store = ChunkStore(str(tmp_path))
+    manifest = read_manifest(path)
+    # The PARAMS kernel record, not the optimizer moments' mirror of it
+    # (mu/nu leaf names embed the same ['params']['fc']['kernel'] tail).
+    params_rec = next(r for r in manifest["leaves"]
+                      if r["name"].startswith("['params']")
+                      and "kernel" in r["name"])
+    os.remove(store.path(params_rec["chunks"][0]))
+    fetcher = DeltaFetcher(str(tmp_path))
+    with pytest.raises(ValueError, match="missing chunk") as err:
+        fetcher.load(path, state)
+    # The absence message must NOT collide with the sharded layout's
+    # retry-forever "missing shards" taxonomy — this one is permanent
+    # for the file at the watcher.
+    assert "missing shards" not in str(err.value)
+    assert not ck.is_corrupt_checkpoint_error(err.value)
+
+
+# ---------------------------------------------------------------------------
+# Watcher integration: the delta failure taxonomy end to end.
+# ---------------------------------------------------------------------------
+
+
+class _Installs:
+    def __init__(self):
+        self.epochs = []
+
+    def __call__(self, params, epoch, path):
+        self.epochs.append(epoch)
+        return True
+
+
+def test_watcher_skips_torn_manifest_until_clean_publish(tmp_path):
+    state = _fresh()
+    installs = _Installs()
+    fetcher = DeltaFetcher(str(tmp_path))
+    watcher = CheckpointWatcher(str(tmp_path), state, installs,
+                                loader=fetcher.load)
+    publish_state(state, epoch=1, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once() and installs.epochs == [1]
+    # A torn manifest under the published name: half a JSON file.
+    whole = (tmp_path / "checkpoint_1.manifest").read_bytes()
+    (tmp_path / "checkpoint_2.manifest").write_bytes(
+        whole[:len(whole) // 2])
+    assert not watcher.poll_once()
+    assert not watcher.poll_once()  # permanent for the file: no retry
+    assert installs.epochs == [1]
+    publish_state(_perturbed(state, 1e-3), epoch=3, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once() and installs.epochs == [1, 3]
+
+
+def test_watcher_skips_missing_chunk_publish_then_recovers(tmp_path):
+    state = _fresh()
+    installs = _Installs()
+    fetcher = DeltaFetcher(str(tmp_path))
+    watcher = CheckpointWatcher(str(tmp_path), state, installs,
+                                loader=fetcher.load)
+    store = ChunkStore(str(tmp_path))
+    publish_state(state, epoch=1, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once() and installs.epochs == [1]
+    before = store.digests()
+    publish_state(_perturbed(state, 1e-3), epoch=2, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    for digest in store.digests() - before:
+        os.remove(store.path(digest))
+    assert not watcher.poll_once()
+    assert not watcher.poll_once()  # permanent for THIS publish
+    assert installs.epochs == [1]
+    # The next clean publish recovers — and because epoch 3 re-chunks
+    # the changed leaf, the missing epoch-2 bytes are never needed.
+    publish_state(_perturbed(state, 2e-3), epoch=3, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once() and installs.epochs == [1, 3]
+
+
+def test_watcher_full_file_fallback_resets_delta_cache(tmp_path):
+    """A whole-file publish landing in a delta-watched directory loads
+    through the byte-identical fallback and resets the diff cache, so
+    the NEXT manifest rebuilds every leaf instead of trusting stale
+    hashes."""
+    state = _fresh()
+    installs = _Installs()
+    fetcher = DeltaFetcher(str(tmp_path))
+    watcher = CheckpointWatcher(str(tmp_path), state, installs,
+                                loader=fetcher.load)
+    publish_state(state, epoch=1, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once()
+    save_checkpoint(state, epoch=2, best_acc=0.5, is_best=False,
+                    directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once() and fetcher.total["full_loads"] == 1
+    publish_state(state, epoch=3, best_acc=0.5,
+                  directory=str(tmp_path), process_index=0)
+    assert watcher.poll_once()
+    assert fetcher.last["dirty_leaves"] == 2  # cache was reset
+    assert installs.epochs == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Router seams: manifest republish + backends-dir discovery.
+# ---------------------------------------------------------------------------
+
+
+def test_republish_with_epoch_rewrites_manifest_json(tmp_path):
+    from pytorch_distributed_mnist_tpu.serve.router import (
+        epoch_of_checkpoint,
+        republish_with_epoch,
+    )
+
+    state = _fresh()
+    src = publish_state(state, epoch=2, best_acc=0.5,
+                        directory=str(tmp_path), process_index=0)
+    assert epoch_of_checkpoint(src) == 2
+    dest = str(tmp_path / "checkpoint_7.manifest")
+    republish_with_epoch(src, dest, epoch=7)
+    rebased = read_manifest(dest)
+    original = read_manifest(src)
+    assert rebased["epoch"] == 8  # stored as epoch+1, the npz convention
+    assert rebased["leaves"] == original["leaves"]  # same chunks, bitwise
+    loaded, start_epoch, _ = load_checkpoint(dest, _fresh(seed=1))
+    assert start_epoch == 8
+    for a, b in zip(_gathered(state), _gathered(loaded)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_health_poller_backends_dir_discovery(tmp_path):
+    from pytorch_distributed_mnist_tpu.serve.router import (
+        PROBATION,
+        Fleet,
+        HealthPoller,
+    )
+    from pytorch_distributed_mnist_tpu.serve.server import (
+        _remove_register_record,
+        _write_register_record,
+    )
+
+    fleet = Fleet()
+    static = fleet.add("127.0.0.1:7001")
+    poller = HealthPoller(fleet, backends_dir=str(tmp_path))
+    record = str(tmp_path / "backend_127-0-0-1_7002.json")
+    _write_register_record(record, "http://127.0.0.1:7002")
+    # A static member's record must not double-add or mark it reapable.
+    _write_register_record(
+        str(tmp_path / "backend_127-0-0-1_7001.json"),
+        "http://127.0.0.1:7001")
+    poller.sync_backends_dir()
+    assert fleet.names() == ["127.0.0.1:7001", "127.0.0.1:7002"]
+    joined = fleet.get("127.0.0.1:7002")
+    assert joined.health.state == PROBATION  # earns healthy like a spawn
+    # Idempotent while records are stable.
+    poller.sync_backends_dir()
+    assert fleet.names() == ["127.0.0.1:7001", "127.0.0.1:7002"]
+    # Record removed (drain/shutdown): only the DISCOVERED backend
+    # leaves; the static member is the operator's.
+    _remove_register_record(record)
+    os.remove(str(tmp_path / "backend_127-0-0-1_7001.json"))
+    poller.sync_backends_dir()
+    assert fleet.names() == ["127.0.0.1:7001"]
+    assert fleet.get(static.name) is static
+    # A torn record (partial JSON) is skipped, not fatal.
+    with open(str(tmp_path / "backend_torn.json"), "w") as f:
+        f.write('{"url": "http')
+    poller.sync_backends_dir()
+    assert fleet.names() == ["127.0.0.1:7001"]

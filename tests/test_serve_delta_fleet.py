@@ -1,0 +1,226 @@
+"""Delta distribution over real loopback HTTP (ISSUE 18): the gossip
+endpoint, dynamic fleet membership, and a manifest rolling deploy —
+in-process ThreadingHTTPServers, same harness as the fleet acceptance
+twin (tests/test_serve_router_fleet.py).
+
+Pins:
+- a backend boots straight from a delta-published directory (manifest
+  + chunk store, no npz anywhere);
+- ``GET /chunks/<hash>`` serves immutable chunk bytes (content-typed,
+  404 on absence/malformed digests) and ``fetch_chunk_http`` reads it —
+  the two halves of the gossip plane meeting over a real socket;
+- ``--register-dir`` records follow the backend lifecycle (boot
+  registers, drain un-registers, undrain re-registers, shutdown
+  removes) and a ``--backends-dir`` router's membership tracks them
+  with no restart;
+- ``POST /rollout`` with a manifest source: the router ships a few-KB
+  manifest per backend, every fetcher pulls the chunks from
+  ``--chunk-source`` staging, and the whole fleet converges on the new
+  epoch.
+
+The in-process unit halves (ChunkStore semantics, DeltaFetcher diff /
+requantize / taxonomy, HealthPoller.sync_backends_dir as pure state)
+live in tests/test_distrib_delta.py; the subprocess twins in
+tools/chaos.py --torn-manifest and --fleet --delta-publish E.
+"""
+
+import os
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_mnist_tpu.distrib.cas import (
+    ChunkStore,
+    read_manifest,
+)
+from pytorch_distributed_mnist_tpu.distrib.fetch import fetch_chunk_http
+from pytorch_distributed_mnist_tpu.distrib.publish import publish_state
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.router import create_router
+from pytorch_distributed_mnist_tpu.serve.router import (
+    build_parser as router_parser,
+)
+from pytorch_distributed_mnist_tpu.serve.server import (
+    build_parser,
+    create_server,
+)
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from tests.test_serve_router_fleet import _Server, _wait
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet, pytest.mark.distrib]
+
+
+def _delta_publish(ckpt_dir, epoch, seed, shift=0.0):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(seed))
+    if shift:
+        state = state.replace(params=jax.tree_util.tree_map(
+            lambda leaf: leaf + shift, state.params))
+    publish_state(state, epoch=epoch, best_acc=0.5,
+                  directory=str(ckpt_dir), process_index=0)
+    return state
+
+
+def _boot_backend(ckpt_dir, *extra):
+    args = build_parser().parse_args([
+        "--checkpoint-dir", str(ckpt_dir),
+        "--model", "linear", "--dtype", "f32",
+        "--host", "127.0.0.1", "--port", "0",
+        "--buckets", "1,8",
+        "--max-wait-ms", "2", "--max-queue", "256",
+        "--poll-interval", "0.1",
+        *extra,
+    ])
+    return _Server(create_server(args))
+
+
+def _boot_router(argv):
+    base = ["--host", "127.0.0.1", "--port", "0",
+            "--health-interval", "0.1",
+            "--quarantine-after", "2",
+            "--probation-successes", "1",
+            "--connect-timeout", "2.0"]
+    return _Server(create_router(router_parser().parse_args(base + argv)))
+
+
+def _healthz(router):
+    """Router /healthz, tolerating the empty-fleet 503 (a discovery
+    router starts with zero members — that reply is still JSON)."""
+    import json as _json
+
+    try:
+        return router.get("/healthz")
+    except urllib.error.HTTPError as exc:
+        return _json.load(exc)
+
+
+def _record_urls(register_dir):
+    import json as _json
+
+    urls = []
+    for name in sorted(os.listdir(register_dir)):
+        if name.startswith("backend_") and name.endswith(".json"):
+            with open(os.path.join(register_dir, name)) as f:
+                urls.append(_json.load(f)["url"])
+    return urls
+
+
+def test_boot_from_manifest_and_chunk_gossip_endpoint(tmp_path):
+    """A backend whose checkpoint dir holds only a manifest + chunks
+    boots serving that epoch, and its /chunks route feeds
+    fetch_chunk_http the exact stored bytes."""
+    ckpt = tmp_path / "ckpt"
+    _delta_publish(ckpt, epoch=1, seed=10)
+    assert not any(p.endswith(".npz") for p in os.listdir(str(ckpt)))
+    backend = _boot_backend(ckpt)
+    try:
+        health = backend.get("/healthz")
+        assert health["model_epoch"] == 1
+        store = ChunkStore(str(ckpt))
+        manifest = read_manifest(str(ckpt / "checkpoint_1.manifest"))
+        for rec in manifest["leaves"][:2]:
+            digest = rec["chunks"][0]
+            data = fetch_chunk_http(backend.url, digest)
+            assert data == store.get(digest)
+        # Absent and malformed digests 404 — never a hang or a 500.
+        for bogus in ("0" * 64, "nothex"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                fetch_chunk_http(backend.url, bogus)
+            assert err.value.code == 404
+    finally:
+        backend.close()
+
+
+def test_register_record_follows_lifecycle(tmp_path):
+    ckpt, reg = tmp_path / "ckpt", tmp_path / "fleet"
+    _delta_publish(ckpt, epoch=0, seed=10)
+    backend = _boot_backend(ckpt, "--register-dir", str(reg))
+    try:
+        assert _record_urls(str(reg)) == [backend.url]
+        backend.post("/drain", {"drain": True})
+        assert _record_urls(str(reg)) == []
+        backend.post("/drain", {"drain": False})
+        assert _record_urls(str(reg)) == [backend.url]
+    finally:
+        backend.close()
+    # Shutdown removes the record even without a preceding drain.
+    assert _record_urls(str(reg)) == []
+
+
+def test_router_membership_tracks_backends_dir(tmp_path):
+    """A --backends-dir router with NO static --backends: membership
+    grows when a backend registers, shrinks when it drains (the record
+    removal IS the leave signal), and recovers on undrain."""
+    reg = tmp_path / "fleet"
+    backends = []
+    for i in range(2):
+        ckpt = tmp_path / f"b{i}"
+        _delta_publish(ckpt, epoch=0, seed=10)
+        backends.append(
+            _boot_backend(ckpt, "--register-dir", str(reg)))
+    router = _boot_router(["--backends-dir", str(reg)])
+    try:
+        _wait(lambda: _healthz(router)["routable"] == 2,
+              what="both registered backends routable")
+        backends[1].post("/drain", {"drain": True})
+        _wait(lambda: _healthz(router)["routable"] == 1,
+              what="drained backend reaped from the fleet")
+        assert _healthz(router)["total"] == 1
+        backends[1].post("/drain", {"drain": False})
+        _wait(lambda: _healthz(router)["routable"] == 2,
+              what="undrained backend re-admitted")
+        # Late join: a third backend registers after the router booted.
+        ckpt = tmp_path / "b2"
+        _delta_publish(ckpt, epoch=0, seed=10)
+        backends.append(
+            _boot_backend(ckpt, "--register-dir", str(reg)))
+        _wait(lambda: _healthz(router)["routable"] == 3,
+              what="late-joining backend discovered")
+    finally:
+        router.close()
+        for b in backends:
+            b.close()
+
+
+def test_manifest_rollout_converges_fleet(tmp_path):
+    """POST /rollout with a manifest source: each backend receives the
+    few-KB manifest (epoch-rewritten by the router), pulls only the
+    chunks it lacks from --chunk-source staging, and the whole fleet
+    lands on the new epoch with every backend answering throughout."""
+    staging = tmp_path / "staging"
+    _delta_publish(staging, epoch=0, seed=10)
+    backends, dirs = [], []
+    for i in range(3):
+        ckpt = tmp_path / f"b{i}"
+        _delta_publish(ckpt, epoch=0, seed=10)
+        dirs.append(ckpt)
+        backends.append(_boot_backend(
+            ckpt, "--chunk-source", str(staging)))
+    router = _boot_router(
+        ["--backends", ",".join(b.name for b in backends)])
+    try:
+        _wait(lambda: router.get("/healthz")["routable"] == 3,
+              what="all 3 backends healthy")
+        _delta_publish(staging, epoch=1, seed=10, shift=1e-3)
+        source = str(staging / "checkpoint_1.manifest")
+        result = router.post("/rollout", {"source": source})
+        assert result["ok"], result
+        assert sorted(result["updated"]) == sorted(
+            b.name for b in backends)
+        assert result["target_epoch"] == 1
+        for b, d in zip(backends, dirs):
+            health = b.get("/healthz")
+            assert health["model_epoch"] == 1
+            assert health["draining"] is False
+            # The router shipped a manifest, not a whole file — and the
+            # fetcher installed the chunks into the backend's own store
+            # (it is now a seeder for this epoch's bytes).
+            assert os.path.isfile(str(d / "checkpoint_1.manifest"))
+            assert not os.path.exists(str(d / "checkpoint_1.npz"))
+    finally:
+        router.close()
+        for b in backends:
+            b.close()

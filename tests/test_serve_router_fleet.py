@@ -187,9 +187,17 @@ def test_kill_one_backend_zero_dropped_then_readmit(fleet, tmp_path):
 
     # The dead backend is quarantined (poller or dispatch noticed) and
     # the router kept serving: /stats shows the per-backend rows and
-    # the merged fleet quantiles over the survivors' windows.
-    _wait(lambda: router.get("/stats")["backends"][1]["state"]
-          == "quarantined", what="victim quarantine")
+    # the merged fleet quantiles over the survivors' windows. Rows are
+    # sorted by NAME (ephemeral ports don't sort in creation order) —
+    # always look the victim up, never index positionally.
+    def _victim_row():
+        for r in router.get("/stats")["backends"]:
+            if r["name"] == victim.name:
+                return r
+        raise AssertionError(f"no row for {victim.name}")
+
+    _wait(lambda: _victim_row()["state"] == "quarantined",
+          what="victim quarantine")
     stats = router.get("/stats")
     rows = {r["name"]: r for r in stats["backends"]}
     assert set(rows) == {b.name for b in backends}
@@ -209,9 +217,9 @@ def test_kill_one_backend_zero_dropped_then_readmit(fleet, tmp_path):
     revived = _boot_backend(dirs[1], port=victim.port)
     try:
         assert revived.name == victim.name
-        _wait(lambda: router.get("/stats")["backends"][1]["state"]
-              == "healthy", what="victim re-admission")
-        row = router.get("/stats")["backends"][1]
+        _wait(lambda: _victim_row()["state"] == "healthy",
+              what="victim re-admission")
+        row = _victim_row()
         assert row["readmissions"] >= 1 and row["routable"]
         assert router.get("/healthz")["routable"] == 3
     finally:

@@ -95,6 +95,17 @@ deaths instead of monkeypatches:
     python tools/chaos.py --fleet 2 --fleet-canary-rollback \\
         --cpu-devices 1
 
+    # DELTA DISTRIBUTION: 3 backends watch one shared checkpoint dir;
+    # 3 adjacent delta publishes under live loadgen — zero drops,
+    # every backend converges, and each publish's new chunk bytes are
+    # a tiny fraction of the cold (whole-state) publish
+    python tools/chaos.py --fleet 3 --delta-publish 3 --cpu-devices 1
+
+    # torn publish: a half-written manifest, then a manifest with a
+    # missing chunk, then a clean one — skipped, skipped, recovered;
+    # serving never stops through any of it
+    python tools/chaos.py --torn-manifest --cpu-devices 1
+
 Fault host indices are process RANKS within the world that reads the
 plan — in an elastic run each rebuilt generation renumbers its ranks
 0..W'-1, so a spec aimed at rank 2 cannot re-fire once the world is
@@ -681,6 +692,61 @@ def run_serve_chaos(args) -> int:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+# Delta-publish helper run in a subprocess (chaos stays jax-free).
+# Deterministic per epoch: the state is base(seed 7) with the SMALLEST
+# params leaf (the bias) shifted by e*1e-3, so adjacent epochs differ in
+# exactly one leaf and re-running any epoch reproduces its bytes.
+# argv: directory e0 n drop_new sleep_s. drop_new=1 sabotages the
+# publish by deleting every chunk it newly added — the missing-chunk
+# torn-publish twin.
+_DELTA_PUBLISH_CODE = """
+import os, sys, time
+import jax, jax.numpy as jnp
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+from pytorch_distributed_mnist_tpu.distrib.cas import ChunkStore
+from pytorch_distributed_mnist_tpu.distrib.publish import publish_state
+
+directory, e0, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+drop_new, sleep_s = sys.argv[4] == "1", float(sys.argv[5])
+m = get_model("linear", compute_dtype=jnp.float32)
+base = create_train_state(m, jax.random.key(7))
+store = ChunkStore(directory)
+leaves, treedef = jax.tree_util.tree_flatten(base.params)
+small = min(range(len(leaves)), key=lambda j: leaves[j].size)
+for e in range(e0, e0 + n):
+    shifted = list(leaves)
+    shifted[small] = leaves[small] + e * 1e-3
+    state = base.replace(
+        params=jax.tree_util.tree_unflatten(treedef, shifted))
+    before = store.digests()
+    publish_state(state, epoch=e, best_acc=0.5, directory=directory,
+                  process_index=0)
+    if drop_new:
+        for digest in store.digests() - before:
+            os.remove(store.path(digest))
+    if sleep_s and e + 1 < e0 + n:
+        time.sleep(sleep_s)
+"""
+
+
+def _delta_publish_epochs(env: dict, directory: str, e0: int, n: int,
+                          drop_new: bool = False,
+                          sleep_s: float = 0.0) -> None:
+    subprocess.run(
+        [sys.executable, "-c", _DELTA_PUBLISH_CODE, directory, str(e0),
+         str(n), "1" if drop_new else "0", str(sleep_s)],
+        env=env, check=True, timeout=600)
+
+
+def _chunks_bytes(directory: str) -> int:
+    chunk_dir = os.path.join(directory, "chunks")
+    if not os.path.isdir(chunk_dir):
+        return 0
+    return sum(os.path.getsize(os.path.join(chunk_dir, name))
+               for name in os.listdir(chunk_dir))
+
+
 def _seed_checkpoint(env: dict, directory: str, epoch: int) -> str:
     """Save a real linear-model checkpoint_{epoch}.npz into
     ``directory`` via a subprocess (chaos itself stays jax-import-free)
@@ -718,7 +784,13 @@ def run_fleet_chaos(args) -> int:
     --fleet-canary-rollback: publish behind a fleet canary with
     TPUMNIST_FLEET_FAULT=canary_disagree injected into the router —
     the canary must roll back (baseline weights republished) while
-    every request is still answered."""
+    every request is still answered.
+
+    --delta-publish E (ISSUE 18): every backend watches ONE shared
+    checkpoint directory; E delta publishes land under live loadgen —
+    zero drops, every backend converges to the last epoch, and the
+    chunk bytes each adjacent publish adds must be a small fraction of
+    the cold (whole-state) bytes."""
     env = _serve_env(args)
     router_env = dict(env)
     if args.fleet_canary_rollback:
@@ -731,10 +803,20 @@ def run_fleet_chaos(args) -> int:
     backends = []  # (server, log, ckpt_dir, url)
     router = router_log = None
     staging = tempfile.mkdtemp(prefix="tpumnist-fleet-staging-")
+    shared_dir = None
     try:
+        if args.delta_publish:
+            # One directory for the whole fleet (the shared-fs
+            # scenario); seeded with a COLD delta publish so the
+            # backends boot serving epoch 1 off the manifest and the
+            # store holds the full-state baseline bytes to compare
+            # adjacent publishes against.
+            shared_dir = tempfile.mkdtemp(prefix="tpumnist-fleet-delta-")
+            _delta_publish_epochs(env, shared_dir, 1, 1)
+            _say("seeded epoch-1 delta publish (cold store)")
         for i in range(args.fleet):
             server, log, ckpt_dir, url = _boot_serve(
-                env, backend_flags, args.timeout)
+                env, backend_flags, args.timeout, ckpt_dir=shared_dir)
             if url is None:
                 return 1
             backends.append([server, log, ckpt_dir, url])
@@ -926,8 +1008,64 @@ def run_fleet_chaos(args) -> int:
                  f"dropped")
             return 0
 
+        if args.delta_publish:
+            n = args.delta_publish
+            cold = _chunks_bytes(shared_dir)
+            last_epoch = 1 + n
+            duration = max(8.0, 2.0 * n + 4.0)
+            loadgen = subprocess.Popen(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "loadgen.py"),
+                 "--mode", "open", "--rate", "60",
+                 "--duration", str(duration), "--retry-transport", "2",
+                 "--url", url],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            time.sleep(1.0)
+            t0 = time.monotonic()
+            _delta_publish_epochs(env, shared_dir, 2, n, sleep_s=1.0)
+            # Fleet consistency: every backend swaps onto the LAST
+            # published epoch while traffic keeps flowing.
+            deadline = time.monotonic() + args.timeout
+            converged = False
+            while time.monotonic() < deadline and not converged:
+                converged = all(
+                    _get_json(burl, "/healthz").get("model_epoch")
+                    == last_epoch for _, _, _, burl in backends)
+                if not converged:
+                    time.sleep(0.2)
+            consistency_s = time.monotonic() - t0
+            out, _ = loadgen.communicate(timeout=args.timeout)
+            report = _loadgen_report(out)
+            answered = sum(report.get("status_counts", {}).values())
+            dropped = (report.get("transport_errors", 0)
+                       + report.get("conn_refused", 0))
+            if loadgen.returncode != 0 or dropped or \
+                    report.get("ok") != answered or answered < 100:
+                _say(f"DROPPED requests through the delta publishes: "
+                     f"ok={report.get('ok')}/{answered}, "
+                     f"dropped={dropped}")
+                return 1
+            if not converged:
+                epochs = [_get_json(burl, "/healthz").get("model_epoch")
+                          for _, _, _, burl in backends]
+                _say(f"fleet never converged to epoch {last_epoch}: "
+                     f"{epochs}")
+                return 1
+            delta = _chunks_bytes(shared_dir) - cold
+            per_publish = delta / n
+            _say(f"{n} delta publishes: {per_publish:.0f}B/publish vs "
+                 f"{cold}B cold ({100 * per_publish / max(cold, 1):.2f}"
+                 f"%); fleet consistent in {consistency_s:.1f}s; "
+                 f"{answered} requests answered, zero dropped")
+            if per_publish >= 0.30 * cold:
+                _say("adjacent delta publishes should move far fewer "
+                     "bytes than the cold publish")
+                return 1
+            return 0
+
         _say("--fleet needs one of --kill-backend K / --rolling-reload "
-             "/ --fleet-canary-rollback")
+             "/ --fleet-canary-rollback / --delta-publish E")
         return 2
     finally:
         if router is not None:
@@ -939,6 +1077,116 @@ def run_fleet_chaos(args) -> int:
         for server, log, ckpt_dir, _ in backends:
             _kill_serve(server, log, ckpt_dir)
         shutil.rmtree(staging, ignore_errors=True)
+
+
+def run_torn_manifest(args) -> int:
+    """The torn-publish twin (ISSUE 18): one real serve process on a
+    delta-published directory, fed three kinds of publish damage.
+
+    1. A TORN manifest (half a JSON file under the published name —
+       a publisher that died mid-write without the tmp+rename
+       discipline): content damage, permanent-skip for that file.
+    2. A manifest referencing a MISSING chunk (the publish's new chunks
+       deleted after the rename): absence for that publish,
+       permanent-skip until a newer manifest appears.
+    3. A clean publish: the watcher recovers onto it with no restart.
+
+    Through all three the server answers every request on the params it
+    has — reload failures are recorded, never served."""
+    env = _serve_env(args)
+    ckpt_dir = tempfile.mkdtemp(prefix="tpumnist-torn-")
+    server = log = None
+    try:
+        _delta_publish_epochs(env, ckpt_dir, 1, 1)
+        server, log, ckpt_dir, url = _boot_serve(
+            env, ["--model", "linear", "--buckets", "1,8",
+                  "--max-wait-ms", "2", "--max-queue", "256",
+                  "--poll-interval", "0.2"],
+            args.timeout, ckpt_dir=ckpt_dir)
+        if url is None:
+            return 1
+        if _get_json(url, "/healthz").get("model_epoch") != 1:
+            _say("server did not boot onto the epoch-1 manifest")
+            return 1
+        _say("serving epoch 1 off the seeded manifest")
+
+        def _smoke(stage: str) -> bool:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "loadgen.py"),
+                 "--smoke", "--url", url, "--requests", "50",
+                 "--concurrency", "4"],
+                capture_output=True, text=True, timeout=args.timeout)
+            report = _loadgen_report(proc.stdout)
+            if proc.returncode != 0 or report.get("ok") != 50:
+                _say(f"requests dropped {stage}: {report}")
+                return False
+            return True
+
+        def _await_failures(want: int) -> bool:
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                if _get_json(url, "/stats").get(
+                        "reload_failures", 0) >= want:
+                    return True
+                time.sleep(0.2)
+            _say(f"watcher never recorded reload failure #{want}")
+            return False
+
+        # 1: torn JSON under the published epoch-2 name.
+        with open(os.path.join(ckpt_dir,
+                               "checkpoint_1.manifest"), "rb") as f:
+            data = f.read()
+        with open(os.path.join(ckpt_dir,
+                               "checkpoint_2.manifest"), "wb") as f:
+            f.write(data[:len(data) // 2])
+        if not _await_failures(1):
+            return 1
+        if _get_json(url, "/healthz").get("model_epoch") != 1:
+            _say("torn manifest must not change the serving params")
+            return 1
+        if not _smoke("under the torn manifest"):
+            return 1
+        _say("torn manifest skipped (still serving epoch 1, zero "
+             "drops)")
+
+        # 2: epoch-3 manifest whose new chunks were deleted post-rename.
+        _delta_publish_epochs(env, ckpt_dir, 3, 1, drop_new=True)
+        if not _await_failures(2):
+            return 1
+        if _get_json(url, "/healthz").get("model_epoch") != 1:
+            _say("missing-chunk manifest must not change the serving "
+                 "params")
+            return 1
+        if not _smoke("under the missing-chunk manifest"):
+            return 1
+        _say("missing-chunk manifest skipped (still serving epoch 1)")
+
+        # 3: the next CLEAN publish recovers with no operator action.
+        _delta_publish_epochs(env, ckpt_dir, 4, 1)
+        deadline = time.monotonic() + args.timeout
+        epoch = None
+        while time.monotonic() < deadline:
+            epoch = _get_json(url, "/healthz").get("model_epoch")
+            if epoch == 4:
+                break
+            time.sleep(0.2)
+        if epoch != 4:
+            _say(f"clean publish never recovered the watcher "
+                 f"(model_epoch={epoch}, want 4)")
+            return 1
+        if not _smoke("after the recovery publish"):
+            return 1
+        stats = _get_json(url, "/stats")
+        _say(f"recovered onto epoch 4 (reloads={stats.get('reloads')}, "
+             f"reload_failures={stats.get('reload_failures')}); zero "
+             f"drops end to end")
+        return 0
+    finally:
+        if server is not None:
+            _kill_serve(server, log, ckpt_dir)
+        else:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
 def main(argv=None) -> int:
@@ -1123,6 +1371,21 @@ def main(argv=None) -> int:
                         "injected into the router — the canary must "
                         "roll back (baseline weights republished) "
                         "while every request is still answered")
+    p.add_argument("--delta-publish", type=int, default=0, metavar="E",
+                   help="fleet twin (ISSUE 18): all backends watch ONE "
+                        "shared checkpoint dir; E adjacent delta "
+                        "publishes land under live loadgen — zero "
+                        "drops, every backend converges to the last "
+                        "epoch, and each publish's new chunk bytes "
+                        "must be a small fraction of the cold "
+                        "(whole-state) publish")
+    p.add_argument("--torn-manifest", action="store_true",
+                   help="delta-distribution twin (ISSUE 18): one serve "
+                        "process fed a TORN manifest, then a manifest "
+                        "with a missing chunk, then a clean publish — "
+                        "both damaged publishes are skipped (recorded, "
+                        "never served), serving never stops, and the "
+                        "clean publish recovers with no restart")
     p.add_argument("cli_args", nargs=argparse.REMAINDER,
                    help="arguments after -- go to tpu-mnist verbatim")
     args = p.parse_args(argv)
@@ -1137,10 +1400,12 @@ def main(argv=None) -> int:
                              "fleet has no failure domain to survive)")
         return run_fleet_chaos(args)
     if args.kill_backend is not None or args.rolling_reload \
-            or args.fleet_canary_rollback:
+            or args.fleet_canary_rollback or args.delta_publish:
         raise SystemExit("--kill-backend/--rolling-reload/"
-                         "--fleet-canary-rollback are fleet twins; "
-                         "add --fleet N")
+                         "--fleet-canary-rollback/--delta-publish are "
+                         "fleet twins; add --fleet N")
+    if args.torn_manifest:
+        return run_torn_manifest(args)
     if args.autoscale_spike:
         return run_autoscale_spike(args)
     if args.quota_abuse:
