@@ -1901,7 +1901,8 @@ def main_serve() -> None:
         from pytorch_distributed_mnist_tpu.train.checkpoint import (
             save_checkpoint,
         )
-        from tools.loadgen import _make_images, run_closed, run_open
+        from tools.loadgen import _make_images, run_closed, run_open, \
+            zipf_cum
         from tools.loadgen import report as _loadgen_report
 
         def _drive_closed(url, n, conc, *, seed):
@@ -2130,6 +2131,201 @@ def main_serve() -> None:
                 "failure")
         fleet_block["ok"] = not fleet_failures
 
+        # -- economics (ISSUE 19): the request-path economics layer's
+        # own cost and behavior — one loopback backend with the
+        # response cache + cost-priced admission on, driven with
+        # Zipf-duplicate traffic (the key-reuse workload the cache
+        # exists for). Three verdicts:
+        # (1) a client-observed cache hit is ~free next to compute:
+        #     hit p99 <= 0.1x miss p99 on TPU (on CPU loopback the
+        #     HTTP stack dominates BOTH sides, so the enforced bar
+        #     relaxes to hit p99 < miss p99 and the 0.1x number is
+        #     reported with the BENCH_r05 caveat);
+        # (2) goodput at ~10x offered load holds >= 96% of the curve's
+        #     peak (the PR 14 single-process bar, which the cache
+        #     should now CLEAR rather than approach: duplicates are
+        #     answered from memory, not shed);
+        # (3) zero steady-state recompiles across every economics
+        #     drive — a cache hit never touches a chip, so it can
+        #     never compile anything.
+        # The collapse ratio (followers joined / requests served) and
+        # the server's measured per-bucket cost table ride along as
+        # report-only provenance.
+        economics_failures: list = []
+        economics_block: dict = {}
+        econ_seconds = float(os.environ.get("BENCH_ECONOMICS_SECONDS",
+                                            "1.0"))
+        econ_reqs = int(os.environ.get("BENCH_ECONOMICS_REQUESTS", "200"))
+        econ_dir = None
+        econ_server = None
+        try:
+            econ_model = get_model("linear", compute_dtype=jnp.float32)
+            econ_state = create_train_state(econ_model,
+                                            jax.random.key(9))
+            econ_dir = _tempfile.mkdtemp(prefix="bench-economics-")
+            save_checkpoint(econ_state, epoch=0, best_acc=0.0,
+                            is_best=False, directory=econ_dir,
+                            process_index=0)
+            econ_server = _boot_httpd(create_server(
+                _serve_parser().parse_args([
+                    "--checkpoint-dir", econ_dir, "--model", "linear",
+                    "--dtype", "f32", "--host", "127.0.0.1",
+                    "--port", "0", "--buckets", "1,8",
+                    "--max-wait-ms", "2", "--max-queue", "256",
+                    "--poll-interval", "5", "--price-admission"])))
+            econ_url = econ_server["url"]
+
+            def _econ_json(path):
+                with _urlreq.urlopen(econ_url + path, timeout=10) as r:
+                    return json.loads(r.read())
+
+            # Warm the PROGRAMS with a disjoint body set (different
+            # seed -> different bytes -> different cache keys), so the
+            # measured drive sees warm compiles but a COLD cache: its
+            # misses are pure compute, not compile.
+            warm_bodies = _make_images(4, 8, seed=11)
+            col = run_closed(econ_url, 16, 4, warm_bodies, timeout=30.0,
+                             seed=1)
+            warm_rep = _loadgen_report(col, 1.0, "closed")
+            if warm_rep["ok"] != 16:
+                raise RuntimeError(
+                    f"economics warmup failed: {warm_rep}")
+            before_econ = _serve_program_compiles()
+
+            # (1) The Zipf-duplicate drive: 16 templates, exponent 1.1
+            # — the head template dominates, every template's first
+            # touch is a measured miss (compute), every repeat a hit.
+            econ_bodies = _make_images(16, 8, seed=9)
+            econ_zipf = zipf_cum(16, 1.1)
+            t_e = time.perf_counter()
+            col = run_closed(econ_url, econ_reqs, 8, econ_bodies,
+                             timeout=30.0, seed=17, zipf=econ_zipf)
+            zipf_rep = _loadgen_report(col, time.perf_counter() - t_e,
+                                       "closed")
+            cc = zipf_rep.get("cache_client", {})
+            hit_p99 = cc.get("hit_latency_ms", {}).get("p99", 0.0)
+            miss_p99 = cc.get("miss_latency_ms", {}).get("p99", 0.0)
+            if zipf_rep["ok"] != econ_reqs:
+                economics_failures.append(
+                    f"zipf drive lost requests: {zipf_rep}")
+            if not cc.get("hits") or not cc.get("misses"):
+                economics_failures.append(
+                    f"zipf drive never split hit/miss "
+                    f"(cache inactive?): {cc}")
+            hit_ratio = round(hit_p99 / max(miss_p99, 1e-9), 3)
+            on_tpu = device.platform == "tpu"
+            hit_bar = 0.1 if on_tpu else 1.0
+            hit_cheap = hit_p99 <= hit_bar * miss_p99
+            economics_block["zipf_drive"] = {
+                "requests": econ_reqs,
+                "zipf_exponent": 1.1,
+                "templates": 16,
+                "hit_rate": cc.get("hit_rate"),
+                "hit_p99_ms": hit_p99,
+                "miss_p99_ms": miss_p99,
+                "hit_over_miss_p99": hit_ratio,
+                "enforced_bar": hit_bar,
+                "hit_is_cheap": hit_cheap,
+            }
+            if not hit_cheap:
+                economics_failures.append(
+                    f"cache hits are not cheap: hit p99 {hit_p99}ms vs "
+                    f"miss p99 {miss_p99}ms (ratio {hit_ratio} > "
+                    f"{hit_bar})")
+
+            # (2) Goodput at 10x offered, cache warm: duplicates come
+            # back from memory, so the top point should HOLD the PR 14
+            # 96%-of-peak single-process bar, not merely approach it.
+            t_cap = time.perf_counter()
+            cap = run_closed(econ_url, 3 * econ_reqs // 2, 8,
+                             econ_bodies, timeout=30.0, seed=23,
+                             zipf=econ_zipf)
+            cap_wall = max(time.perf_counter() - t_cap, 1e-9)
+            econ_capacity = max(cap.status.get(200, 0) / cap_wall, 1e-9)
+            econ_points = []
+            for mult in (1, 10):
+                rate = min(econ_capacity * mult, 1500.0)
+                col = run_open(econ_url, rate, econ_seconds,
+                               econ_bodies, timeout=10.0,
+                               seed=60 + mult, zipf=econ_zipf)
+                rep = _loadgen_report(col, econ_seconds, "open")
+                econ_points.append({
+                    "offered_x": round(rate / econ_capacity, 2),
+                    "offered_rps": round(rate, 1),
+                    "completed": rep["ok"],
+                    "shed": rep["rejected"],
+                    "not_launched": rep["not_launched"],
+                    "hit_rate": rep.get("cache_client", {})
+                    .get("hit_rate"),
+                    "goodput_rps": round(rep["ok"] / econ_seconds, 1),
+                })
+                if rep["transport_errors"] or rep["conn_refused"]:
+                    economics_failures.append(
+                        f"requests dropped on the floor at {mult}x "
+                        f"on the cached path: {rep}")
+            peak_econ = max(pt["goodput_rps"] for pt in econ_points)
+            top_econ = econ_points[-1]
+            econ_frac = round(
+                top_econ["goodput_rps"] / max(peak_econ, 1e-9), 3)
+            economics_block["goodput"] = {
+                "capacity_rps": round(econ_capacity, 1),
+                "points": econ_points,
+                "peak_goodput_rps": peak_econ,
+                "goodput_at_top_fraction_of_peak": econ_frac,
+                "single_process_fraction_of_peak": overload_block.get(
+                    "goodput_at_top_fraction_of_peak"),
+                "holds_at_overload": econ_frac >= 0.96,
+            }
+            if econ_frac < 0.96:
+                economics_failures.append(
+                    f"cached-path goodput fell below the 96%-of-peak "
+                    f"bar at {top_econ['offered_x']}x: "
+                    f"{top_econ['goodput_rps']} rps vs peak "
+                    f"{peak_econ} rps ({econ_frac})")
+
+            # (3) Zero recompiles + the report-only provenance: the
+            # collapse ratio and the measured per-bucket cost table.
+            delta_econ = _recompile_delta(before_econ,
+                                          _serve_program_compiles())
+            economics_block["zero_steady_state_recompiles"] = \
+                not delta_econ
+            if delta_econ:
+                economics_failures.append(
+                    f"steady-state serving recompiled on the cached "
+                    f"path: {delta_econ}")
+            stats = _econ_json("/stats")
+            served = max(stats.get("requests", 0), 1)
+            collapsed = stats.get("cache", {}).get("collapsed", 0)
+            economics_block["collapse_ratio"] = round(
+                collapsed / served, 4)
+            economics_block["server_cache"] = stats.get("cache")
+            economics_block["cost_model"] = stats.get("cost_model")
+        except Exception as exc:  # noqa: BLE001 - the block fails loudly, the bench still emits JSON
+            economics_failures.append(f"economics block crashed: {exc!r}")
+        finally:
+            if econ_server is not None:
+                try:
+                    _stop_httpd(econ_server)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            if econ_dir is not None:
+                _shutil.rmtree(econ_dir, ignore_errors=True)
+        if device.platform != "tpu":
+            economics_block["caveat"] = (
+                "CPU fallback (the BENCH_r05 convention): the HTTP "
+                "loopback stack dominates both the hit and the miss "
+                "path, so the 0.1x hit-vs-compute bar is reported but "
+                "only hit < miss is enforced — the hit rate, goodput "
+                "fraction and recompile verdict are the meaningful "
+                "part here")
+        if os.environ.get("BENCH_ECONOMICS_INJECT_FAIL"):
+            # Test hook: pin the fails-loudly path (mirrors
+            # BENCH_FLEET_INJECT_FAIL).
+            economics_failures.append(
+                "BENCH_ECONOMICS_INJECT_FAIL set: injected economics "
+                "verdict failure")
+        economics_block["ok"] = not economics_failures
+
         value = requests / wall
         out.update({
             "value": round(value, 1),
@@ -2151,6 +2347,7 @@ def main_serve() -> None:
             "whole_program": whole_program_block,
             "overload": overload_block,
             "fleet": fleet_block,
+            "economics": economics_block,
             "pipeline_speedup": round(pipeline_speedup, 3),
             "pipeline_pairs": pipeline_pairs,
             "pool_requests": pool_requests,
@@ -2170,13 +2367,17 @@ def main_serve() -> None:
               and not recompiled_replicas and not sharded_recompiles
               and not pipeline_recompiles and not precision_recompiles
               and not fused_recompiles and not wp_failures
-              and not overload_failures and not fleet_failures)
+              and not overload_failures and not fleet_failures
+              and not economics_failures)
         if overload_failures:
             out["error"] = ("overload block failed: "
                             + "; ".join(overload_failures))
         elif fleet_failures:
             out["error"] = ("fleet block failed: "
                             + "; ".join(fleet_failures))
+        elif economics_failures:
+            out["error"] = ("economics block failed: "
+                            + "; ".join(economics_failures))
         elif fused_recompiles:
             out["error"] = ("steady-state WHOLE-PROGRAM serving "
                             "recompiled (fused plane): "

@@ -83,11 +83,13 @@ class _Pending:
     """One submitted request riding the queue."""
 
     __slots__ = ("images", "rows", "event", "result", "error", "t_submit",
-                 "t_batched", "abandoned", "klass", "rank", "seq")
+                 "t_batched", "abandoned", "klass", "rank", "seq",
+                 "ckey", "cost", "waiters", "guard")
 
     def __init__(self, images: np.ndarray, rows: int,
                  klass: Optional[str] = None, rank: int = 0,
-                 seq: int = 0) -> None:
+                 seq: int = 0, ckey: Optional[str] = None,
+                 cost: float = 1.0, guard=None) -> None:
         self.images = images
         self.rows = rows
         self.klass = klass
@@ -98,7 +100,16 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
         self.t_batched = self.t_submit
-        # Set by a caller whose result() wait timed out: still-queued
+        # In-flight collapsing (ISSUE 19): ``ckey`` is the request's
+        # collapse key while it owns a slot in the batcher's inflight-key
+        # map; ``waiters`` counts the callers (leader + collapsed
+        # followers) whose result() is riding this pending, guarded by
+        # ``guard`` (the batcher's _cv — shared, never a new lock).
+        self.ckey = ckey
+        self.cost = float(cost)
+        self.waiters = 1
+        self.guard = guard
+        # Set when EVERY caller's result() wait timed out: still-queued
         # abandoned requests are dropped before execution (no device work
         # for an answer nobody will read, no phantom /stats samples, and
         # the queue slot frees for admission control).
@@ -110,12 +121,24 @@ class _Pending:
         self.error = error
         if serve_log is not None and not self.abandoned:
             now = time.perf_counter()
-            serve_log.record_request(
-                latency_s=now - self.t_submit,
-                queue_wait_s=self.t_batched - self.t_submit,
-                images=self.rows,
-                klass=self.klass,
-            )
+            if self.guard is not None:
+                with self.guard:
+                    waiters = self.waiters
+            else:
+                waiters = self.waiters
+            # One record per caller still waiting: a collapsed follower
+            # is a served request exactly like a cache hit, so it must
+            # count in the per-model/class totals even though only one
+            # dispatch ran. waiters excludes callers that timed out
+            # (result() decrements on timeout), which is the honest
+            # count of replies actually delivered.
+            for _ in range(max(1, waiters)):
+                serve_log.record_request(
+                    latency_s=now - self.t_submit,
+                    queue_wait_s=self.t_batched - self.t_submit,
+                    images=self.rows,
+                    klass=self.klass,
+                )
         self.event.set()
 
 
@@ -151,6 +174,8 @@ class MicroBatcher:
         complete_fn: Optional[Callable] = None,
         max_inflight: int = 1,
         shed_policy=None,
+        cost_model=None,
+        priced: bool = False,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -181,6 +206,18 @@ class MicroBatcher:
         # Priority shedding (serve/control.py): None keeps the classic
         # single-class admission (full queue = 503) and FIFO order.
         self.shed_policy = shed_policy
+        # Request-path economics (serve/economics.py): with a CostModel
+        # attached the completion stage feeds it measured batch walls
+        # (the serve-time EWMA refresh); ``priced`` additionally switches
+        # admission depth, drain rate, and Retry-After to COST units —
+        # off (the default) is byte-identical to the count-based batcher.
+        self.cost_model = cost_model
+        self.priced = bool(priced)
+        # Collapse map: collapse_key -> the live _Pending duplicates
+        # join, guarded by _cv; entries leave before their event fires.
+        self._inflight_keys = {}
+        self.collapsed = 0
+        self._queue_cost = 0.0
         # Completion-side requests/sec over a sliding window — the
         # denominator every Retry-After hint is derived from.
         self._drain = DrainRate()
@@ -242,7 +279,9 @@ class MicroBatcher:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, images, klass: Optional[str] = None) -> _Pending:
+    def submit(self, images, klass: Optional[str] = None,
+               collapse_key: Optional[str] = None,
+               cost: float = 1.0) -> _Pending:
         """Enqueue one request. ``images`` must be a row-stack whose first
         dim is the example count (the server preprocesses through
         ``engine.preprocess`` first, so row counting and concatenation
@@ -260,7 +299,26 @@ class MicroBatcher:
         shed policy attached, admission additionally applies the
         class's queue watermark and the queue is kept priority-ordered
         (FIFO within a class) — an interactive arrival overtakes every
-        queued best_effort request."""
+        queued best_effort request.
+
+        ``collapse_key`` opts into in-flight collapsing: a submit whose
+        key matches a still-QUEUED (not yet dispatched, not abandoned)
+        pending JOINS it — no new queue slot, no re-dispatch; the
+        caller's ``result()`` rides the leader's event and sees the
+        same result or error (error fan-out reaches every joiner
+        exactly once, one raise per ``result()`` call). A follower
+        still passes ADMISSION first, at its own price: count-mode
+        depth counts every outstanding waiter (a collapsed client is
+        still an outstanding client, so a byte-identical flood sheds
+        at exactly the classic watermark), and quota accounting for
+        the follower's CLIENT is the server's job before this call.
+        Once a batch dispatches its key retires — a duplicate arriving
+        mid-execution queues normally and is answered by the response
+        cache one layer up after the leader completes. ``cost`` is the
+        request's admission price in cost units (``priced`` batchers
+        account queue depth, drain rate and Retry-After in these
+        units; the default 1.0 per request is byte-identical to count
+        accounting)."""
         arr = np.asarray(images)
         if arr.ndim < 2 or arr.shape[0] == 0:
             raise ValueError(
@@ -268,10 +326,25 @@ class MicroBatcher:
                 f"examples; got shape {arr.shape}")
         effective = klass or PRIORITY_CLASSES[0]
         rank = priority_rank(effective)
+        cost = float(cost)
         with self._cv:
             if self._stopped:
                 raise RuntimeError("batcher is shut down")
-            depth = len(self._queue)
+            if self.priced:
+                # Cost-unit depth: the queue's admitted cost plus this
+                # request's price beyond the 1.0 a count would charge —
+                # at cost 1.0 everywhere this IS the count depth. A
+                # would-be follower checks at its own price too; if it
+                # then joins, the queue's cost is untouched (it adds
+                # no compute).
+                depth = self._queue_cost + cost - 1.0
+            else:
+                # Outstanding-CLIENT depth: every waiter on a queued
+                # pending counts — a collapsed follower is still an
+                # outstanding request, so a byte-identical flood sheds
+                # at exactly the watermark a distinct flood would.
+                # Without collapsing this IS len(queue).
+                depth = sum(p.waiters for p in self._queue)
             if self.shed_policy is not None:
                 admitted = self.shed_policy.admits(
                     effective, depth, self.max_queue)
@@ -287,14 +360,27 @@ class MicroBatcher:
                     effective, self.max_queue)
                 retry_after = self.shed_policy.retry_after_s(
                     effective, depth, self.max_queue,
-                    self._drain.rate())
+                    self._drain.rate(), incoming=cost if self.priced
+                    else 1.0)
                 raise Overloaded(
                     f"request queue past the {effective!r} admission "
-                    f"watermark ({depth} pending, class limit {limit} "
+                    f"watermark ({depth:g} pending, class limit {limit} "
                     f"of {self.max_queue})", retry_after_s=retry_after)
+            if collapse_key is not None:
+                # Admitted — now a duplicate of a still-queued pending
+                # joins it instead of consuming a slot and a dispatch.
+                leader = self._inflight_keys.get(collapse_key)
+                if leader is not None and not leader.abandoned:
+                    leader.waiters += 1
+                    self.collapsed += 1
+                    return leader
             pending = _Pending(arr, int(arr.shape[0]), klass=klass,
-                               rank=rank, seq=self._seq)
+                               rank=rank, seq=self._seq,
+                               ckey=collapse_key, cost=cost,
+                               guard=self._cv)
             self._seq += 1
+            if collapse_key is not None:
+                self._inflight_keys[collapse_key] = pending
             # Priority insert, stable within a class: scan back from
             # the tail (same-or-more-urgent arrivals append in O(1),
             # the common case; an interactive request overtakes only
@@ -303,25 +389,38 @@ class MicroBatcher:
             while i > 0 and self._queue[i - 1].rank > rank:
                 i -= 1
             self._queue.insert(i, pending)
+            self._queue_cost += cost
             self._cv.notify_all()
         return pending
 
     @staticmethod
     def result(pending: _Pending, timeout: Optional[float] = None):
         if not pending.event.wait(timeout):
-            # Nobody will read the answer: if the request is still
-            # queued, the worker drops it instead of executing it (an
-            # already in-flight batch can't be recalled from the device).
-            pending.abandoned = True
+            # This caller will never read the answer — but a collapsed
+            # follower still might: only when the LAST waiter leaves is
+            # the pending abandoned (then, if still queued, the worker
+            # drops it instead of executing it; an already in-flight
+            # batch can't be recalled from the device).
+            if pending.guard is not None:
+                with pending.guard:
+                    pending.waiters -= 1
+                    if pending.waiters <= 0:
+                        pending.abandoned = True
+            else:
+                pending.abandoned = True
             raise TimeoutError("request did not complete in time")
         if pending.error is not None:
             raise pending.error
         return pending.result
 
     def predict(self, images, timeout: Optional[float] = 30.0,
-                klass: Optional[str] = None):
+                klass: Optional[str] = None,
+                collapse_key: Optional[str] = None, cost: float = 1.0):
         """Synchronous submit + wait — the HTTP handler's one call."""
-        return self.result(self.submit(images, klass=klass), timeout)
+        return self.result(
+            self.submit(images, klass=klass, collapse_key=collapse_key,
+                        cost=cost),
+            timeout)
 
     # -- worker side -------------------------------------------------------
 
@@ -374,9 +473,13 @@ class MicroBatcher:
                 while self._queue and rows < self.max_batch:
                     head = self._queue[0]
                     if head.abandoned:
-                        # Its caller timed out and left: drop without
+                        # Every caller timed out and left: drop without
                         # executing (finish() skips stats for abandoned).
                         self._queue.pop(0)
+                        self._queue_cost -= head.cost
+                        if head.ckey is not None and \
+                                self._inflight_keys.get(head.ckey) is head:
+                            del self._inflight_keys[head.ckey]
                         head.finish(None, TimeoutError("abandoned"),
                                     self.serve_log)
                         continue
@@ -394,8 +497,19 @@ class MicroBatcher:
                                   != taken[0].images.dtype):
                         break
                     self._queue.pop(0)
+                    self._queue_cost -= head.cost
+                    if head.ckey is not None and \
+                            self._inflight_keys.get(head.ckey) is head:
+                        # Collapse window closes AT DISPATCH: a
+                        # duplicate arriving mid-execution queues
+                        # normally (and the response cache answers it
+                        # after this batch completes) — it must never
+                        # ride a result that predates a param swap.
+                        del self._inflight_keys[head.ckey]
                     taken.append(head)
                     rows += head.rows
+                if not self._queue:
+                    self._queue_cost = 0.0  # re-zero any float drift
                 if not taken:
                     continue  # everything seen was abandoned: wait again
                 t = time.perf_counter()
@@ -475,11 +589,20 @@ class MicroBatcher:
             for p in taken:
                 p.finish(None, error, self.serve_log)
             return
+        if self.cost_model is not None:
+            # Serve-time EWMA refresh of the per-bucket cost table: the
+            # measured wall from batch formation to delivered results.
+            self.cost_model.observe(
+                sum(p.rows for p in taken),
+                time.perf_counter() - taken[0].t_batched)
         off = 0
         for p in taken:
             p.finish(out[off:off + p.rows], None, self.serve_log)
             off += p.rows
         # Completed requests feed the drain-rate estimate Retry-After
         # hints divide by (errors excluded: a failing plane is not
-        # drain capacity).
-        self._drain.note(len(taken))
+        # drain capacity). Priced batchers drain COST units, so the
+        # hint says when the drained cost plausibly re-admits, not the
+        # drained request count.
+        self._drain.note(sum(p.cost for p in taken) if self.priced
+                         else len(taken))

@@ -131,6 +131,12 @@ class ShadowCanary:
         self._publishes = 0
         self._promotions = 0
         self._rollbacks = 0
+        # Swap hooks (ISSUE 19): run under the canary lock on every
+        # publish reset AND on a promotion — the two transitions that
+        # change what a repeated request would be answered with
+        # (rollback keeps the baseline answering, so it needs no
+        # invalidation). O(1) arithmetic only.
+        self._swap_hooks = []
         self._deltas = collections.deque(maxlen=max_delta_samples)
         self._reset_counters_locked()
 
@@ -192,8 +198,17 @@ class ShadowCanary:
             self._state = SHADOW
             self._publishes += 1
             self._reset_counters_locked()
+            for hook in self._swap_hooks:
+                hook(epoch)
         self._record_event("reset", previous_state=prev, epoch=epoch)
         return installed
+
+    def add_swap_hook(self, hook) -> None:
+        """Register ``hook(epoch)`` to run under the canary lock on each
+        publish reset and on promotion (the response cache's
+        ``bump_generation`` seam — O(1) arithmetic only)."""
+        with self._lock:
+            self._swap_hooks.append(hook)
 
     # -- dispatch / complete ----------------------------------------------
 
@@ -305,6 +320,10 @@ class ShadowCanary:
         if self._compared_rows >= self.promote_after:
             self._state = PRIMARY
             self._promotions += 1
+            # The answering plane just changed: cached baseline answers
+            # must not outlive the promote.
+            for hook in self._swap_hooks:
+                hook(None)
             return "promoted"
         return None
 

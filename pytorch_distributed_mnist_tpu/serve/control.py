@@ -119,32 +119,39 @@ class ShedPolicy:
         return depth < self.admit_depth(klass, max_queue)
 
     def retry_after_s(self, klass: str, depth: int, max_queue: int,
-                      drain_rps: float) -> float:
+                      drain_rps: float, incoming: float = 1.0) -> float:
         """Seconds until the queue plausibly re-admits ``klass``: the
-        requests above its watermark divided by the measured drain
-        rate. Clamped to [0.1, 30] — an idle-drain estimate of hours is
-        not a useful client hint, and sub-100ms retries just re-offer
-        the overload."""
-        over = depth - self.admit_depth(klass, max_queue) + 1
+        load above its watermark divided by the measured drain rate.
+        ``depth``, ``drain_rps`` and ``incoming`` (the refused
+        request's own price) share ONE unit — request counts by
+        default, cost units when the batcher prices admission — so a
+        cost-priced 503's hint derives from drained COST, not drained
+        count. Clamped to [0.1, 30] — an idle-drain estimate of hours
+        is not a useful client hint, and sub-100ms retries just
+        re-offer the overload."""
+        over = depth - self.admit_depth(klass, max_queue) + float(incoming)
         rate = max(float(drain_rps), 1.0)
         return round(min(30.0, max(0.1, over / rate)), 3)
 
 
 class DrainRate:
-    """Requests-per-second the data plane is actually completing, over a
+    """Units-per-second the data plane is actually completing, over a
     short sliding window — the denominator of every ``Retry-After``.
+    The unit is whatever the caller notes: request counts by default,
+    COST units on a priced batcher (fractional notes are preserved — a
+    drained cache hit at ~0 cost must not round up to a full request).
     Thread-safe; the batcher's completion stage notes each delivered
-    request."""
+    batch."""
 
     def __init__(self, window_s: float = 10.0) -> None:
         self._lock = threading.Lock()
         self.window_s = float(window_s)
         self._events: collections.deque = collections.deque(maxlen=4096)
 
-    def note(self, n: int = 1, now: Optional[float] = None) -> None:
+    def note(self, n: float = 1, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         with self._lock:
-            self._events.append((now, int(n)))
+            self._events.append((now, float(n)))
 
     def rate(self, now: Optional[float] = None) -> float:
         now = time.monotonic() if now is None else now
@@ -252,11 +259,17 @@ class ClientQuotas:
         return any(r > 0 for r in self.rps_by_class.values())
 
     def admit(self, client_id: Optional[str], klass: str,
-              now: Optional[float] = None) -> Tuple[bool, float]:
-        """``(admitted, retry_after_s)`` for one request. Arithmetic
-        only under the lock — never a sleep, never IO (a handler thread
-        parked inside here would hold queue capacity hostage to the
-        very client being limited)."""
+              now: Optional[float] = None,
+              cost: float = 1.0) -> Tuple[bool, float]:
+        """``(admitted, retry_after_s)`` for one request. ``cost`` is
+        the request's price in cost units (the TokenBucket was always
+        cost-capable; a cost-pricing server finally wires real prices
+        through — an expensive-bucket request spends its measured
+        multiple, a cache hit spends ~0, and the default 1.0 keeps
+        count-based quotas byte-identical). Arithmetic only under the
+        lock — never a sleep, never IO (a handler thread parked inside
+        here would hold queue capacity hostage to the very client being
+        limited)."""
         rate = self.rps_by_class.get(klass, 0.0)
         if rate <= 0:
             return True, 0.0
@@ -272,7 +285,7 @@ class ClientQuotas:
                 self._buckets.move_to_end(key)
             while len(self._buckets) > self.max_clients:
                 self._buckets.popitem(last=False)
-            admitted, retry_after = bucket.admit(now=now)
+            admitted, retry_after = bucket.admit(now=now, cost=cost)
             if not admitted:
                 self._rejected += 1
         return admitted, retry_after

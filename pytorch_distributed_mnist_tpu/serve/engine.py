@@ -65,7 +65,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import warnings
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -409,6 +409,12 @@ class InferenceEngine:
         # Committed to device once per swap, not once per request.
         self._params = self._place(params)
         self._params_epoch = params_epoch
+        # Swap hooks (ISSUE 19): called UNDER _lock right after an
+        # install, so cache-generation bumps are atomic with the params
+        # swap — no request can hit a pre-swap cache entry after the
+        # new params are visible. Hooks must be O(1) arithmetic
+        # (ResponseCache.bump_generation is one integer increment).
+        self._swap_hooks: List[Callable] = []
         self._compiled = {}  # bucket -> Compiled executable
         # bucket -> free staging buffers (see module docstring lifecycle).
         self._staging = StagingPool(self.buckets, self.input_shape,
@@ -506,6 +512,14 @@ class InferenceEngine:
                     self._fused_jit, params_spec, raw_spec,
                     program=self.fused_program_name(bucket))
 
+    def add_swap_hook(self, hook: Callable) -> None:
+        """Register ``hook(epoch)`` to run UNDER the params lock each
+        time a swap installs (hot reload / precision swap): the
+        response cache's ``bump_generation`` seam — atomic with the
+        install, O(1) arithmetic only."""
+        with self._lock:
+            self._swap_hooks.append(hook)
+
     def swap_params(self, params, epoch: Optional[int] = None,
                     path: Optional[str] = None) -> bool:
         """Atomically install new params (checkpoint hot-reload); the
@@ -531,6 +545,8 @@ class InferenceEngine:
                 return False  # a newer checkpoint already installed
             self._params = placed
             self._params_epoch = epoch
+            for hook in self._swap_hooks:
+                hook(epoch)
             return True
 
     # -- inference ---------------------------------------------------------

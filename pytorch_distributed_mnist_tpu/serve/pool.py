@@ -78,7 +78,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -263,6 +263,11 @@ class EnginePool:
         # boot-time params after a hot reload moved the fleet on.
         self._params_host = params
         self._params_host_epoch = params_epoch
+        # Swap hooks (ISSUE 19): run under the pool lock AFTER a reload
+        # fan-out completes — once every routable replica answers on the
+        # new params, a cache generation bump retires every entry whose
+        # compute could predate the swap. O(1) arithmetic only.
+        self._swap_hooks: List[Callable] = []
         # Topology bookkeeping (pool lock): generation bumps on every
         # quarantine/regroup/resize so /stats can say "the shape
         # changed" without diffing replica rows.
@@ -453,7 +458,21 @@ class EnginePool:
         for replica in replicas:
             if replica.engine.swap_params(params, epoch=epoch, path=path):
                 installed += 1
+        # Generation bump AFTER the whole fan-out (under the pool lock):
+        # an entry inserted mid-fan-out captured the pre-bump generation
+        # and is dropped at put; anything probed after this bump
+        # computes on replicas that all hold the new params.
+        with self._lock:
+            for hook in self._swap_hooks:
+                hook(epoch)
         return installed
+
+    def add_swap_hook(self, hook: Callable) -> None:
+        """Register ``hook(epoch)`` to run under the pool lock after
+        each reload fan-out (the response cache's ``bump_generation``
+        seam — O(1) arithmetic only)."""
+        with self._lock:
+            self._swap_hooks.append(hook)
 
     # -- dispatch / complete ----------------------------------------------
 

@@ -81,6 +81,15 @@ import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+# The shared request-economics layer (serve/economics.py) is pure
+# stdlib BY CONTRACT — the router reuses the backend's exact-match
+# keyed cache and generation-invalidation rule without growing a
+# jax/numpy dependency.
+from pytorch_distributed_mnist_tpu.serve.economics import (
+    ResponseCache,
+    request_key,
+)
+
 # Mirrors serve/control.py::PRIORITY_CLASSES without importing it (that
 # module imports numpy; the router is stdlib-only). The backend remains
 # the authority — an unknown class forwarded anyway comes back 400.
@@ -712,13 +721,19 @@ class Fleet:
 
     def __init__(self, quarantine_after: int = 3,
                  probation_successes: int = 3, hash_replicas: int = 64,
-                 on_event: Optional[Callable[..., None]] = None) -> None:
+                 on_event: Optional[Callable[..., None]] = None,
+                 cache: Optional[ResponseCache] = None) -> None:
         self._lock = threading.Lock()
         self._backends: Dict[str, Backend] = {}
         self._ring = HashRing(replicas=hash_replicas)
         self.quarantine_after = quarantine_after
         self.probation_successes = probation_successes
         self._on_event = on_event
+        # The router's response cache: invalidated (generation bump)
+        # whenever the health poller observes ANY backend's serving
+        # epoch change — a rollout/reload on one backend means a cached
+        # reply anywhere in the fleet may now be stale.
+        self._cache = cache
         self.failovers = 0
         self.retries = 0
         self.fleet_503s = 0
@@ -801,6 +816,7 @@ class Fleet:
         /healthz view, all under the lock; the transition event is
         emitted after it drops."""
         transition = None
+        epoch_changed = False
         with self._lock:
             backend = self._backends.get(name)
             if backend is None:
@@ -810,7 +826,9 @@ class Fleet:
                 self._ring.add(name)
             if info is not None:
                 epoch = info.get("model_epoch")
-                backend.epoch = int(epoch) if epoch is not None else None
+                new_epoch = int(epoch) if epoch is not None else None
+                epoch_changed = new_epoch != backend.epoch
+                backend.epoch = new_epoch
                 backend.draining = bool(info.get("draining", False))
                 models = info.get("models")
                 if isinstance(models, dict):
@@ -818,6 +836,12 @@ class Fleet:
                 elif info.get("model"):
                     backend.models = {info["model"]}
                 backend.last_error = None
+        if epoch_changed and self._cache is not None:
+            # Invalidation rides the poller's observation (same idiom
+            # as _emit: the cache's own lock, taken OUTSIDE the table
+            # lock): any backend epoch change makes every router entry
+            # unreachable in O(1).
+            self._cache.bump_generation()
         if transition == PROBATION:
             self._emit("fleet_probation", backend=name)
         elif transition == HEALTHY:
@@ -1287,10 +1311,12 @@ class RouterContext:
                  drain_timeout_s: float = 30.0,
                  verify_timeout_s: float = 60.0,
                  fleet_autoscaler: Optional[FleetAutoscaler] = None,
-                 spawn_template: Optional[str] = None) -> None:
+                 spawn_template: Optional[str] = None,
+                 cache: Optional[ResponseCache] = None) -> None:
         self.fleet = fleet
         self.poller = poller
         self.sink = sink
+        self.cache = cache
         self.log = RouterLog()
         self.connect_timeout = float(connect_timeout)
         self.read_timeout = float(read_timeout)
@@ -1757,6 +1783,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             },
             "health_poller": ctx.poller.snapshot(),
         }
+        if ctx.cache is not None and ctx.cache.enabled:
+            out["cache"] = ctx.cache.snapshot()
         if ctx.canary is not None:
             out["fleet_canary"] = ctx.canary.snapshot()
         if ctx.last_rollout is not None:
@@ -1812,6 +1840,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # (its reply would carry the unjudged epoch).
                 within = {b.name for b in ctx.fleet.backends()
                           if b.name not in cohort}
+        # Router response cache (request-path economics, the same keyed
+        # cache as the backends'): exact-byte repeats replay the cached
+        # 200 body without a dispatch. Disabled for the duration of a
+        # fleet canary SHADOW — cohort replies carry the unjudged
+        # epoch, and a cache would leak them across cohorts.
+        cache = ctx.cache if ctx.cache is not None and ctx.cache.enabled \
+            and within is None else None
+        ckey, gen = None, 0
+        if cache is not None:
+            ckey = request_key(raw, model, "fleet", "route")
+            hit_body, _hit_epoch, gen = cache.get(ckey)
+            if hit_body is not None:
+                ctx.log.record(time.perf_counter() - t0, 200, klass)
+                self._reply_raw(200, hit_body,
+                                headers={"X-Cache": "hit"})
+                return
         exclude: Set[str] = set()
         attempt = 0
         while True:
@@ -1888,6 +1932,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # measured drain rate and the router has no better
                 # information.
                 passthrough["Retry-After"] = headers["Retry-After"]
+            if cache is not None and status == 200:
+                # Insert stamped with the probe-time generation: a
+                # backend epoch change the poller observed mid-flight
+                # bumped it, and put() drops this (possibly-stale)
+                # body instead of installing it.
+                cache.put(ckey, body, len(body) + 64,
+                          epoch=backend.epoch, generation=gen)
+                passthrough["X-Cache"] = "miss"
             self._reply_raw(status, body, headers=passthrough)
             return
 
@@ -2029,6 +2081,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "router appends --port 0 and parses the bound "
                         "port). Without it --fleet-min/max only RECORD "
                         "decisions (dry run)")
+    p.add_argument("--cache-mb", type=float, default=0.0,
+                   help="router response-cache byte budget in MB "
+                        "(bounded LRU, same keyed cache as the "
+                        "backends'): an exact-byte repeat of a routed "
+                        "/predict replays the cached 200 body without "
+                        "a backend dispatch; ANY backend epoch change "
+                        "the health poller observes invalidates every "
+                        "entry in O(1). Default 0 = DISABLED: a "
+                        "router cache also starves the per-backend "
+                        "load signal the fleet tier routes on, so it "
+                        "is an explicit opt-in (the backends' own "
+                        "caches already absorb duplicates fleet-wide)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the router response cache")
     p.add_argument("--metrics-file", type=str, default=None,
                    help="append router JSONL events (quarantines, "
                         "failovers, rollout steps, canary verdicts, "
@@ -2062,9 +2128,14 @@ def create_router(args) -> ThreadingHTTPServer:
         if ctx_ref:
             ctx_ref[0].event(kind, **fields)
 
+    cache_mb = float(getattr(args, "cache_mb", 64.0) or 0.0)
+    if getattr(args, "no_cache", False) or cache_mb < 0:
+        cache_mb = 0.0
+    cache = ResponseCache(int(cache_mb * (1 << 20)))
     fleet = Fleet(quarantine_after=args.quarantine_after,
                   probation_successes=args.probation_successes,
-                  hash_replicas=args.hash_replicas, on_event=_emit)
+                  hash_replicas=args.hash_replicas, on_event=_emit,
+                  cache=cache if cache.enabled else None)
     for url in backends:
         fleet.add(url)
     poller = HealthPoller(fleet, interval_s=args.health_interval,
@@ -2087,7 +2158,8 @@ def create_router(args) -> ThreadingHTTPServer:
         drain_timeout_s=args.drain_timeout_s,
         verify_timeout_s=args.verify_timeout_s,
         fleet_autoscaler=scaler,
-        spawn_template=args.spawn_backend)
+        spawn_template=args.spawn_backend,
+        cache=cache if cache.enabled else None)
     ctx_ref.append(ctx)
     if scaler is not None and args.spawn_backend:
         scaler.start_fn = ctx.spawn_backend

@@ -72,7 +72,16 @@ from pytorch_distributed_mnist_tpu.serve.engine import (
     InferenceEngine,
     load_params_for_serving,
 )
+from pytorch_distributed_mnist_tpu.serve.canary import (
+    SHADOW as CANARY_SHADOW,
+)
 from pytorch_distributed_mnist_tpu.serve.canary import ShadowCanary
+from pytorch_distributed_mnist_tpu.serve.economics import (
+    HIT_COST,
+    CostModel,
+    ResponseCache,
+    request_key,
+)
 from pytorch_distributed_mnist_tpu.serve.programs import (
     precision_engine_name,
     serve_modes,
@@ -305,6 +314,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "devices")
     p.add_argument("--autoscale-max-devices", type=int, default=0,
                    help="autoscaler ceiling (0 = all local devices)")
+    p.add_argument("--cache-mb", type=float, default=64.0,
+                   help="response-cache byte budget in MB (bounded LRU): "
+                        "an exact-byte repeat of a served request — same "
+                        "raw body, model, serve mode and precision — "
+                        "answers from the cache without touching the "
+                        "batcher or a chip. Entries are stamped with a "
+                        "generation counter bumped atomically under the "
+                        "param-swap lock, so a hot reload / precision "
+                        "swap / canary promote invalidates the whole "
+                        "cache in O(1) — a stale logit can never be "
+                        "served. 0 disables (same as --no-cache)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the response cache (and in-flight "
+                        "request collapsing keeps working — identical "
+                        "concurrent requests still share one compute). "
+                        "Replies are byte-identical to the cached path; "
+                        "only the X-Cache header and the /stats cache "
+                        "block disappear")
+    p.add_argument("--price-admission", action="store_true",
+                   help="cost-priced admission: each request is priced "
+                        "in measured step-cost units (per-bucket bench "
+                        "seed refreshed by an online EWMA at serve "
+                        "time) instead of counting 1 per request — "
+                        "queue watermarks, per-client quotas and "
+                        "Retry-After all account in cost units, and a "
+                        "cache hit prices at ~0. Default off: every "
+                        "request costs 1.0, byte-identical to the "
+                        "classic count-based admission")
     p.add_argument("--max-request-images", type=int, default=1024,
                    help="reject /predict requests with more images than "
                         "this (400): one giant request occupies a single "
@@ -368,6 +405,20 @@ def build_parser() -> argparse.ArgumentParser:
 # One oversized body must not buy unbounded JSON parsing on a handler
 # thread; 16 MB comfortably fits --max-request-images' worth of pixels.
 MAX_BODY_BYTES = 16 << 20
+
+
+def _estimate_rows(images) -> int:
+    """Cheap pure-Python row-count estimate for ADMISSION PRICING only
+    (len/isinstance — no numpy before the quota gate): a multi-image
+    request is a list whose first element is itself a 2-D image (list
+    of lists); anything else prices as one row. The engine's
+    preprocess still decides the real shape (and 400s malformed
+    bodies); the batcher re-prices at the real row count."""
+    if isinstance(images, list) and images \
+            and isinstance(images[0], list) \
+            and images[0] and isinstance(images[0][0], list):
+        return len(images)
+    return 1
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -434,13 +485,20 @@ class ServeContext:
                  max_inflight: int = 1,
                  serve_mode: str = "replicated",
                  serve_precision: str = "f32",
-                 quotas=None, fair_gate=None, fused: bool = True) -> None:
+                 quotas=None, fair_gate=None, fused: bool = True,
+                 cache=None, price_admission: bool = False) -> None:
         self.planes = planes
         self.default_model = default_model
         self.sink = sink
         self.max_request_images = max_request_images
         self.serve_mode = serve_mode
         self.serve_precision = serve_precision
+        # Request-path economics (DESIGN.md §7n): the epoch-stamped
+        # response cache shared by every plane (keys carry the model
+        # name, so one budget serves the whole process) and whether
+        # admission accounts in measured cost units.
+        self.cache = cache
+        self.price_admission = bool(price_admission)
         # Which dispatch plane answers raw uint8 requests: fused
         # whole-program (default) or the --no-fuse split reference.
         self.fused = fused
@@ -549,6 +607,10 @@ class ServeContext:
         print(f"registered backend {url} in {register_dir}", flush=True)
 
     def write_all_stats(self, **extra) -> None:
+        if self.cache is not None and self.cache.enabled:
+            # The cache block rides the periodic serve_stats JSONL
+            # lines (PR 3 sink) — no separate event stream to tail.
+            extra.setdefault("cache", self.cache.snapshot())
         for plane in self.planes.values():
             plane.serve_log.write_stats(**extra)
 
@@ -649,6 +711,17 @@ class _Handler(BaseHTTPRequestHandler):
             # pool's replicas — never re-listed for reuse.
             src = plane.pool if plane.pool is not None else plane.engine
             stats["donated_staging_retired"] = src.fused_staging_retired()
+        if ctx.cache is not None and ctx.cache.enabled:
+            # Request-path economics block: cache hit/miss/eviction
+            # counters, the invalidation generation, and how many
+            # duplicate in-flight requests collapsed onto one compute.
+            cache_block = ctx.cache.snapshot()
+            cache_block["collapsed"] = plane.batcher.collapsed
+            stats["cache"] = cache_block
+        if ctx.price_admission and plane.batcher.cost_model is not None:
+            # Cost-table provenance: per-bucket prices (bench seed
+            # refreshed by the serve-time EWMA) admission accounts in.
+            stats["cost_model"] = plane.batcher.cost_model.snapshot()
         if plane.canary is not None:
             # The shadow-canary block: state machine position,
             # sampling shape, disagreement counters, logit-delta
@@ -760,12 +833,37 @@ class _Handler(BaseHTTPRequestHandler):
                     data = f.read()
             except OSError:
                 continue
+            # `Range: bytes=N-` resumes a torn fetch from byte N
+            # (DeltaFetcher retries a mid-body disconnect with the
+            # partial offset instead of re-downloading): 206 + a
+            # Content-Range naming the suffix; N past the end is 416.
+            # Content addressing makes this trivially safe — the bytes
+            # behind a digest can never change between attempts. A
+            # malformed/unsupported Range falls back to the full 200.
+            start = 0
+            range_header = (self.headers.get("Range") or "").strip()
+            if range_header:
+                match = _re.fullmatch(r"bytes=(\d+)-", range_header)
+                if match:
+                    start = int(match.group(1))
+                    if start >= len(data):
+                        self._reply(
+                            416, {"error": f"range start {start} past "
+                                           f"chunk end {len(data)}"},
+                            headers={"Content-Range":
+                                     f"bytes */{len(data)}"})
+                        return
+            body = data[start:] if start else data
             try:
-                self.send_response(200)
+                self.send_response(206 if start else 200)
                 self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Content-Length", str(len(body)))
+                if start:
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {start}-{len(data) - 1}/{len(data)}")
                 self.end_headers()
-                self.wfile.write(data)
+                self.wfile.write(body)
             except OSError:
                 pass  # client went away mid-transfer
             return
@@ -856,8 +954,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(413, {"error": f"body over {MAX_BODY_BYTES} bytes;"
                                        f" batch client-side"})
             return
+        raw_body = self.rfile.read(length) or b"{}"
         try:
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = json.loads(raw_body)
             # Control-plane fields first, all cheap string work: the
             # model route, the priority class (vocabulary-checked), and
             # the client identity — so quota refusal below happens
@@ -876,12 +975,44 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, json.JSONDecodeError) as exc:
             self._reply(400, {"error": str(exc)})
             return
+        # Response-cache probe (still pure byte/hash work — no numpy):
+        # the key is the RAW request bytes plus everything else that
+        # shapes the answer (model, serve mode, precision); the probe
+        # snapshots the invalidation generation so an insert after a
+        # concurrent swap is dropped, never served stale.
+        cache = ctx.cache if ctx.cache is not None and ctx.cache.enabled \
+            else None
+        if cache is not None and plane.canary is not None \
+                and plane.canary.state == CANARY_SHADOW:
+            # A SHADOW canary judges only dispatched traffic: serving
+            # duplicates from cache (or collapsing them onto one
+            # dispatch — the key is also the collapse key) would starve
+            # the comparison stream and stall promotion. Same rule as
+            # the router during a fleet canary; normal caching resumes
+            # on promote or rollback.
+            cache = None
+        ckey, hit_value, gen = None, None, 0
+        if cache is not None:
+            ckey = request_key(raw_body, plane.model_name,
+                               ctx.serve_mode, ctx.serve_precision)
+            hit_value, _hit_epoch, gen = cache.get(ckey)
         if ctx.quotas is not None:
             # Per-client quotas run BEFORE the request consumes a queue
             # slot (or any preprocessing): 429 is the CLIENT's overload
-            # — admission control (503 below) is the server's.
+            # — admission control (503 below) is the server's. Under
+            # --price-admission the bucket drains in measured cost
+            # units: a cache hit is ~free, a big-bucket miss costs its
+            # bench/EWMA price (row count estimated from JSON nesting —
+            # cheap; the engine still decides the real shape below).
+            cost = 1.0
+            if ctx.price_admission:
+                if hit_value is not None:
+                    cost = HIT_COST
+                elif plane.batcher.cost_model is not None:
+                    cost = plane.batcher.cost_model.price(
+                        _estimate_rows(payload.get("images")))
             admitted, retry_after = ctx.quotas.admit(
-                client_id, klass or PRIORITY_CLASSES[0])
+                client_id, klass or PRIORITY_CLASSES[0], cost=cost)
             if not admitted:
                 plane.serve_log.record_rejection(klass=klass, quota=True)
                 self._reply(
@@ -891,6 +1022,28 @@ class _Handler(BaseHTTPRequestHandler):
                      "retry_after_s": retry_after},
                     headers={"Retry-After": max(1, round(retry_after))})
                 return
+        if hit_value is not None:
+            # Cache hit: replay the stored predictions + epoch without
+            # touching the batcher or a chip. The body is built by the
+            # SAME code path as a miss (latency_ms is per-request
+            # either way); only the X-Cache header differs. A hit is
+            # still a SERVED request — it counts in the ServeLog like
+            # any other (zero queue wait), so request totals, rps and
+            # the rolling window the autoscaler reads stay honest.
+            predictions, hit_epoch = hit_value
+            latency_s = time.perf_counter() - t0
+            plane.serve_log.record_request(
+                latency_s, queue_wait_s=0.0,
+                images=len(predictions), klass=klass)
+            reply = {
+                "predictions": list(predictions),
+                "model_epoch": hit_epoch,
+                "latency_ms": round(latency_s * 1e3, 3),
+            }
+            if ctx.multi_model:
+                reply["model"] = plane.model_name
+            self._reply(200, reply, headers={"X-Cache": "hit"})
+            return
         try:
             images = payload.get("images")
             if images is None:
@@ -914,8 +1067,17 @@ class _Handler(BaseHTTPRequestHandler):
             # Each output row is (label, epoch-of-the-params-that-
             # computed-it) — see create_server's infer wrapper — so the
             # reply can never attribute a batch to a checkpoint a
-            # concurrent hot reload installed after it ran.
-            out = plane.batcher.predict(batch, klass=klass)
+            # concurrent hot reload installed after it ran. The cache
+            # key doubles as the collapse key: a concurrent identical
+            # request joins this one's pending future instead of
+            # re-dispatching (it already paid quota at its own price).
+            submit_cost = 1.0
+            if ctx.price_admission and plane.batcher.cost_model is not None:
+                submit_cost = plane.batcher.cost_model.price(
+                    int(batch.shape[0]))
+            out = plane.batcher.predict(batch, klass=klass,
+                                        collapse_key=ckey,
+                                        cost=submit_cost)
         except Overloaded as exc:
             # The shed reply: Retry-After (derived from the batcher's
             # measured drain rate) tells the client when this priority
@@ -936,14 +1098,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": repr(exc)})
             return
         epoch = int(out[0, 1])
+        model_epoch = None if epoch < 0 else epoch
+        predictions = [int(v) for v in out[:, 0]]
         reply = {
-            "predictions": [int(v) for v in out[:, 0]],
-            "model_epoch": None if epoch < 0 else epoch,
+            "predictions": predictions,
+            "model_epoch": model_epoch,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
         }
         if ctx.multi_model:
             reply["model"] = plane.model_name
-        self._reply(200, reply)
+        headers = None
+        if cache is not None:
+            # Insert stamped with the PROBE-TIME generation: if a hot
+            # reload / precision swap / canary promote bumped it while
+            # this request computed, put() drops the entry — the cache
+            # can only ever replay the current generation's params.
+            cache.put(ckey, (predictions, model_epoch),
+                      len(raw_body) + 16 * len(predictions) + 64,
+                      epoch=model_epoch, generation=gen)
+            headers = {"X-Cache": "miss"}
+        self._reply(200, reply, headers=headers)
 
     def _do_resize(self) -> None:
         """``POST /resize`` — the admin topology dial: body
@@ -1292,6 +1466,11 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
     t0 = time.perf_counter()
     pool = None
     canary = None
+    # Request-path economics: the per-bucket cost table (seeded from
+    # the bucket geometry, EWMA-refreshed by the batcher per completed
+    # batch) and whether admission accounts in its cost units.
+    cost_model = CostModel(_parse_buckets(args.buckets))
+    priced = bool(getattr(args, "price_admission", False))
 
     def _model_for(precision: str):
         """The model instance one precision plane lowers: the int8
@@ -1366,6 +1545,7 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
             dispatch_fn=_gated(canary.dispatch),
             complete_fn=lambda handle: _tag(*canary.predict_complete(handle)),
             max_inflight=max_inflight, shed_policy=shed_policy,
+            cost_model=cost_model, priced=priced,
         ).start()
     elif pooled:
         pool = _make_plane(serve_precision)
@@ -1378,6 +1558,7 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
             dispatch_fn=_gated(pool.dispatch),
             complete_fn=lambda handle: _tag(*pool.predict_complete(handle)),
             max_inflight=max_inflight, shed_policy=shed_policy,
+            cost_model=cost_model, priced=priced,
         ).start()
     else:
         engine = _make_plane(serve_precision)
@@ -1390,6 +1571,7 @@ def _build_plane(args, model_name: str, checkpoint_dir: str, *,
             _gated(infer), max_batch=engine.max_batch,
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
             serve_log=serve_log, shed_policy=shed_policy,
+            cost_model=cost_model, priced=priced,
         ).start()
     stats = compile_log.stats()["programs"]
     compiled_ms = sum(rec["wall_ms"] for name, rec in stats.items()
@@ -1671,6 +1853,20 @@ def create_server(args) -> ThreadingHTTPServer:
             shed_policy=shed_policy, fair_gate=fair_gate,
             multi_model=multi_model)
     default_model = next(iter(model_dirs))
+    # Response cache (request-path economics): one shared budget for
+    # the whole process — keys carry the model name, so planes cannot
+    # collide. The invalidation hook registers on every plane's
+    # answering engine (pool/canary/engine all expose add_swap_hook):
+    # a hot reload, precision swap, or canary promote bumps the
+    # generation under that plane's params lock — O(1), atomic with
+    # the swap the entries must not outlive.
+    cache_mb = float(getattr(args, "cache_mb", 64.0) or 0.0)
+    if getattr(args, "no_cache", False) or cache_mb < 0:
+        cache_mb = 0.0
+    resp_cache = ResponseCache(int(cache_mb * (1 << 20)))
+    if resp_cache.enabled:
+        for plane in planes.values():
+            plane.engine.add_swap_hook(resp_cache.bump_generation)
     if multi_model:
         print(f"multi-model serving: {sorted(planes)} from one "
               f"{n_devices}-device budget (weighted-fair dispatch "
@@ -1685,7 +1881,9 @@ def create_server(args) -> ThreadingHTTPServer:
         max_inflight=max_inflight, serve_mode=serve_mode,
         serve_precision=getattr(args, "serve_precision", "f32") or "f32",
         quotas=quotas, fair_gate=fair_gate,
-        fused=not getattr(args, "no_fuse", False))
+        fused=not getattr(args, "no_fuse", False),
+        cache=resp_cache if resp_cache.enabled else None,
+        price_admission=getattr(args, "price_admission", False))
     register_dir = getattr(args, "register_dir", None)
     if register_dir:
         # Announce AFTER the socket is bound (the real port is known —

@@ -1125,3 +1125,88 @@ def test_distrib_package_clean_and_lock_free():
         baseline=None)
     assert result.findings == []
     assert result.reports["lock-discipline"]["lock_graph"] == {}
+
+
+# -- ISSUE 19: response-cache lock discipline ---------------------------------
+
+
+def test_fires_on_device_get_under_cache_lock():
+    """FIRING: fetching logits off-device while holding the cache lock
+    serializes every cache reader behind a D2H transfer. The cache
+    contract is arithmetic-only under the lock — payloads arrive
+    already built."""
+    src = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def insert(self, key, handle):
+        with self._lock:
+            self._entries[key] = jax.device_get(handle)
+"""
+    (f,) = _findings(src)
+    assert "device-to-host" in f.message and "Cache._lock" in f.message
+
+
+def test_fires_on_network_fetch_under_cache_lock():
+    """FIRING: the router variant — a backend round-trip under the
+    router cache lock stalls every concurrent hit probe."""
+    src = """
+import threading
+
+class RouterCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def fill(self, key, url):
+        with self._lock:
+            self._entries[key] = urllib.request.urlopen(url).read()
+"""
+    (f,) = _findings(src)
+    assert "network IO" in f.message and "RouterCache._lock" in f.message
+
+
+def test_silent_on_snapshot_then_insert():
+    """NON-FIRING twin: the shipped economics shape — probe under the
+    lock capturing the generation, compute/serialize OUTSIDE it, then a
+    generation-checked arithmetic-only insert."""
+    src = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._generation = 0
+
+    def probe(self, key):
+        with self._lock:
+            return self._entries.get(key), self._generation
+
+    def fill(self, key, handle, generation):
+        payload = jax.device_get(handle)
+        with self._lock:
+            if generation != self._generation:
+                return False
+            self._entries[key] = payload
+            return True
+"""
+    assert _findings(src) == []
+
+
+def test_economics_module_clean_and_arithmetic_only():
+    """ISSUE 19 acceptance: serve/economics.py holds its lock for
+    dict/counter arithmetic only — clean under every behavior checker
+    (and jax-import-free, which trace-purity would flag instantly if a
+    device call snuck in)."""
+    result = run_analysis(
+        [os.path.join(_REPO, "pytorch_distributed_mnist_tpu", "serve",
+                      "economics.py")],
+        checkers=["lock-discipline", "trace-purity", "collective-symmetry",
+                  "agreement-except-breadth", "recompile-hazard"],
+        baseline=None)
+    assert result.findings == []

@@ -32,6 +32,8 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
         "BENCH_FLEET_SECONDS": "0.6",
         "BENCH_FLEET_PAIRS": "2",
         "BENCH_FLEET_REQUESTS": "24",
+        "BENCH_ECONOMICS_SECONDS": "0.6",
+        "BENCH_ECONOMICS_REQUESTS": "48",
         "BENCH_COMPILE_CACHE": "",
         "TPUMNIST_COMPILE_CACHE": "",
     })
@@ -225,6 +227,34 @@ def test_bench_serve_reports_scaling_and_pipeline_fields():
     assert fleet["router_stats"]["routable"] == 2
     assert "CPU fallback" in fleet["caveat"]
 
+    # The economics block (ISSUE 19): zipf-duplicate drive through the
+    # response cache — measured hit/miss p99 split, the warm-cache
+    # goodput curve holding the 96%-of-peak bar at ~10x, the collapse
+    # ratio, the live server cache + measured cost table, and the
+    # zero-recompile verdict on the cached path.
+    econ = report["economics"]
+    assert econ["ok"] is True
+    zd = econ["zipf_drive"]
+    assert zd["zipf_exponent"] == 1.1
+    assert zd["hit_rate"] > 0
+    assert zd["hit_p99_ms"] > 0 and zd["miss_p99_ms"] > 0
+    assert zd["hit_is_cheap"] is True
+    assert zd["enforced_bar"] == 1.0  # the CPU bar; 0.1 on TPU
+    good_e = econ["goodput"]
+    assert good_e["capacity_rps"] > 0
+    # The top point targets 10x but the open-loop rate is clamped at
+    # 1500 rps, so on a fast cached path offered_x lands lower.
+    assert good_e["points"][0]["offered_x"] == 1.0
+    assert good_e["points"][-1]["offered_x"] > 1.0
+    assert good_e["holds_at_overload"] is True
+    assert good_e["single_process_fraction_of_peak"] == \
+        over["goodput_at_top_fraction_of_peak"]
+    assert econ["zero_steady_state_recompiles"] is True
+    assert econ["collapse_ratio"] >= 0
+    assert econ["server_cache"]["hits"] > 0
+    assert econ["cost_model"]["buckets"] == [1, 8]
+    assert "CPU fallback" in econ["caveat"]
+
 
 def test_bench_serve_overload_verdicts_fail_loudly():
     """The overload verdicts really carry teeth: the injected failure
@@ -246,6 +276,9 @@ def test_bench_serve_overload_verdicts_fail_loudly():
         "BENCH_FLEET_PAIRS": "2",
         "BENCH_FLEET_REQUESTS": "16",
         "BENCH_FLEET_INJECT_FAIL": "1",
+        "BENCH_ECONOMICS_SECONDS": "0.5",
+        "BENCH_ECONOMICS_REQUESTS": "32",
+        "BENCH_ECONOMICS_INJECT_FAIL": "1",
         "BENCH_COMPILE_CACHE": "",
         "TPUMNIST_COMPILE_CACHE": "",
     })
@@ -261,3 +294,4 @@ def test_bench_serve_overload_verdicts_fail_loudly():
     # The fleet injection hook carries teeth too (the overload error
     # outranks it in the message, but the verdict and exit gate hold).
     assert report["fleet"]["ok"] is False
+    assert report["economics"]["ok"] is False

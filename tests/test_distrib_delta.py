@@ -540,3 +540,114 @@ def test_health_poller_backends_dir_discovery(tmp_path):
         f.write('{"url": "http')
     poller.sync_backends_dir()
     assert fleet.names() == ["127.0.0.1:7001"]
+
+
+# -- ranged resume (ISSUE 19): torn mid-body chunk fetch ----------------------
+
+
+def _chunk_peer(data, plan):
+    """A scriptable ``GET /chunks/<digest>`` peer. Each request pops one
+    ``(mode, arg)`` from ``plan`` (exhausted -> honest "full"):
+
+    - ``("tear", k)``: advertise the full remaining length but close the
+      socket after ``k`` body bytes — the mid-body disconnect;
+    - ``("ignore-range", None)``: answer a Range request with a plain
+      200 and the WHOLE body (a peer that never learned Range);
+    - ``("empty-tear", None)``: honor the Range with a 206 header, then
+      close before ANY body byte — a resume that makes no progress;
+    - ``("full", None)``: serve honestly (206 from the Range offset).
+
+    Returns ``(httpd, requests)`` where ``requests`` records every
+    ``(path, range_header)`` seen, for asserting the resume offsets.
+    """
+    import http.server
+    import threading
+
+    requests = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *_a):
+            pass
+
+        def do_GET(self):
+            rng = self.headers.get("Range")
+            requests.append((self.path, rng))
+            mode, arg = plan.pop(0) if plan else ("full", None)
+            start = 0
+            if rng and mode != "ignore-range":
+                start = int(rng.split("=", 1)[1].rstrip("-"))
+            body = data[start:]
+            self.send_response(206 if start else 200)
+            self.send_header("Content-Length", str(len(body)))
+            if start:
+                self.send_header(
+                    "Content-Range",
+                    f"bytes {start}-{len(data) - 1}/{len(data)}")
+            self.end_headers()
+            if mode == "tear":
+                self.wfile.write(body[:arg])
+                self.wfile.flush()
+                self.connection.close()
+            elif mode == "empty-tear":
+                self.connection.close()
+            else:
+                self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.handle_error = lambda *_a: None  # torn sockets are the point
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, requests
+
+
+def _tear_data():
+    # > 2 stream pieces (64 KiB each) so the tear lands mid-stream.
+    return bytes(range(256)) * 650  # 166400 bytes
+
+
+def test_fetch_resumes_from_partial_offset_after_midbody_tear():
+    from pytorch_distributed_mnist_tpu.distrib.fetch import fetch_chunk_http
+
+    data = _tear_data()
+    httpd, requests = _chunk_peer(data, [("tear", 100_000)])
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        assert fetch_chunk_http(url, "deadbeef") == data
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # ONE resume, from exactly the partial offset — not from zero.
+    assert [r[1] for r in requests] == [None, "bytes=100000-"]
+    assert [r[0] for r in requests] == ["/chunks/deadbeef"] * 2
+
+
+def test_fetch_restarts_when_peer_ignores_range():
+    from pytorch_distributed_mnist_tpu.distrib.fetch import fetch_chunk_http
+
+    data = _tear_data()
+    httpd, requests = _chunk_peer(
+        data, [("tear", 100_000), ("ignore-range", None)])
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        # The peer replays the body from byte 0 with a plain 200: the
+        # splice buffer must reset, not concatenate.
+        assert fetch_chunk_http(url, "deadbeef") == data
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert [r[1] for r in requests] == [None, "bytes=100000-"]
+
+
+def test_fetch_raises_after_resume_with_no_progress():
+    from pytorch_distributed_mnist_tpu.distrib.fetch import fetch_chunk_http
+
+    data = _tear_data()
+    httpd, requests = _chunk_peer(
+        data, [("tear", 100_000), ("empty-tear", None)])
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with pytest.raises(OSError, match="torn chunk fetch"):
+            fetch_chunk_http(url, "deadbeef")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert len(requests) == 2  # no blind retry loop after zero progress

@@ -1,0 +1,538 @@
+"""Request-path economics (ISSUE 19): the epoch-stamped response
+cache, in-flight collapsing, and cost-priced admission.
+
+Three layers of pins:
+
+- **unit**: ``request_key`` framing, ``ResponseCache`` LRU/byte-budget/
+  generation semantics, ``CostModel`` seed geometry + first-observation
+  calibration + EWMA, collapse error fan-out on a bare MicroBatcher;
+- **swap seams**: every path that changes the answering params —
+  engine hot reload, canary publish-reset and PROMOTE — bumps the cache
+  generation exactly when it should (and a rejected stale swap does
+  not);
+- **loopback HTTP**: bitwise hit==miss replies, ``--no-cache``
+  byte-identical bodies, zero stale replies across a live reload,
+  cost-priced quotas rejecting an expensive-bucket flood while
+  admitting cached duplicates, and the router cache invalidating on a
+  backend epoch change observed by the health poller.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_mnist_tpu.data.mnist import synthetic_dataset
+from pytorch_distributed_mnist_tpu.models import get_model
+from pytorch_distributed_mnist_tpu.serve.batcher import MicroBatcher
+from pytorch_distributed_mnist_tpu.serve.canary import ShadowCanary
+from pytorch_distributed_mnist_tpu.serve.economics import (
+    HIT_COST,
+    CostModel,
+    ResponseCache,
+    request_key,
+)
+from pytorch_distributed_mnist_tpu.serve.engine import InferenceEngine
+from pytorch_distributed_mnist_tpu.serve.router import (
+    build_parser as router_parser,
+)
+from pytorch_distributed_mnist_tpu.serve.router import create_router
+from pytorch_distributed_mnist_tpu.serve.server import (
+    build_parser,
+    create_server,
+)
+from pytorch_distributed_mnist_tpu.train.checkpoint import save_checkpoint
+from pytorch_distributed_mnist_tpu.train.state import create_train_state
+
+pytestmark = pytest.mark.economics
+
+
+# -- unit: key derivation -----------------------------------------------------
+
+
+def test_request_key_varies_with_every_component():
+    base = request_key(b"body", "m", "replicated", "f32")
+    assert base == request_key(b"body", "m", "replicated", "f32")
+    assert base != request_key(b"bodY", "m", "replicated", "f32")
+    assert base != request_key(b"body", "m2", "replicated", "f32")
+    assert base != request_key(b"body", "m", "tensor", "f32")
+    assert base != request_key(b"body", "m", "replicated", "bf16")
+
+
+def test_request_key_length_framing_prevents_concat_collisions():
+    # Without per-part length framing, raw=b"ab" + model="c" and
+    # raw=b"a" + model="bc" would hash the same concatenation.
+    assert (request_key(b"ab", "c", "x", "y")
+            != request_key(b"a", "bc", "x", "y"))
+
+
+# -- unit: ResponseCache ------------------------------------------------------
+
+
+def test_cache_lru_eviction_honors_byte_budget():
+    cache = ResponseCache(max_bytes=300)
+    for i in range(3):
+        assert cache.put(f"k{i}", i, nbytes=100, epoch=0, generation=0)
+    # Touch k0 so k1 is the LRU victim when k3 arrives.
+    assert cache.get("k0")[0] == 0
+    assert cache.put("k3", 3, nbytes=100, epoch=0, generation=0)
+    snap = cache.snapshot()
+    assert snap["bytes"] <= 300 and snap["evictions"] == 1
+    assert cache.get("k1")[0] is None  # evicted
+    assert cache.get("k0")[0] == 0  # kept: recently used
+    # An entry bigger than the whole budget is refused outright.
+    assert not cache.put("huge", 9, nbytes=301, epoch=0, generation=0)
+
+
+def test_cache_generation_invalidates_without_scanning():
+    cache = ResponseCache(max_bytes=1 << 20)
+    assert cache.put("k", "v", nbytes=10, epoch=0,
+                     generation=cache.generation)
+    cache.bump_generation()
+    # Old-generation entry reads as a MISS (and is dropped lazily).
+    assert cache.get("k")[0] is None
+    # An insert stamped with the pre-bump generation is refused.
+    assert not cache.put("k2", "v", nbytes=10, epoch=0, generation=0)
+    snap = cache.snapshot()
+    assert snap["generation"] == 1 and snap["stale_drops"] == 1
+    # Current-generation traffic proceeds normally.
+    assert cache.put("k3", "w", nbytes=10, epoch=1,
+                     generation=cache.generation)
+    assert cache.get("k3")[0] == "w"
+
+
+def test_disabled_cache_is_inert():
+    cache = ResponseCache(max_bytes=0)
+    assert not cache.enabled
+    assert not cache.put("k", "v", nbytes=1, epoch=0, generation=0)
+    assert cache.get("k")[0] is None
+
+
+# -- unit: CostModel ----------------------------------------------------------
+
+
+def test_cost_model_seed_geometry_then_calibrated_measurement():
+    m = CostModel([1, 8, 32])
+    # Seeded: cost proportional to bucket rows, normalized to smallest.
+    assert m.price(1) == 1.0
+    assert m.price(8) == 8.0
+    assert m.price(9) == 32.0  # rides the 32 bucket
+    # First observation calibrates the still-seeded buckets onto the
+    # measured unit: the 8-bucket measures 4ms, so relative prices are
+    # unchanged until other buckets get their own measurements.
+    m.observe(8, 0.004)
+    assert m.price(1) == 1.0 and m.price(8) == 8.0
+    # The 1-bucket then measures 2ms: an 8-row batch is only 2x the
+    # 1-row batch on this box, whatever the geometry claimed.
+    m.observe(1, 0.002)
+    assert m.price(8) == 2.0
+    # EWMA refresh (alpha=0.2): 0.8*0.004 + 0.2*0.008 = 0.0048.
+    m.observe(8, 0.008)
+    assert m.price(8) == pytest.approx(2.4)
+    snap = m.snapshot()
+    assert snap["observed_batches"] == {"1": 1, "8": 2, "32": 0}
+
+
+def test_cost_model_price_floor_is_hit_cost():
+    m = CostModel([1, 8])
+    m.observe(1, 1.0)
+    m.observe(8, 1e-9)  # degenerate measurement
+    assert m.price(8) == HIT_COST
+
+
+# -- unit: collapse error fan-out --------------------------------------------
+
+
+def test_collapsed_follower_error_fanout_exactly_once():
+    """One failing dispatch, five joined clients: the error reaches
+    every joiner exactly once (one raise per result() call), the infer
+    ran once, and the collapse key is retired so the NEXT identical
+    request gets a fresh pending."""
+    calls = []
+
+    def failing_infer(images):
+        calls.append(images.shape[0])
+        raise RuntimeError("injected batch death")
+
+    rows = np.zeros((1, 4), np.float32)
+    with MicroBatcher(failing_infer, max_batch=64,
+                      max_wait_s=0.3) as b:
+        leader = b.submit(rows, collapse_key="k")
+        followers = [b.submit(rows, collapse_key="k") for _ in range(4)]
+        assert all(f is leader for f in followers)
+        assert b.collapsed == 4
+
+        raises = []
+        lock = threading.Lock()
+
+        def wait_one():
+            try:
+                b.result(leader, timeout=10.0)
+            except RuntimeError as exc:
+                with lock:
+                    raises.append(str(exc))
+
+        threads = [threading.Thread(target=wait_one) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15.0)
+        assert len(raises) == 5
+        assert all("injected batch death" in r for r in raises)
+        assert calls == [1]  # ONE dispatch for five clients
+
+        # The key was retired at dispatch: a new identical request is a
+        # fresh pending, not a join onto the dead leader.
+        fresh = b.submit(rows, collapse_key="k")
+        assert fresh is not leader
+        with pytest.raises(RuntimeError):
+            b.result(fresh, timeout=10.0)
+
+
+def test_collapse_key_retired_at_dispatch_then_recomputes():
+    """A duplicate arriving AFTER its leader dispatched queues normally
+    (the response cache, not the collapser, handles post-completion
+    duplicates)."""
+    done = threading.Event()
+
+    def slow_infer(images):
+        done.wait(5.0)
+        return images
+
+    rows = np.zeros((1, 4), np.float32)
+    with MicroBatcher(slow_infer, max_batch=1, max_wait_s=0.01) as b:
+        leader = b.submit(rows, collapse_key="k")
+        # max_batch=1 dispatches the leader immediately; wait until it
+        # leaves the queue (the worker is now blocked inside infer).
+        deadline = time.perf_counter() + 5.0
+        while b.queue_depth() and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        late = b.submit(rows, collapse_key="k")
+        assert late is not leader
+        done.set()
+        assert b.result(leader, timeout=10.0) is not None
+        assert b.result(late, timeout=10.0) is not None
+
+
+# -- swap seams: who bumps the generation ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(0))
+    return model, state
+
+
+def test_engine_reload_bumps_generation_stale_swap_does_not(linear_setup):
+    model, state = linear_setup
+    engine = InferenceEngine(model.apply, state.params, buckets=(4,))
+    cache = ResponseCache(max_bytes=1 << 20)
+    engine.add_swap_hook(cache.bump_generation)
+    assert engine.swap_params(state.params, epoch=3)
+    assert cache.generation == 1
+    # A STALE publish is rejected by the swap-ordering rule and must
+    # not invalidate anything: nothing changed.
+    assert not engine.swap_params(state.params, epoch=1)
+    assert cache.generation == 1
+
+
+class _StubPlane:
+    """Minimal canary plane: logits_fn drives agree/disagree."""
+
+    def __init__(self, logits_fn):
+        self.logits_fn = logits_fn
+        self.epoch = 0
+
+    @property
+    def params_epoch(self):
+        return self.epoch
+
+    def preprocess(self, images):
+        return np.asarray(images, np.float32)
+
+    def warmup(self):
+        pass
+
+    def dispatch(self, images):
+        return np.asarray(images, np.float32)
+
+    def complete(self, handle):
+        return self.logits_fn(handle), self.epoch
+
+    def swap_params(self, params, epoch=None, path=None):
+        self.epoch = epoch
+        return 1
+
+
+def _spiked(x):
+    out = np.zeros((x.shape[0], 10), np.float32)
+    out[:, 0] = 5.0
+    return out
+
+
+def test_canary_promote_and_publish_reset_bump_generation():
+    canary = ShadowCanary(_StubPlane(_spiked), _StubPlane(_spiked),
+                          "bf16", fraction=1.0, promote_after=8,
+                          budget=0.1)
+    cache = ResponseCache(max_bytes=1 << 20)
+    canary.add_swap_hook(cache.bump_generation)
+    # Clean shadowed rows walk the canary to PROMOTE: the answering
+    # plane changes, so cached baseline answers must die with it.
+    while canary.snapshot()["state"] != "primary":
+        canary.complete(canary.dispatch(np.zeros((4, 4), np.float32)))
+    assert cache.generation == 1
+    # A fresh publish resets the cycle — and bumps again.
+    canary.swap_params(None, epoch=9)
+    assert cache.generation == 2
+
+
+# -- loopback HTTP ------------------------------------------------------------
+
+
+def _publish(ckpt_dir, epoch, seed):
+    model = get_model("linear", compute_dtype=jnp.float32)
+    state = create_train_state(model, jax.random.key(seed))
+    save_checkpoint(state, epoch=epoch, best_acc=0.5, is_best=False,
+                    directory=str(ckpt_dir), process_index=0)
+    return state
+
+
+def _serve_args(ckpt_dir, **overrides):
+    argv = [
+        "--checkpoint-dir", str(ckpt_dir),
+        "--model", "linear", "--dtype", "f32",
+        "--host", "127.0.0.1", "--port", "0",
+        "--buckets", "1,8,32",
+        "--max-wait-ms", "2", "--max-queue", "128",
+        "--poll-interval", "0.1",
+    ]
+    for k, v in overrides.items():
+        flag = "--" + k.replace("_", "-")
+        if v is True:
+            argv.append(flag)
+        else:
+            argv += [flag, str(v)]
+    return build_parser().parse_args(argv)
+
+
+class _Httpd:
+    def __init__(self, httpd, ready_attr="ctx"):
+        self.httpd = httpd
+        host, port = httpd.server_address[:2]
+        self.url = f"http://{host}:{port}"
+        self.name = f"{host}:{port}"
+        self.thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.ctx.close()
+        self.httpd.server_close()
+        self.thread.join(10.0)
+
+    def get(self, path):
+        with urllib.request.urlopen(self.url + path, timeout=30) as r:
+            return json.loads(r.read())
+
+    def post_raw(self, body):
+        """POST pre-serialized bytes; returns (reply_dict, x_cache)."""
+        req = urllib.request.Request(
+            self.url + "/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read()), r.headers.get("X-Cache")
+
+
+def _dup_body(seed=5, n=3, client_id=None, rows28=True):
+    rng = np.random.RandomState(seed)
+    shape = (n, 28, 28)
+    payload = {"images": rng.randint(0, 256, shape).tolist()}
+    if client_id:
+        payload["client_id"] = client_id
+    return json.dumps(payload).encode()
+
+
+@pytest.fixture()
+def cached_server(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    _publish(ckpt, epoch=0, seed=10)
+    srv = _Httpd(create_server(_serve_args(ckpt)))
+    try:
+        yield srv, ckpt
+    finally:
+        srv.close()
+
+
+def test_bitwise_hit_equals_miss(cached_server):
+    srv, _ = cached_server
+    body = _dup_body()
+    miss, miss_verdict = srv.post_raw(body)
+    hit, hit_verdict = srv.post_raw(body)
+    assert (miss_verdict, hit_verdict) == ("miss", "hit")
+    assert hit["predictions"] == miss["predictions"]
+    assert hit["model_epoch"] == miss["model_epoch"] == 0
+    stats = srv.get("/stats")
+    assert stats["cache"]["hits"] >= 1
+    assert stats["cache"]["generation"] == 0
+    # A hit is a SERVED request: totals stay honest.
+    assert stats["requests"] >= 2
+
+
+def test_no_cache_serves_byte_identical_body(tmp_path):
+    """--no-cache must serve the same BYTES (modulo the per-request
+    latency_ms) as the cached path — the cache is a pure accelerator,
+    never a behavior change."""
+    ckpt = tmp_path / "ckpt"
+    _publish(ckpt, epoch=0, seed=10)
+    body = _dup_body()
+    cached = _Httpd(create_server(_serve_args(ckpt)))
+    try:
+        cached_replies = [srv_reply for srv_reply, _ in
+                          (cached.post_raw(body), cached.post_raw(body))]
+    finally:
+        cached.close()
+    plain = _Httpd(create_server(_serve_args(ckpt, no_cache=True)))
+    try:
+        plain_reply, verdict = plain.post_raw(body)
+        assert verdict is None  # no cache, no X-Cache header
+        assert "cache" not in plain.get("/stats")
+    finally:
+        plain.close()
+    for reply in cached_replies + [plain_reply]:
+        reply.pop("latency_ms")
+    assert cached_replies[0] == cached_replies[1] == plain_reply
+
+
+def test_reload_invalidates_zero_stale_replies(cached_server):
+    srv, ckpt = cached_server
+    body = _dup_body()
+    warm, verdict = srv.post_raw(body)
+    assert srv.post_raw(body)[1] == "hit"
+    assert warm["model_epoch"] == 0
+
+    _publish(ckpt, epoch=2, seed=99)  # different params entirely
+    deadline = time.perf_counter() + 30.0
+    while time.perf_counter() < deadline:
+        if srv.get("/healthz").get("model_epoch") == 2:
+            break
+        time.sleep(0.05)
+    assert srv.get("/healthz")["model_epoch"] == 2
+
+    # EVERY post-swap reply carries the new epoch — the first recomputes
+    # (the generation bump made the old entry unreachable), the repeats
+    # hit the re-cached entry; none may replay epoch 0.
+    verdicts = []
+    for _ in range(4):
+        reply, verdict = srv.post_raw(body)
+        verdicts.append(verdict)
+        assert reply["model_epoch"] == 2
+    assert verdicts[0] == "miss" and "hit" in verdicts[1:]
+    assert srv.get("/stats")["cache"]["generation"] >= 1
+
+
+def test_cost_priced_quota_rejects_expensive_flood_admits_hits(tmp_path):
+    """With --price-admission, a client's token bucket drains in COST
+    units: 32-row requests price at the seeded 32x (never observed —
+    they are rejected before computing), which can NEVER fit a 4-token
+    burst, while cached duplicates (priced HIT_COST) keep flowing on
+    the same bucket. A plain request-counted quota would treat both
+    identically."""
+    ckpt = tmp_path / "ckpt"
+    _publish(ckpt, epoch=0, seed=10)
+    srv = _Httpd(create_server(_serve_args(
+        ckpt, price_admission=True, quota_rps="2")))
+    try:
+        dup = _dup_body(seed=1, n=1, client_id="spender")
+        first, _ = srv.post_raw(dup)  # compute once, cache it (cost ~1)
+
+        statuses = []
+        for i in range(4):
+            rng = np.random.RandomState(100 + i)
+            big = json.dumps({
+                "images": rng.randint(0, 256, (32, 28, 28)).tolist(),
+                "client_id": "spender"}).encode()
+            try:
+                srv.post_raw(big)
+                statuses.append(200)
+            except urllib.error.HTTPError as exc:
+                statuses.append(exc.code)
+                if exc.code == 429:
+                    assert exc.headers.get("Retry-After") is not None
+                exc.read()
+        # 32 units a pop against a 4-token burst: every flood request
+        # is clipped (and, never having computed, the 32-bucket keeps
+        # its seeded price — the assertion is deterministic).
+        assert statuses == [429, 429, 429, 429]
+
+        # The SAME drained client keeps its cached duplicates: each
+        # costs HIT_COST, not a full unit.
+        for _ in range(10):
+            reply, verdict = srv.post_raw(dup)
+            assert reply["predictions"] == first["predictions"]
+        assert verdict == "hit"
+        assert srv.get("/stats")["cost_model"]["buckets"] == [1, 8, 32]
+    finally:
+        srv.close()
+
+
+def test_router_cache_invalidated_on_backend_epoch_change(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    _publish(ckpt, epoch=0, seed=10)
+    backend = _Httpd(create_server(_serve_args(ckpt)))
+    router = None
+    try:
+        router = _Httpd(create_router(router_parser().parse_args([
+            "--backends", backend.name,
+            "--host", "127.0.0.1", "--port", "0",
+            "--health-interval", "0.1", "--connect-timeout", "2.0",
+            "--cache-mb", "16"])))
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            try:
+                if router.get("/healthz").get("routable") == 1:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.05)
+
+        body = _dup_body()
+        warm, _ = router.post_raw(body)
+        assert warm["model_epoch"] == 0
+        reply, verdict = router.post_raw(body)
+        assert verdict == "hit" and reply == warm
+
+        _publish(ckpt, epoch=2, seed=99)
+        # The backend reloads; the router's health poller observes the
+        # epoch change and bumps the router cache generation.
+        while time.perf_counter() < deadline:
+            stats = router.get("/stats")
+            rows = stats.get("backends", [])
+            if rows and rows[0].get("epoch") == 2:
+                break
+            time.sleep(0.05)
+        for _ in range(3):
+            reply, _ = router.post_raw(body)
+            assert reply["model_epoch"] == 2  # never the cached epoch-0
+        assert router.get("/stats")["cache"]["generation"] >= 1
+    finally:
+        if router is not None:
+            router.close()
+        backend.close()
+
+
+def test_stats_cache_block_schema_and_collapse_counter(cached_server):
+    srv, _ = cached_server
+    images, _ = synthetic_dataset(2, seed=1)
+    srv.post_raw(json.dumps({"images": images.tolist()}).encode())
+    block = srv.get("/stats")["cache"]
+    assert {"hits", "misses", "hit_rate", "hit_bytes", "evictions",
+            "stale_drops", "generation", "entries", "bytes",
+            "capacity_bytes", "collapsed"} <= set(block)

@@ -71,6 +71,12 @@ _BLOCKING_ATTR_CALLS = {
     # dispatch for the build; the shipped shape snapshots state under
     # the lock and actuates after release.
     "resize": "a pool topology rebuild (build + AOT warm)",
+    # The response-cache seam (ISSUE 19): cache payloads are built —
+    # logits device-fetched, replies serialized — OUTSIDE the cache
+    # lock; only the generation-checked insert runs under it
+    # (snapshot-then-insert). A device_get under any lock stalls every
+    # reader behind a D2H transfer.
+    "device_get": "a device-to-host transfer",
 }
 _BLOCKING_BARE_CALLS = {
     "open": "file IO",

@@ -137,6 +137,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import re
 import shutil
 import subprocess
@@ -521,6 +522,127 @@ def run_quota_abuse(args) -> int:
              f"({100.0 * good_ok / good_sends:.1f}% goodput); server "
              f"tracked {stats.get('quota', {}).get('clients_tracked')} "
              f"client(s)")
+        return 0
+    finally:
+        _kill_serve(server, log, ckpt_dir)
+
+
+def _post_predict(url: str, body: bytes, timeout: float = 30.0):
+    """POST one pre-serialized /predict body; returns ``(reply_dict,
+    x_cache)`` where x_cache is the reply's X-Cache header verdict
+    (hit/miss/None) — the cache-storm twin's staleness probe."""
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), resp.headers.get("X-Cache")
+
+
+def run_cache_storm(args) -> int:
+    """The response-cache invalidation twin (ISSUE 19): duplicate-heavy
+    loadgen (Zipf-shaped key reuse, the cache's best case) over a LIVE
+    hot reload. The bar: zero dropped requests through the swap, and
+    zero stale logits after it — every post-swap reply must carry the
+    new model epoch, because the swap hook bumps the cache generation
+    atomically with the param install (an entry from the old params can
+    never be replayed as the new model's answer)."""
+    env = _serve_env(args)
+    flags = ["--model", "linear", "--buckets", "1,8",
+             "--serve-devices", str(args.serve_devices),
+             "--max-wait-ms", "2", "--poll-interval", "0.2"]
+    server, log, ckpt_dir, url = _boot_serve(env, flags, args.timeout)
+    try:
+        if url is None:
+            return 1
+        # One fixed duplicate body — the probe key the whole twin
+        # replays (deterministic, so pre- and post-swap probes are
+        # byte-identical and MUST collide in the cache).
+        rng = random.Random(3)
+        probe = json.dumps({"images": [
+            [[rng.randrange(256) for _ in range(28)]
+             for _ in range(28)]]}).encode()
+        pre_epochs, pre_cache = set(), []
+        for _ in range(3):
+            reply, verdict = _post_predict(url, probe)
+            pre_epochs.add(reply.get("model_epoch"))
+            pre_cache.append(verdict)
+        if len(pre_epochs) != 1:
+            _say(f"pre-swap epochs disagree: {sorted(pre_epochs)}")
+            return 1
+        if "hit" not in pre_cache:
+            _say(f"duplicate probe never hit the cache ({pre_cache}) — "
+                 f"cache inactive?")
+            return 1
+        (old_epoch,) = pre_epochs
+        _say(f"cache warm on epoch {old_epoch} ({pre_cache})")
+
+        # The storm: Zipf-duplicate loadgen riding THROUGH the reload —
+        # open-loop over a fixed duration (a closed burst would finish
+        # before the publish subprocess even imports jax, and "zero
+        # drops through the swap" would be vacuous).
+        storm_s = 10.0
+        storm = subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "tools", "loadgen.py"),
+             "--url", url, "--mode", "open",
+             "--rate", str(max(20.0, args.requests / storm_s)),
+             "--duration", str(storm_s), "--shape", "zipf:1.1",
+             "--timeout", "20"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        time.sleep(0.5)  # let the storm get in flight first
+        new_epoch = (old_epoch or 0) + 7
+        _seed_checkpoint(env, ckpt_dir, new_epoch)
+        _say(f"published checkpoint_{new_epoch}.npz under the storm")
+        deadline = time.monotonic() + args.timeout
+        epoch = None
+        while time.monotonic() < deadline:
+            epoch = _get_json(url, "/healthz").get("model_epoch")
+            if epoch == new_epoch:
+                break
+            time.sleep(0.2)
+        if epoch != new_epoch:
+            _say(f"hot reload never landed (model_epoch={epoch}, want "
+                 f"{new_epoch})")
+            return 1
+        out, _ = storm.communicate(timeout=args.timeout)
+        report = _loadgen_report(out)
+        sends = _sends(report)
+        dropped = (report.get("transport_errors", 0)
+                   + report.get("conn_refused", 0))
+        if dropped or report.get("ok", 0) != sends:
+            _say(f"storm dropped requests through the swap: "
+                 f"{report.get('ok', 0)}/{sends} answered 200, "
+                 f"{dropped} transport failures")
+            return 1
+        hits = report.get("cache_client", {}).get("hits", 0)
+        if not hits:
+            _say("the storm never observed a cache hit — the Zipf "
+                 "duplicates missed the cache?")
+            return 1
+
+        # Staleness probe: the SAME bytes that were cached pre-swap.
+        # Every reply must now carry the new epoch — a single old-epoch
+        # reply is a stale logit replay, the exact bug the generation
+        # bump exists to make impossible.
+        post_cache = []
+        for i in range(8):
+            reply, verdict = _post_predict(url, probe)
+            post_cache.append(verdict)
+            if reply.get("model_epoch") != new_epoch:
+                _say(f"STALE reply {i}: model_epoch="
+                     f"{reply.get('model_epoch')} after swap to "
+                     f"{new_epoch} (X-Cache: {verdict})")
+                return 1
+        if "hit" not in post_cache:
+            _say(f"post-swap probe never re-cached ({post_cache})")
+            return 1
+        stats = _get_json(url, "/stats")
+        cache_stats = stats.get("cache", {})
+        _say(f"cache storm: {report['ok']}/{sends} answered through the "
+             f"reload ({hits} client-observed hits), zero stale replies "
+             f"after the swap to epoch {new_epoch} (cache generation "
+             f"{cache_stats.get('generation')}, "
+             f"{cache_stats.get('stale_drops')} stale insert(s) "
+             f"dropped)")
         return 0
     finally:
         _kill_serve(server, log, ckpt_dir)
@@ -1332,6 +1454,14 @@ def main(argv=None) -> int:
                         "one hot client at 10x --quota-rps must be "
                         "clipped with 429+Retry-After while a "
                         "well-behaved client keeps >= 90%% goodput")
+    p.add_argument("--cache-storm", action="store_true",
+                   help="serve twin (ISSUE 19): duplicate-heavy "
+                        "(Zipf) loadgen over a LIVE hot reload — "
+                        "zero dropped requests through the swap, and "
+                        "zero stale logits after it (every post-swap "
+                        "reply must carry the new model epoch; the "
+                        "swap hook's generation bump is what makes a "
+                        "stale replay impossible)")
     p.add_argument("--quota-rps", type=float, default=20.0,
                    help="quota-abuse twin: per-client requests/sec "
                         "handed to the server")
@@ -1410,6 +1540,8 @@ def main(argv=None) -> int:
         return run_autoscale_spike(args)
     if args.quota_abuse:
         return run_quota_abuse(args)
+    if args.cache_storm:
+        return run_cache_storm(args)
     if args.serve:
         args.resize_targets = [int(t) for t in
                                (args.resize or "").split(",") if t.strip()]

@@ -19,7 +19,16 @@ Open-loop traffic SHAPES (`--shape`) modulate the rate over the run:
 a flat baseline with a `--spike-mult`x burst through the middle fifth
 (what the autoscaler twin fires at a server), `adversarial` flips
 per-second between near-silence and a 3x burst on a seeded RNG — the
-worst case for any controller that trusts a trend.
+worst case for any controller that trusts a trend. Two more shapes
+change WHICH BODY each request carries rather than the rate (both run
+at the constant rate, and work in closed mode too): `zipf:S` samples
+the request template per-request from a Zipf(S) distribution — the
+duplicate-heavy key-reuse traffic a response cache lives on — and
+`replay:FILE` replays a JSONL trace (one request payload per line, in
+order, cycling if the run outlasts the trace). The report carries
+client-OBSERVED cache behaviour whenever the server stamps replies
+with `X-Cache` (hits/misses/hit_rate and a hit-vs-miss latency split)
+— measured at this end of the wire, not inferred from server stats.
 
 Priority classes: `--mix interactive=0.8,batch=0.2` samples each
 request's `priority` field from the given distribution (and the report
@@ -55,6 +64,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
 import math
 import random
@@ -106,6 +116,78 @@ def pick_class(mix, rng) -> str:
         if r <= cum:
             return klass
     return mix[-1][0]
+
+
+#: Rate-modulating shapes (the body round-robins); `zipf:S` /
+#: `replay:FILE` are BODY shapes that ride a constant rate.
+RATE_SHAPES = ("constant", "sine", "spike", "adversarial")
+
+
+def parse_shape(spec: str):
+    """Split ``--shape`` into (rate_shape, body_shape). ``zipf:S`` and
+    ``replay:FILE`` pick bodies differently but fire at the constant
+    rate; everything else modulates the rate with round-robin bodies.
+    body_shape is None, ("zipf", S) or ("replay", path)."""
+    if spec.startswith("zipf:"):
+        try:
+            s = float(spec.split(":", 1)[1])
+        except ValueError:
+            raise SystemExit(f"--shape {spec!r}: expected zipf:S with "
+                             f"a numeric exponent S") from None
+        if s < 0:
+            raise SystemExit(f"--shape {spec!r}: exponent must be >= 0")
+        return "constant", ("zipf", s)
+    if spec.startswith("replay:"):
+        path = spec.split(":", 1)[1]
+        if not path:
+            raise SystemExit("--shape replay: expected replay:FILE")
+        return "constant", ("replay", path)
+    if spec not in RATE_SHAPES:
+        raise SystemExit(
+            f"--shape {spec!r}: expected one of {list(RATE_SHAPES)}, "
+            f"zipf:S, or replay:FILE")
+    return spec, None
+
+
+def zipf_cum(n: int, s: float):
+    """Cumulative Zipf(s) weights over ranks 1..n — P(rank k) is
+    proportional to 1/k^s, so rank 1 dominates at s >= 1 (the
+    duplicate-heavy head a response cache feeds on) and s=0 degrades
+    to uniform. Sampled by bisect on a uniform draw."""
+    weights = [1.0 / (k + 1) ** s for k in range(n)]
+    total = sum(weights)
+    cum, out = 0.0, []
+    for w in weights:
+        cum += w / total
+        out.append(cum)
+    return out
+
+
+def load_replay(path: str, extra_fields=None):
+    """JSONL trace -> pre-serialized bodies, in trace order. Each line
+    is one request payload (the dict POSTed to /predict); client-id /
+    model stamps apply on top, same as generated bodies. The run cycles
+    the trace when it outlasts it."""
+    bodies = []
+    with open(path, encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise SystemExit(
+                    f"--shape replay: {path}:{ln}: bad JSON ({exc})"
+                ) from None
+            if not isinstance(payload, dict):
+                raise SystemExit(
+                    f"--shape replay: {path}:{ln}: expected an object")
+            payload.update(extra_fields or {})
+            bodies.append(json.dumps(payload).encode())
+    if not bodies:
+        raise SystemExit(f"--shape replay: {path}: empty trace")
+    return {None: bodies}
 
 
 def rate_at(shape: str, base_rate: float, t: float, duration: float,
@@ -184,6 +266,14 @@ class Collector:
         self.not_launched = 0
         self.retry_after_seen = 0
         self.classes = {}
+        # Client-OBSERVED cache behaviour: replies stamped `X-Cache:
+        # hit|miss` by a caching server/router. Latencies split per
+        # verdict so the report can show the hit-vs-compute gap as
+        # measured at this end of the wire.
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.hit_latencies = []
+        self.miss_latencies = []
 
     def _class_rec(self, klass):
         rec = self.classes.get(klass)
@@ -193,11 +283,17 @@ class Collector:
         return rec
 
     def record(self, status: int, latency_s: float, klass=None,
-               retry_after: bool = False) -> None:
+               retry_after: bool = False, cache=None) -> None:
         with self.lock:
             self.status[status] = self.status.get(status, 0) + 1
             if status == 200:
                 self.latencies.append(latency_s)
+                if cache == "hit":
+                    self.cache_hits += 1
+                    self.hit_latencies.append(latency_s)
+                elif cache == "miss":
+                    self.cache_misses += 1
+                    self.miss_latencies.append(latency_s)
             if retry_after:
                 self.retry_after_seen += 1
             if klass is not None:
@@ -254,7 +350,8 @@ def _one_request(url: str, body: bytes, timeout: float,
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 resp.read()
                 collector.record(resp.status, time.perf_counter() - t0,
-                                 klass=klass)
+                                 klass=klass,
+                                 cache=resp.headers.get("X-Cache"))
                 return
         except urllib.error.HTTPError as exc:
             exc.read()
@@ -273,17 +370,34 @@ def _one_request(url: str, body: bytes, timeout: float,
             return
 
 
-def _pick_body(bodies, mix, rng, i):
+def _pick_body(bodies, mix, rng, i, zipf=None):
     """``(klass, body)`` for request ``i``: class sampled from the mix,
-    body round-robin within the class's template set."""
+    body round-robin within the class's template set — or, with
+    ``zipf`` (cumulative weights from :func:`zipf_cum`), sampled
+    per-request from the Zipf distribution over templates, which is
+    what makes the traffic duplicate-heavy."""
     klass = pick_class(mix, rng) if mix else None
     per_class = bodies[klass]
+    if zipf is not None:
+        idx = min(bisect.bisect_left(zipf, rng.random()),
+                  len(per_class) - 1)
+        return klass, per_class[idx]
     return klass, per_class[i % len(per_class)]
+
+
+def _salted(body: bytes, i: int) -> bytes:
+    """Splice a per-request nonce field into a pre-serialized JSON body
+    so every request is byte-unique. The DEFAULT drive salts: against a
+    caching server, accidental duplicates from a small template pool
+    would measure the cache, not the server — duplicate-heavy traffic
+    is the explicit ``--shape zipf:S`` / ``replay:FILE`` opt-in. One
+    slice copy per request; the server ignores unknown fields."""
+    return body[:-1] + (',"nonce":%d}' % i).encode()
 
 
 def run_closed(url: str, requests: int, concurrency: int, bodies,
                timeout: float, mix=None, seed: int = 0,
-               retries: int = 0) -> Collector:
+               retries: int = 0, zipf=None, salt: bool = False) -> Collector:
     collector = Collector()
     counter = {"next": 0}
     lock = threading.Lock()
@@ -296,7 +410,9 @@ def run_closed(url: str, requests: int, concurrency: int, bodies,
                 if i >= requests:
                     return
                 counter["next"] = i + 1
-                klass, body = _pick_body(bodies, mix, rng, i)
+                klass, body = _pick_body(bodies, mix, rng, i, zipf=zipf)
+            if salt:
+                body = _salted(body, i)
             _one_request(url, body, timeout, collector, klass=klass,
                          retries=retries)
 
@@ -312,7 +428,8 @@ def run_closed(url: str, requests: int, concurrency: int, bodies,
 def run_open(url: str, rate: float, duration: float, bodies,
              timeout: float, max_outstanding: int = 512,
              shape: str = "constant", spike_mult: float = 5.0,
-             mix=None, seed: int = 0, retries: int = 0) -> Collector:
+             mix=None, seed: int = 0, retries: int = 0,
+             zipf=None, salt: bool = False) -> Collector:
     collector = Collector()
     sem = threading.Semaphore(max_outstanding)
     threads = []
@@ -333,7 +450,9 @@ def run_open(url: str, rate: float, duration: float, bodies,
             # outstanding cap, not a dropped request.
             collector.record_not_launched()
             continue
-        klass, body = _pick_body(bodies, mix, rng, i)
+        klass, body = _pick_body(bodies, mix, rng, i, zipf=zipf)
+        if salt:
+            body = _salted(body, i)
 
         def fire(body=body, klass=klass):
             try:
@@ -384,6 +503,26 @@ def report(collector: Collector, wall_s: float, mode: str) -> dict:
             "max": ms(lats[-1]) if lats else 0.0,
         },
     }
+    if collector.cache_hits or collector.cache_misses:
+        # Client-observed cache verdicts (X-Cache reply headers) —
+        # measured hit rate and the hit-vs-compute latency gap as the
+        # CLIENT saw them, independent of the server's own counters.
+        hit_lats = sorted(collector.hit_latencies)
+        miss_lats = sorted(collector.miss_latencies)
+        seen = collector.cache_hits + collector.cache_misses
+        out["cache_client"] = {
+            "hits": collector.cache_hits,
+            "misses": collector.cache_misses,
+            "hit_rate": round(collector.cache_hits / seen, 4),
+            "hit_latency_ms": {
+                "p50": ms(_percentile(hit_lats, 0.50)),
+                "p99": ms(_percentile(hit_lats, 0.99)),
+            },
+            "miss_latency_ms": {
+                "p50": ms(_percentile(miss_lats, 0.50)),
+                "p99": ms(_percentile(miss_lats, 0.99)),
+            },
+        }
     if collector.classes:
         # Per-priority-class goodput + tail: the shed-not-collapse
         # evidence per class (interactive p99 should stay BELOW batch
@@ -428,13 +567,18 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=5.0,
                    help="open loop: seconds to run")
     p.add_argument("--shape", type=str, default="constant",
-                   choices=["constant", "sine", "spike", "adversarial"],
-                   help="open loop traffic shape: 'sine' = one diurnal "
-                        "period over the duration (0.2x..1.8x), "
-                        "'spike' = --spike-mult x burst through the "
-                        "middle fifth, 'adversarial' = seeded "
-                        "per-second flips between 0.1x and 3x (no "
-                        "trend for a controller to learn)")
+                   help="traffic shape: 'sine' = one diurnal period "
+                        "over the duration (0.2x..1.8x), 'spike' = "
+                        "--spike-mult x burst through the middle "
+                        "fifth, 'adversarial' = seeded per-second "
+                        "flips between 0.1x and 3x (no trend for a "
+                        "controller to learn). Two BODY shapes ride a "
+                        "constant rate and work in closed mode too: "
+                        "'zipf:S' samples each request's template from "
+                        "Zipf(S) — duplicate-heavy key reuse, the "
+                        "response-cache workload — and 'replay:FILE' "
+                        "replays a JSONL trace (one request payload "
+                        "per line, in order, cycling)")
     p.add_argument("--spike-mult", type=float, default=5.0,
                    help="spike shape: burst multiple of --rate")
     p.add_argument("--mix", type=str, default=None,
@@ -513,30 +657,50 @@ def main(argv=None) -> int:
 
     url = args.url.rstrip("/")
     mix = parse_mix(args.mix)
+    rate_shape, body_shape = parse_shape(args.shape)
     extra_fields = {}
     if args.client_id:
         extra_fields["client_id"] = args.client_id
     if args.model:
         extra_fields["model"] = args.model
-    bodies = _make_images(
-        n_templates=min(16, max(1, args.requests)),
-        images_per_request=args.images_per_request, seed=args.seed,
-        extra_fields=extra_fields, mix=mix)
+    zipf = None
+    if body_shape and body_shape[0] == "replay":
+        # Trace bodies carry their own priority fields; --mix would
+        # fight the trace, so it is rejected rather than ignored.
+        if mix:
+            raise SystemExit("--shape replay:FILE and --mix are "
+                             "mutually exclusive (the trace IS the mix)")
+        bodies = load_replay(body_shape[1], extra_fields)
+    else:
+        bodies = _make_images(
+            n_templates=min(16, max(1, args.requests)),
+            images_per_request=args.images_per_request, seed=args.seed,
+            extra_fields=extra_fields, mix=mix)
+        if body_shape and body_shape[0] == "zipf":
+            zipf = zipf_cum(len(bodies[next(iter(bodies))]),
+                            body_shape[1])
 
+    # Byte-unique bodies by DEFAULT: only the duplicate-opt-in shapes
+    # (zipf, replay) send repeated bytes, so a caching server's compute
+    # path is what the default drive measures.
+    salt = body_shape is None
     t0 = time.perf_counter()
     if args.mode == "open" and not args.smoke:
         collector = run_open(url, args.rate, args.duration, bodies,
-                             args.timeout, shape=args.shape,
+                             args.timeout, shape=rate_shape,
                              spike_mult=args.spike_mult, mix=mix,
                              seed=args.seed,
-                             retries=args.retry_transport)
+                             retries=args.retry_transport, zipf=zipf,
+                             salt=salt)
     else:
         collector = run_closed(url, args.requests, args.concurrency,
                                bodies, args.timeout, mix=mix,
                                seed=args.seed,
-                               retries=args.retry_transport)
+                               retries=args.retry_transport, zipf=zipf,
+                               salt=salt)
     out = report(collector, time.perf_counter() - t0,
                  "closed" if args.smoke else args.mode)
+    out["shape"] = args.shape
     # Data-plane shape from /stats on EVERY run (not just smoke): a
     # loadgen report without the serve mode and mesh shape can't say
     # WHAT it measured. Smoke mode reuses its own /stats fetch below
