@@ -104,11 +104,25 @@ def _fetch(url: str, dest: str, timeout: float) -> None:
     tmp = f"{dest}.tmp{os.getpid()}"
     try:
         with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+            # getattr: test doubles (and file:// responses on some
+            # platforms) expose a bare file object without headers.
+            headers = getattr(r, "headers", None)
+            expected = headers.get("Content-Length") if headers else None
+            received = 0
             while True:
                 chunk = r.read(1 << 20)
                 if not chunk:
                     break
+                received += len(chunk)
                 f.write(chunk)
+            # A connection torn mid-body ends the chunk loop exactly like
+            # a complete one (read() reports EOF either way); only the
+            # byte count knows. OSError feeds _fetch_verified's
+            # delete-and-retry path instead of publishing a truncated
+            # file the gzip gate must then catch.
+            if expected is not None and received != int(expected):
+                raise OSError(f"short read from {url}: got {received} "
+                              f"of {expected} bytes")
         os.replace(tmp, dest)  # atomic publish, like checkpoint writes
     finally:
         if os.path.exists(tmp):  # mid-stream failure: no orphan partials

@@ -660,31 +660,39 @@ def _run_generation(
     started = time.monotonic()
     first_bad_exit: Optional[float] = None
     stragglers: List[int] = []
-    while True:
-        rcs = [p.poll() for p in procs]
-        if all(rc is not None for rc in rcs):
-            break
-        now = time.monotonic()
-        if first_bad_exit is None and any(
-                rc is not None and rc != 0 for rc in rcs):
-            first_bad_exit = now
-        over_settle = (first_bad_exit is not None
-                       and now - first_bad_exit > settle_timeout)
-        over_total = now - started > generation_timeout
-        if over_settle or over_total:
-            why = ("settle deadline" if over_settle
-                   else "generation timeout")
-            for rank, p in enumerate(procs):
-                if p.poll() is None:
-                    _say(f"generation {generation}: rank {rank} (host "
-                         f"{members[rank]}) still running past the {why} "
-                         f"({settle_timeout if over_settle else generation_timeout:g}s); killing it")
-                    stragglers.append(rank)
-                    p.kill()
-            for p in procs:
-                p.wait()
-            break
-        time.sleep(0.2)
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                break
+            now = time.monotonic()
+            if first_bad_exit is None and any(
+                    rc is not None and rc != 0 for rc in rcs):
+                first_bad_exit = now
+            over_settle = (first_bad_exit is not None
+                           and now - first_bad_exit > settle_timeout)
+            over_total = now - started > generation_timeout
+            if over_settle or over_total:
+                why = ("settle deadline" if over_settle
+                       else "generation timeout")
+                for rank, p in enumerate(procs):
+                    if p.poll() is None:
+                        _say(f"generation {generation}: rank {rank} (host "
+                             f"{members[rank]}) still running past the {why} "
+                             f"({settle_timeout if over_settle else generation_timeout:g}s); killing it")
+                        stragglers.append(rank)
+                break
+            time.sleep(0.2)
+    finally:
+        # Every exit path — normal drain, deadline kill, or an exception
+        # mid-wait (KeyboardInterrupt included) — reaps every child: an
+        # unreaped rank would keep its TPU chips allocated long past the
+        # generation (the thread-lifecycle protected-reap rule).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
 
     result = GenerationResult(
         generation=generation, members=list(members),

@@ -931,6 +931,17 @@ def http_exchange(url: str, *, method: str = "GET",
             data = resp.read()
         except Exception as exc:  # noqa: BLE001 - classified by caller
             raise TransportError(exc, body_started=True) from exc
+        # http.client only raises IncompleteRead for CHUNK-framed short
+        # bodies; a Content-Length body torn mid-stream comes back as
+        # plain short bytes. Verify the count or truncated JSON reaches
+        # json.loads as a decode error the retry logic misclassifies
+        # (the distrib/fetch.py torn-chunk incident, one layer over).
+        expected = resp.getheader("Content-Length")
+        if expected and expected.isdigit() and len(data) != int(expected):
+            raise TransportError(
+                OSError(f"short body from {url}: got {len(data)} of "
+                        f"{expected} bytes"),
+                body_started=True)
         return resp.status, dict(resp.headers.items()), data
     finally:
         conn.close()
@@ -1576,19 +1587,27 @@ class RouterContext:
         proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
         url = None
-        deadline = time.monotonic() + 120.0
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline() if proc.stdout else ""
-            if not line:
-                if proc.poll() is not None:
+        try:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline() if proc.stdout else ""
+                if not line:
+                    if proc.poll() is not None:
+                        break
+                    continue
+                match = re.search(r"serving on (http://\S+)", line)
+                if match:
+                    url = match.group(1)
                     break
-                continue
-            match = re.search(r"serving on (http://\S+)", line)
-            if match:
-                url = match.group(1)
-                break
+        finally:
+            # No URL — deadline, early exit, or an exception while
+            # parsing — means nobody will ever own this process: kill
+            # AND wait here, or the half-booted backend leaks (kill
+            # without wait still leaves a zombie holding its chips).
+            if url is None:
+                proc.kill()
+                proc.wait()
         if url is None:
-            proc.kill()
             self.event("fleet_scale_up_failed")
             return False
         # The spawned process keeps writing to stdout; drain it on a

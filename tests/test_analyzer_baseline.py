@@ -162,7 +162,7 @@ def test_parse_error_findings_cannot_be_baselined(tmp_path):
 
 _TOP_KEYS = {"schema_version", "paths", "checkers", "findings",
              "suppressed", "stale_baseline", "baseline_problems",
-             "reports", "summary"}
+             "reports", "cache", "summary"}
 _FINDING_KEYS = {"checker", "path", "line", "col", "message", "hint",
                  "symbol"}
 _SUMMARY_KEYS = {"files", "findings", "suppressed", "stale_baseline", "ok"}

@@ -1,0 +1,138 @@
+"""Fixture suite: the donated-reuse checker.
+
+Pins the PR 7 carry hazard: ``donate_argnums`` lets XLA update buffers
+in place, which makes the caller's reference a dangling handle — any
+read of the donated argument after the call (or a loop that re-donates
+without rebinding) touches freed memory.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src, filename="snippet.py"):
+    return analyze_snippet(src, checkers=["donated-reuse"],
+                           filename=filename)
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_read_after_donating_call():
+    src = """
+import jax
+
+def train(state, batch):
+    step = jax.jit(update, donate_argnums=(0,))
+    new_state = step(state, batch)
+    loss = metrics(state)
+    return new_state, loss
+"""
+    (f,) = _findings(src)
+    assert "'state'" in f.message and "PR 7" in f.message
+    assert f.line == 7  # the read, not the call
+
+
+def test_fires_on_loop_that_never_rebinds_the_carry():
+    src = """
+import jax
+
+def train(state, batches):
+    step = jax.jit(update, donate_argnums=(0,))
+    for batch in batches:
+        out = step(state, batch)
+"""
+    (f,) = _findings(src)
+    assert "every loop iteration" in f.message
+
+
+def test_fires_through_a_factory_binding():
+    """The make_step idiom: the donating jit lives in a factory the
+    index resolves; the caller's binding inherits its positions."""
+    src = """
+import jax
+
+def make_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+
+def train(state, batch):
+    step = make_step(update)
+    new_state = step(state, batch)
+    print(state.mean())
+"""
+    (f,) = _findings(src)
+    assert "'state'" in f.message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_clean_on_rebound_carry():
+    src = """
+import jax
+
+def train(state, batches):
+    step = jax.jit(update, donate_argnums=(0,))
+    for batch in batches:
+        state = step(state, batch)
+    return state
+"""
+    assert _findings(src) == []
+
+
+def test_clean_without_donation():
+    src = """
+import jax
+
+def train(state, batch):
+    step = jax.jit(update)
+    new_state = step(state, batch)
+    loss = metrics(state)
+    return new_state, loss
+"""
+    assert _findings(src) == []
+
+
+def test_clean_when_read_happens_after_rebinding():
+    src = """
+import jax
+
+def train(state, batch):
+    step = jax.jit(update, donate_argnums=(1,))
+    state = step(batch, state)
+    return metrics(state)
+"""
+    assert _findings(src) == []
+
+
+def test_clean_on_nondonated_position():
+    src = """
+import jax
+
+def train(state, batch):
+    step = jax.jit(update, donate_argnums=(0,))
+    new_state = step(state, batch)
+    stats = summarize(batch)
+    return new_state, stats
+"""
+    assert _findings(src) == []
+
+
+# -- the real donation sites stay clean --------------------------------------
+
+
+_SERVE = pathlib.Path(_REPO) / "pytorch_distributed_mnist_tpu" / "serve"
+
+
+def test_real_serve_programs_are_clean():
+    for name in ("programs.py", "engine.py"):
+        path = _SERVE / name
+        assert _findings(path.read_text(), filename=name) == [], name

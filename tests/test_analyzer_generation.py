@@ -1,0 +1,184 @@
+"""Fixture suite: the generation-ordering checker.
+
+Pins the PR 4 hot-reload swap (install without re-comparing the epoch
+under the lock) and the PR 19 stale-cache-insert (a response computed
+against generation G inserted after the bump to G+1) — the same
+sentence at two layers: snapshot the counter under the lock, compute
+outside, re-compare under the lock immediately before the install.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src, filename="snippet.py"):
+    return analyze_snippet(src, checkers=["generation-ordering"],
+                           filename=filename)
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_swap_without_recompare():
+    """The PR 4 swap_params shape: caller-snapshotted epoch, install
+    under the lock, no compare under the lock."""
+    src = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._epoch = 0
+
+    def swap_params(self, params, epoch):
+        placed = self.place(params)
+        with self._lock:
+            self._params = placed
+            self._epoch = epoch
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "Engine.swap_params"
+    assert "self._params" in f.message and "PR 4" in f.message
+
+
+def test_fires_on_stale_cache_insert():
+    """The PR 19 shape: a subscript install into a self container under
+    the lock, generation passed in, never re-compared."""
+    src = """
+import threading
+
+class ResponseCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._entries = {}
+
+    def put(self, key, value, generation):
+        with self._lock:
+            self._entries[key] = (value, generation)
+"""
+    (f,) = _findings(src)
+    assert f.symbol == "ResponseCache.put"
+    assert "self._entries" in f.message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_clean_with_recompare_under_the_lock():
+    src = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._epoch = 0
+
+    def swap_params(self, params, epoch):
+        placed = self.place(params)
+        with self._lock:
+            if epoch <= self._epoch:
+                return
+            self._params = placed
+            self._epoch = epoch
+"""
+    assert _findings(src) == []
+
+
+def test_clean_when_the_compare_lives_in_a_resolvable_callee():
+    """Cross-module rule: the engine->pool->watcher fan-outs delegate
+    the ordering compare; the index follows the call."""
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._epoch = 0
+
+    def _stale(self, epoch):
+        return epoch <= self._epoch
+
+    def install(self, params, epoch):
+        with self._lock:
+            if self._stale(epoch):
+                return
+            self._params = params
+            self._epoch = epoch
+"""
+    assert _findings(src) == []
+
+
+def test_clean_on_generation_producer_without_counter_param():
+    """resize/regroup bump the counter themselves — the producer, not a
+    stale consumer racing it; no caller-supplied counter, no finding."""
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._generation = 0
+
+    def resize(self, n):
+        replicas = self.build(n)
+        with self._lock:
+            self.replicas = replicas
+            self._generation += 1
+"""
+    assert _findings(src) == []
+
+
+def test_clean_on_counterless_stats_update():
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._generation = 0
+
+    def note(self, n):
+        with self._lock:
+            self._count = n
+"""
+    assert _findings(src) == []
+
+
+# -- reversion: the real swap path stays pinned ------------------------------
+
+
+_ENGINE = pathlib.Path(_REPO) / "pytorch_distributed_mnist_tpu" / \
+    "serve" / "engine.py"
+
+
+def test_real_engine_swap_is_clean():
+    assert _findings(_ENGINE.read_text(), filename="engine.py") == []
+
+
+def test_removing_the_swap_epoch_compare_fails_the_gate():
+    """Delete swap_params' under-lock staleness compare — the exact
+    PR 4 bug — and the checker must flag the install with file:line."""
+    source = _ENGINE.read_text()
+    guard = ("            if (epoch is not None and self._params_epoch "
+             "is not None\n"
+             "                    and epoch < self._params_epoch):\n"
+             "                return False  # a newer checkpoint "
+             "already installed\n")
+    assert guard in source, (
+        "engine.py swap_params no longer carries the epoch guard this "
+        "test re-narrows — evolve the fixture with the code")
+    broken = source.replace(guard, "", 1)
+    findings = _findings(broken, filename="engine.py")
+    assert findings, "guardless swap install was not flagged"
+    f = findings[0]
+    assert f.path == "engine.py" and f.line > 0
+    assert "swap_params" in f.symbol

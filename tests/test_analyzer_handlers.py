@@ -1,0 +1,160 @@
+"""Fixture suite: the handler-discipline checker + the real handlers.
+
+Pins the PR 10 ``/resize`` incident: a handler branch that returns
+without writing a status line is a dropped connection to the client;
+two replies on one path corrupt keep-alive framing.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src, filename="snippet.py"):
+    return analyze_snippet(src, checkers=["handler-discipline"],
+                           filename=filename)
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_branch_that_never_replies():
+    """The PR 10 /resize shape: an early return with no status line."""
+    src = """
+class Handler:
+    def do_POST(self):
+        if self.path == "/resize":
+            if self.busy:
+                return
+            self.send_response(200)
+            return
+        self.send_error(404)
+"""
+    findings = _findings(src)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == "Handler.do_POST"
+    assert "dropped" in f.message and "PR 10" in f.message
+
+
+def test_fires_on_double_reply_path():
+    src = """
+class Handler:
+    def do_GET(self):
+        self.send_response(200)
+        if self.path == "/stats":
+            self.send_response(200)
+        self.wfile.write(b"{}")
+"""
+    findings = _findings(src)
+    assert len(findings) == 1
+    assert "more than one response" in findings[0].message
+
+
+def test_fires_on_unbounded_body_read():
+    src = """
+class Handler:
+    def do_POST(self):
+        body = self.rfile.read()
+        self.send_response(200)
+"""
+    findings = _findings(src)
+    assert len(findings) == 1
+    assert "blocks forever" in findings[0].message
+
+
+def test_fires_when_one_except_arm_swallows_without_reply():
+    """An exception handler that logs and falls off the end drops the
+    connection exactly like an early return."""
+    src = """
+class Handler:
+    def do_GET(self):
+        try:
+            payload = self.compute()
+        except ValueError:
+            return
+        self.send_response(200)
+"""
+    findings = _findings(src)
+    assert len(findings) == 1
+    assert "dropped" in findings[0].message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_clean_when_every_branch_replies_once():
+    src = """
+class Handler:
+    def do_GET(self):
+        if self.path == "/healthz":
+            self.send_response(200)
+            return
+        self.send_error(404)
+"""
+    assert _findings(src) == []
+
+
+def test_clean_when_reply_goes_through_a_resolvable_helper():
+    """The index follows self._reply -> send_response, so helper-based
+    handlers need no special-casing."""
+    src = """
+class Handler:
+    def _reply(self, code, body):
+        self.send_response(code)
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, b"{}")
+            return
+        self._reply(404, b"")
+"""
+    assert _findings(src) == []
+
+
+def test_clean_when_a_branch_raises():
+    """A raise terminal is the server loop's problem, not a drop."""
+    src = """
+class Handler:
+    def do_POST(self):
+        if self.path not in self.routes:
+            raise KeyError(self.path)
+        self.send_response(200)
+"""
+    assert _findings(src) == []
+
+
+def test_clean_on_length_bounded_body_read():
+    src = """
+class Handler:
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        self.send_response(200)
+"""
+    assert _findings(src) == []
+
+
+# -- the real handlers stay clean --------------------------------------------
+
+
+_SERVER = pathlib.Path(_REPO) / "pytorch_distributed_mnist_tpu" / \
+    "serve" / "server.py"
+_ROUTER = pathlib.Path(_REPO) / "pytorch_distributed_mnist_tpu" / \
+    "serve" / "router.py"
+
+
+def test_real_server_handlers_are_clean():
+    assert _findings(_SERVER.read_text(), filename="server.py") == []
+
+
+def test_real_router_handlers_are_clean():
+    assert _findings(_ROUTER.read_text(), filename="router.py") == []

@@ -1,0 +1,164 @@
+"""Fixture suite: the short-read checker + the real fetch paths.
+
+Pins the PR 19 distrib/fetch.py torn-chunk incident: ``http.client``
+only raises ``IncompleteRead`` for chunk-framed bodies — a
+Content-Length body torn mid-stream comes back as plain short bytes,
+and only comparing the received count against the header catches it.
+The reversion tests re-remove the shipped fixes from the REAL files
+(data/download.py ``_fetch``, serve/router.py ``http_exchange``) and
+assert the checker reproduces a file:line finding.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.analyzer import analyze_snippet  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _findings(src, filename="snippet.py"):
+    return analyze_snippet(src, checkers=["short-read"],
+                           filename=filename)
+
+
+# -- firing ------------------------------------------------------------------
+
+
+def test_fires_on_chunked_read_loop_without_length_check():
+    """The download.py shape before the fix: a torn connection ends the
+    chunk loop exactly like a complete body."""
+    src = """
+import urllib.request
+
+def fetch(url, dest):
+    with urllib.request.urlopen(url) as r, open(dest, "wb") as f:
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+"""
+    (f,) = _findings(src)
+    assert "Content-Length" in f.message and "torn" in f.message
+
+
+def test_fires_on_getresponse_read_without_length_check():
+    """The router.py http_exchange shape before the fix."""
+    src = """
+import http.client
+
+def exchange(host, path):
+    conn = http.client.HTTPConnection(host)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    return resp.status, data
+"""
+    (f,) = _findings(src)
+    assert "Content-Length" in f.message
+
+
+# -- non-firing --------------------------------------------------------------
+
+
+def test_clean_when_received_count_is_compared():
+    src = """
+import urllib.request
+
+def fetch(url, dest):
+    with urllib.request.urlopen(url) as r, open(dest, "wb") as f:
+        expected = r.headers.get("Content-Length")
+        received = 0
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            received += len(chunk)
+            f.write(chunk)
+        if expected is not None and received != int(expected):
+            raise OSError("short read")
+"""
+    assert _findings(src) == []
+
+
+def test_clean_when_body_feeds_json_loads():
+    """json.loads is its own truncation detector: torn JSON raises."""
+    src = """
+import json, urllib.request
+
+def get_json(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+"""
+    assert _findings(src) == []
+
+
+def test_clean_when_read_result_is_discarded():
+    """The loadgen drain shape: the bytes are thrown away, truncation
+    cannot corrupt anything."""
+    src = """
+import urllib.request
+
+def drain(url):
+    with urllib.request.urlopen(url) as r:
+        r.read()
+"""
+    assert _findings(src) == []
+
+
+def test_clean_on_nonhttp_reads():
+    src = """
+def load(path):
+    with open(path, "rb") as f:
+        return f.read()
+"""
+    assert _findings(src) == []
+
+
+# -- reversion: re-remove the shipped fixes from the REAL files --------------
+
+
+_DOWNLOAD = pathlib.Path(_REPO) / "pytorch_distributed_mnist_tpu" / \
+    "data" / "download.py"
+_ROUTER = pathlib.Path(_REPO) / "pytorch_distributed_mnist_tpu" / \
+    "serve" / "router.py"
+
+
+def test_removing_the_download_length_check_fails_the_gate():
+    source = _DOWNLOAD.read_text()
+    guard = "expected is not None and received != int(expected)"
+    assert guard in source, (
+        "download.py _fetch no longer verifies Content-Length — evolve "
+        "this fixture with the code")
+    broken = source.replace(guard, "False", 1)
+    findings = _findings(broken, filename="download.py")
+    assert findings, "unverified chunk loop was not flagged"
+    f = findings[0]
+    assert f.path == "download.py" and f.line > 0
+    assert f.symbol == "_fetch"
+
+
+def test_pristine_download_is_clean():
+    assert _findings(_DOWNLOAD.read_text(), filename="download.py") == []
+
+
+def test_removing_the_router_length_check_fails_the_gate():
+    source = _ROUTER.read_text()
+    assert "len(data) != int(expected)" in source, (
+        "router.py http_exchange no longer verifies Content-Length — "
+        "evolve this fixture with the code")
+    broken = source.replace("len(data) != int(expected)", "False", 1)
+    findings = _findings(broken, filename="router.py")
+    assert findings, "unverified body read was not flagged"
+    f = findings[0]
+    assert f.path == "router.py" and f.line > 0
+    assert f.symbol == "http_exchange"
+
+
+def test_pristine_router_is_clean():
+    assert _findings(_ROUTER.read_text(), filename="router.py") == []

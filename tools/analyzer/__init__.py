@@ -16,6 +16,21 @@ from PRs 1-4 (docs/DESIGN.md §8 maps checker -> incident):
 - ``registry-drift``        fault-point registry == maybe_fault hooks
 - ``marker-registry``       pytest markers used == markers registered
 
+Analyzer v2 (PRs 6-19 incident record) adds a project-wide def/call
+index (``_ast_util.ProjectIndex``: import + ``self._attr = fn`` factory
+resolution, reachability queries), per-file content-hash caching of
+findings, a SARIF emitter, and five cross-module checkers:
+
+- ``thread-lifecycle``      every Thread/Timer/Popen join/reap-reachable
+                            on all exit paths of its owner
+- ``handler-discipline``    every do_GET/do_POST branch replies exactly
+                            once; body reads length-bounded
+- ``generation-ordering``   installs under a lock re-compare the
+                            generation/epoch counter under that lock
+- ``short-read``            HTTP body reads verify Content-Length
+- ``donated-reuse``         no reads of a donate_argnums argument after
+                            the donating call
+
 Run it::
 
     python -m tools.analyzer [--format text|json] [--baseline FILE] [paths]
@@ -39,7 +54,9 @@ from tools.analyzer.core import (
     analyze_snippet,
     checker_registry,
     default_baseline_path,
+    default_cache_path,
     load_baseline,
+    render_sarif,
     render_text,
     run_analysis,
 )
@@ -53,7 +70,9 @@ __all__ = [
     "analyze_snippet",
     "checker_registry",
     "default_baseline_path",
+    "default_cache_path",
     "load_baseline",
+    "render_sarif",
     "render_text",
     "run_analysis",
 ]

@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 # Standalone invocation from anywhere: the repo root (two levels up) must
@@ -20,6 +21,8 @@ if _REPO not in sys.path:
 
 from tools.analyzer import (  # noqa: E402
     checker_registry,
+    default_cache_path,
+    render_sarif,
     render_text,
     run_analysis,
 )
@@ -29,18 +32,45 @@ from tools.analyzer import (  # noqa: E402
 DEFAULT_PATHS = ("pytorch_distributed_mnist_tpu", "tools", "bench.py")
 
 
+def _git_changed_files():
+    """Modified + untracked .py files from git, repo-root relative
+    absolute paths; None when git is unavailable (not a checkout)."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=_REPO,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    files = []
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: the new side is what exists now
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            files.append(os.path.join(_REPO, path))
+    return files
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tools.analyzer",
         description="tpumnist-lint: AST invariant checker (collective "
                     "symmetry, agreement except-breadth, trace purity, "
-                    "recompile hazards, lock discipline, registry drift)",
+                    "recompile hazards, lock discipline, registry "
+                    "drift, thread lifecycle, handler discipline, "
+                    "generation ordering, short reads, donated reuse)",
     )
     p.add_argument("paths", nargs="*", default=None,
                    help=f"files/directories to analyze (default: "
                         f"{' '.join(DEFAULT_PATHS)}, resolved from the "
                         f"repo root)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="baseline file of triaged-accepted findings "
                         "(default: tools/analyzer/baseline.json)")
@@ -49,6 +79,13 @@ def main(argv=None) -> int:
     p.add_argument("--checkers", default=None, metavar="ID[,ID...]",
                    help="run only these checkers")
     p.add_argument("--list-checkers", action="store_true")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the per-file content-hash findings cache "
+                        "(tools/analyzer/.cache.json)")
+    p.add_argument("--changed", action="store_true",
+                   help="analyze only files git reports as changed, "
+                        "plus their reverse dependencies from the "
+                        "cross-module import graph")
     args = p.parse_args(argv)
 
     if args.list_checkers:
@@ -71,14 +108,28 @@ def main(argv=None) -> int:
     else:
         baseline = "default"
 
+    changed = None
+    if args.changed:
+        changed = _git_changed_files()
+        if changed is None:
+            print("warning: --changed needs a git checkout; analyzing "
+                  "everything", file=sys.stderr)
+
+    cache = None
+    if not args.no_cache and changed is None:
+        cache = default_cache_path()
+
     try:
-        result = run_analysis(paths, checkers=checkers, baseline=baseline)
+        result = run_analysis(paths, checkers=checkers, baseline=baseline,
+                              cache=cache, changed=changed)
     except ValueError as exc:  # unknown checker ids
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    elif args.format == "sarif":
+        print(render_sarif(result))
     else:
         print(render_text(result))
     if any(f.checker == "usage" for f in result.findings):

@@ -2,18 +2,26 @@
 
 Ordered: the order here is the order checkers run and the order
 ``--list-checkers`` prints. Each module exposes ``CHECKER_ID`` and
-``run(modules) -> CheckerResult``.
+``run(modules) -> CheckerResult`` — or, with ``NEEDS_INDEX = True``,
+``run(modules, index)`` taking the project-wide
+:class:`~tools.analyzer._ast_util.ProjectIndex` (the analyzer v2
+cross-module checkers).
 """
 
 from __future__ import annotations
 
 from tools.analyzer.checkers import (
     collective_symmetry,
+    donated_reuse,
     exception_breadth,
+    generation_ordering,
+    handler_discipline,
     lock_discipline,
     marker_registry,
     recompile_hazard,
     registry_drift,
+    short_read,
+    thread_lifecycle,
     trace_purity,
 )
 
@@ -27,6 +35,11 @@ REGISTRY = {
         lock_discipline,
         registry_drift,
         marker_registry,
+        thread_lifecycle,
+        handler_discipline,
+        generation_ordering,
+        short_read,
+        donated_reuse,
     )
 }
 

@@ -1,0 +1,249 @@
+"""donated-reuse: a donated buffer is dead the moment the call returns.
+
+The incident this encodes (docs/DESIGN.md §8): PR 7's fused train loop
+donated the carry (``donate_argnums``) so XLA could update parameters
+in place — which makes the *caller's* reference a dangling handle. The
+shipped hazard was reading the old carry after the step (metrics
+computed on donated params raise ``RuntimeError: invalid buffer`` at
+best and alias freed memory at worst); the loop had to be written as
+``state = step(state, batch)`` with nothing touching the old ``state``
+afterwards.
+
+Detection, per module (cross-module through the project index):
+
+1. Donating bindings: ``f = jax.jit(fn, donate_argnums=(..))`` bound to
+   a name or ``self`` attribute — or bound from a *factory* call whose
+   resolved function returns such a jit (the ``make_step(...)`` idiom).
+2. At each call through a donating binding, for every argument at a
+   donated position that is a plain name/attribute chain:
+   - straight-line reuse: the name is read again after the call before
+     any rebinding — firing;
+   - loop carry: the call sits in a ``for``/``while`` body that never
+     rebinds the name — the next iteration re-donates a dead buffer —
+     firing. (``state = step(state, ...)`` rebinding on the same
+     statement is the blessed shape.)
+
+The analysis is lexical (line-ordered within one function); dynamic
+``donate_argnums`` values and donated positions passed as ``**kwargs``
+are out of scope and never fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyzer._ast_util import (
+    call_name,
+    dotted_name,
+    int_constants,
+    iter_functions,
+    last_segment,
+    walk_body_in_scope,
+)
+from tools.analyzer.core import CheckerResult, Finding
+
+CHECKER_ID = "donated-reuse"
+NEEDS_INDEX = True
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Positions a jit-like call donates, None when it is not donating
+    (or the positions are dynamic)."""
+    if last_segment(call_name(call)) not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = int_constants(kw.value)
+            return tuple(vals) if vals else None
+    return None
+
+
+def _factory_returns(index) -> Dict[str, Tuple[int, ...]]:
+    """fq -> donated positions, for functions whose return value is a
+    donating jit call (the make_step factory idiom)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for fq, info in index.functions.items():
+        for sub in walk_body_in_scope(info.node.body):
+            if isinstance(sub, ast.Return) and \
+                    isinstance(sub.value, ast.Call):
+                pos = _donated_positions(sub.value)
+                if pos:
+                    out[fq] = pos
+    return out
+
+
+def _donating_bindings(fn: ast.AST, module, classname: Optional[str],
+                       index, factories: Dict[str, Tuple[int, ...]]
+                       ) -> Dict[str, Tuple[int, ...]]:
+    """dotted binding name -> donated positions, for bindings made in
+    ``fn`` (``step = jax.jit(...)`` / ``self._step = make_step(...)``)."""
+    bindings: Dict[str, Tuple[int, ...]] = {}
+    for sub in walk_body_in_scope(fn.body):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.value, ast.Call)):
+            continue
+        target = dotted_name(sub.targets[0])
+        if not target:
+            continue
+        pos = _donated_positions(sub.value)
+        if pos is None:
+            for fq in index.resolve_call(sub.value, module, classname):
+                if fq in factories:
+                    pos = factories[fq]
+                    break
+        if pos:
+            bindings[target] = pos
+    return bindings
+
+
+def _loads_of(node: ast.AST, dotted: str) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for sub in ast.walk(node):
+        if dotted_name(sub) == dotted and \
+                isinstance(getattr(sub, "ctx", None), ast.Load):
+            out.append(sub)
+    return out
+
+
+def _rebind_lines(fn: ast.AST, dotted: str) -> Set[int]:
+    lines: Set[int] = set()
+    for sub in walk_body_in_scope(fn.body):
+        targets: List[ast.expr] = []
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+            targets = [sub.target]
+        elif isinstance(sub, ast.For):
+            targets = [sub.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if dotted_name(n) == dotted and \
+                        isinstance(getattr(n, "ctx", None), ast.Store):
+                    lines.add(sub.lineno)
+    return lines
+
+
+def _enclosing_loop(call: ast.Call,
+                    parents: Dict[int, ast.AST]) -> Optional[ast.AST]:
+    cur: ast.AST = call
+    while True:
+        parent = parents.get(id(cur))
+        if parent is None:
+            return None
+        if isinstance(parent, (ast.For, ast.While)):
+            return parent
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return None
+        cur = parent
+
+
+def _parent_map(fn: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _check_call(call: ast.Call, positions: Tuple[int, ...], fn: ast.AST,
+                module, symbol: str,
+                parents: Dict[int, ast.AST]) -> List[Finding]:
+    findings: List[Finding] = []
+    for pos in positions:
+        if pos >= len(call.args):
+            continue
+        arg = dotted_name(call.args[pos])
+        if not arg:
+            continue
+        rebinds = _rebind_lines(fn, arg)
+        call_end = getattr(call, "end_lineno", call.lineno)
+        next_rebind = min((ln for ln in rebinds if ln >= call.lineno),
+                          default=None)
+        # straight-line: a read after the call, before any rebinding
+        for load in sorted(_loads_of(fn, arg), key=lambda n: n.lineno):
+            if load.lineno <= call_end:
+                continue
+            if next_rebind is not None and load.lineno > next_rebind:
+                break
+            findings.append(Finding(
+                checker=CHECKER_ID, path=module.path,
+                line=load.lineno, col=load.col_offset, symbol=symbol,
+                message=f"{arg!r} was donated at line {call.lineno} "
+                        f"(donate_argnums position {pos}) and is read "
+                        f"again here — the buffer no longer exists "
+                        f"(the PR 7 carry hazard)",
+                hint="use the call's RESULT; a donated argument is "
+                     "consumed by the callee"))
+            break
+        # loop carry: donated every iteration but never rebound
+        loop = _enclosing_loop(call, parents)
+        if loop is not None:
+            loop_end = getattr(loop, "end_lineno", loop.lineno)
+            rebound_in_loop = any(
+                loop.lineno <= ln <= loop_end for ln in rebinds)
+            if not rebound_in_loop:
+                findings.append(Finding(
+                    checker=CHECKER_ID, path=module.path,
+                    line=call.lineno, col=call.col_offset,
+                    symbol=symbol,
+                    message=f"{arg!r} is donated every loop iteration "
+                            f"but never rebound — the second iteration "
+                            f"donates a buffer the first already "
+                            f"consumed",
+                    hint="carry the result: `x = fn(x, ...)`"))
+    return findings
+
+
+def run(modules, index) -> CheckerResult:
+    findings: List[Finding] = []
+    factories = _factory_returns(index)
+    n_bindings = 0
+    for module in modules:
+        for fn, qual, classname in iter_functions(module.tree):
+            bindings = _donating_bindings(fn, module, classname, index,
+                                          factories)
+            # bindings made on self in __init__ are visible to every
+            # method of the class
+            n_bindings += len(bindings)
+            if not bindings:
+                continue
+            parents = _parent_map(fn)
+            for sub in walk_body_in_scope(fn.body):
+                if isinstance(sub, ast.Call):
+                    name = dotted_name(sub.func)
+                    if name in bindings:
+                        findings.extend(_check_call(
+                            sub, bindings[name], fn, module, qual,
+                            parents))
+    # self-attribute bindings cross method boundaries: collect per class
+    for module in modules:
+        class_bindings: Dict[Optional[str], Dict[str, Tuple[int, ...]]] \
+            = {}
+        for fn, qual, classname in iter_functions(module.tree):
+            if classname is None:
+                continue
+            b = _donating_bindings(fn, module, classname, index,
+                                   factories)
+            selfb = {k: v for k, v in b.items() if k.startswith("self.")}
+            if selfb:
+                class_bindings.setdefault(classname, {}).update(selfb)
+        if not class_bindings:
+            continue
+        for fn, qual, classname in iter_functions(module.tree):
+            bindings = class_bindings.get(classname)
+            if not bindings:
+                continue
+            local = _donating_bindings(fn, module, classname, index,
+                                       factories)
+            parents = _parent_map(fn)
+            for sub in walk_body_in_scope(fn.body):
+                if isinstance(sub, ast.Call):
+                    name = dotted_name(sub.func)
+                    if name in bindings and name not in local:
+                        findings.extend(_check_call(
+                            sub, bindings[name], fn, module, qual,
+                            parents))
+    return CheckerResult(findings=findings,
+                         report={"donating_bindings": n_bindings})

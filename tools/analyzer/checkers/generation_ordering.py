@@ -1,0 +1,213 @@
+"""generation-ordering: re-compare the counter under the lock you
+install under.
+
+The incident record (docs/DESIGN.md §8): the PR 4 hot-reload swap
+installed params that were placed OUTSIDE the lock; without re-comparing
+the epoch UNDER the lock before the install, a slow old fan-out could
+overwrite a newer model (``serve/engine.py swap_params``). PR 19 hit the
+identical shape one layer up: a response computed against generation G
+must not be inserted into the cache after the generation bumped to G+1
+(``serve/economics.py ResponseCache.put``). Both fixes are the same
+sentence: *snapshot the counter under the lock, compute outside, then
+re-compare under the lock immediately before the install.*
+
+Mechanically, for every class that owns BOTH a lock attribute and a
+generation-ish counter (an attribute or parameter matching
+``generation|epoch|version``):
+
+- a method that *receives* a counter as a parameter (``epoch=``,
+  ``generation=`` — the caller-snapshot shape both incidents share) and
+  then assigns non-counter state to ``self`` (or into a ``self``
+  container) inside a ``with self.<lock>`` block must ALSO compare a
+  counter inside that block — directly, or inside any callee the
+  cross-module index can resolve from the block (the
+  ``engine -> pool -> watcher`` fan-outs are checked end-to-end this
+  way).
+- ``AugAssign`` bumps of the counter itself are exempt (that IS the
+  generation bump). Methods with no counter parameter are exempt even
+  when they read/bump ``self``'s own counter: they are the generation
+  *producers* (resize/regroup bump the counter as part of the install),
+  not stale consumers racing it — and plain stats updates under a lock
+  are not this checker's business either.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from tools.analyzer._ast_util import (
+    call_name,
+    dotted_name,
+    function_param_names,
+    iter_functions,
+    last_segment,
+    module_name,
+    walk_body_in_scope,
+    walk_in_scope,
+)
+from tools.analyzer.core import CheckerResult, Finding
+
+CHECKER_ID = "generation-ordering"
+NEEDS_INDEX = True
+
+_COUNTER_RE = re.compile(r"(generation|epoch|version)", re.IGNORECASE)
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _class_locks_and_counters(class_node: ast.ClassDef):
+    locks: Set[str] = set()
+    counters: Set[str] = set()
+    for sub in ast.walk(class_node):
+        target = None
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+            target = sub.targets[0]
+            value = sub.value
+        elif isinstance(sub, ast.AugAssign):
+            target = sub.target
+            value = None
+        else:
+            continue
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            continue
+        if value is not None and isinstance(value, ast.Call) and \
+                last_segment(call_name(value)) in _LOCK_CTORS:
+            locks.add(target.attr)
+        if _COUNTER_RE.search(target.attr):
+            counters.add(target.attr)
+    return locks, counters
+
+
+def _counter_tokens(node: ast.AST, counters: Set[str],
+                    params: Set[str]) -> bool:
+    """Does ``node`` mention a counter attribute or parameter?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in counters and \
+                isinstance(sub.value, ast.Name) and sub.value.id == "self":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in params:
+            return True
+    return False
+
+
+def _block_compares_counter(block: ast.With, counters: Set[str],
+                            params: Set[str], module,
+                            classname: Optional[str], index) -> bool:
+    for sub in walk_body_in_scope(block.body):
+        if isinstance(sub, ast.Compare) and \
+                _counter_tokens(sub, counters, params):
+            return True
+    # A callee invoked inside the block may own the compare (the pool
+    # delegates the ordering rule to each engine's swap_params).
+    for sub in walk_body_in_scope(block.body):
+        if not isinstance(sub, ast.Call):
+            continue
+        for fq in index.resolve_call(sub, module, classname):
+            info = index.functions.get(fq)
+            if info is None:
+                continue
+            callee_params = {p for p in function_param_names(info.node)
+                             if _COUNTER_RE.search(p)}
+            for inner in walk_body_in_scope(info.node.body):
+                if isinstance(inner, ast.Compare) and _counter_tokens(
+                        inner, counters | _any_counter_attrs(info),
+                        callee_params):
+                    return True
+    return False
+
+
+def _any_counter_attrs(info) -> Set[str]:
+    out: Set[str] = set()
+    for sub in walk_in_scope(info.node):
+        if isinstance(sub, ast.Attribute) and _COUNTER_RE.search(sub.attr):
+            out.add(sub.attr)
+    return out
+
+
+def _installs_in_block(block: ast.With, counters: Set[str],
+                       locks: Set[str]):
+    for sub in walk_body_in_scope(block.body):
+        if not isinstance(sub, ast.Assign):
+            continue
+        for target in sub.targets:
+            attr = None
+            if isinstance(target, ast.Attribute):
+                attr_node = target
+            elif isinstance(target, ast.Subscript) and \
+                    isinstance(target.value, ast.Attribute):
+                attr_node = target.value
+            else:
+                continue
+            if not (isinstance(attr_node.value, ast.Name)
+                    and attr_node.value.id == "self"):
+                continue
+            attr = attr_node.attr
+            if attr in counters or attr in locks:
+                continue  # stamping the counter IS the protocol
+            yield sub, attr
+
+
+def _lock_blocks(fn: ast.AST, locks: Set[str]):
+    for sub in walk_body_in_scope(fn.body):
+        if isinstance(sub, ast.With):
+            for item in sub.items:
+                d = dotted_name(item.context_expr)
+                if d and d.startswith("self.") and \
+                        d.split(".")[1] in locks:
+                    yield sub
+                    break
+
+
+def run(modules, index) -> CheckerResult:
+    findings: List[Finding] = []
+    n_guarded = 0
+    for module in modules:
+        modname = module_name(module.path)
+        class_info: Dict[str, tuple] = {}
+        for fn, qual, classname in iter_functions(module.tree):
+            if classname is None:
+                continue
+            if classname not in class_info:
+                node = index.class_node(modname, classname)
+                if node is None:
+                    continue
+                class_info[classname] = _class_locks_and_counters(node)
+            locks, counters = class_info[classname]
+            if not locks or not counters:
+                continue
+            params = {p for p in function_param_names(fn)
+                      if _COUNTER_RE.search(p)}
+            if not params:
+                # No caller-supplied counter: this method is either the
+                # generation PRODUCER (resize/regroup bump the counter
+                # themselves) or counter-oblivious; neither is the
+                # stale-consumer race this checker encodes.
+                continue
+            for block in _lock_blocks(fn, locks):
+                installs = list(_installs_in_block(block, counters,
+                                                   locks))
+                if not installs:
+                    continue
+                n_guarded += 1
+                if _block_compares_counter(block, counters, params,
+                                           module, classname, index):
+                    continue
+                stmt, attr = installs[0]
+                findings.append(Finding(
+                    checker=CHECKER_ID, path=module.path,
+                    line=stmt.lineno, col=stmt.col_offset,
+                    symbol=f"{classname}.{fn.name}",
+                    message=f"self.{attr} installed under the lock "
+                            f"without re-comparing "
+                            f"{'/'.join(sorted(counters))} — a stale "
+                            f"computation can overwrite newer state "
+                            f"(the PR 4 swap_params / PR 19 stale-"
+                            f"cache-insert shape)",
+                    hint="snapshot the counter under the lock, compute "
+                         "outside, re-compare under the lock "
+                         "immediately before the install"))
+    return CheckerResult(findings=findings,
+                         report={"guarded_installs": n_guarded})

@@ -1,0 +1,201 @@
+"""handler-discipline: every HTTP handler branch answers exactly once.
+
+The incident this encodes (docs/DESIGN.md §8): the PR 10 ``/resize``
+handler had an early-return branch that never wrote a status line — the
+client saw a dropped connection, which loadgen counted as a transport
+error and the chaos twin diagnosed as a resize dropping in-flight
+requests. The dual failure (two ``send_response`` calls on one path)
+corrupts keep-alive framing just as silently.
+
+For every ``do_*`` method of a class that defines HTTP verb handlers:
+
+1. Every execution path must reach exactly one reply — a call that hits
+   ``send_response``/``send_error`` directly OR through any helper the
+   cross-module index can resolve (``self._reply``, ``self._do_resize``,
+   a shared module-level ``reply(handler, ...)``). Paths that terminate
+   by ``raise`` are exempt: an exception is the server loop's problem,
+   not a silent drop.
+2. Body reads must be length-bounded: ``self.rfile.read()`` with no size
+   argument blocks forever on a keep-alive socket (the client is waiting
+   for the reply while the server waits for EOF).
+
+Loops are approximated as executing zero-or-one times and reply counts
+saturate at 2 ("more than once") — handlers are short glue code, and the
+approximation keeps the path walk linear.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.analyzer._ast_util import (
+    call_name,
+    dotted_name,
+    last_segment,
+)
+from tools.analyzer.core import CheckerResult, Finding
+
+CHECKER_ID = "handler-discipline"
+NEEDS_INDEX = True
+
+_REPLY_TARGETS = frozenset({"send_response", "send_error"})
+_VERBS = ("do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD",
+          "do_PATCH")
+
+#: (reply_count, kind, node) — kind is how the path ended.
+_Terminal = Tuple[int, str, ast.AST]
+
+
+def _cap(n: int) -> int:
+    return 2 if n >= 2 else n
+
+
+class _PathWalker:
+    """Abstract interpretation of one handler body: propagate the set of
+    possible reply counts along every path; collect terminals."""
+
+    def __init__(self, module, classname: Optional[str], index) -> None:
+        self.module = module
+        self.classname = classname
+        self.index = index
+        self.terminals: List[_Terminal] = []
+
+    def hits(self, node: ast.AST) -> int:
+        if node is None:
+            return 0
+        return self.index.call_hits(node, self.module, self.classname,
+                                    _REPLY_TARGETS)
+
+    def flow(self, stmts, counts: Set[int]) -> Set[int]:
+        """Returns the set of reply counts that fall through ``stmts``."""
+        for stmt in stmts:
+            if not counts:
+                return counts
+            if isinstance(stmt, ast.Return):
+                n = self.hits(stmt.value)
+                for c in counts:
+                    self.terminals.append((_cap(c + n), "return", stmt))
+                return set()
+            if isinstance(stmt, ast.Raise):
+                for c in counts:
+                    self.terminals.append((_cap(c), "raise", stmt))
+                return set()
+            if isinstance(stmt, ast.If):
+                pre = self.hits(stmt.test)
+                entry = {_cap(c + pre) for c in counts}
+                counts = self.flow(stmt.body, set(entry)) | \
+                    self.flow(stmt.orelse, set(entry))
+            elif isinstance(stmt, (ast.While, ast.For)):
+                if isinstance(stmt, ast.While):
+                    pre = self.hits(stmt.test)
+                else:
+                    pre = self.hits(stmt.iter)
+                entry = {_cap(c + pre) for c in counts}
+                once = self.flow(list(stmt.body), set(entry))
+                after = entry | once
+                counts = self.flow(stmt.orelse, after) if stmt.orelse \
+                    else after
+            elif isinstance(stmt, ast.Try):
+                body_out = self.flow(stmt.body, set(counts))
+                if stmt.orelse:
+                    body_out = self.flow(stmt.orelse, body_out)
+                handler_out: Set[int] = set()
+                for h in stmt.handlers:
+                    # the exception may fire before any reply in the try
+                    # body landed — handlers enter at the pre-try counts
+                    handler_out |= self.flow(h.body, set(counts))
+                merged = body_out | handler_out
+                if stmt.finalbody:
+                    counts = self.flow(stmt.finalbody, merged)
+                else:
+                    counts = merged
+            elif isinstance(stmt, ast.With):
+                pre = sum(self.hits(item.context_expr)
+                          for item in stmt.items)
+                counts = self.flow(stmt.body,
+                                   {_cap(c + pre) for c in counts})
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue
+            else:
+                n = self.hits(stmt)
+                counts = {_cap(c + n) for c in counts}
+        return counts
+
+
+def _unbounded_body_reads(class_node: ast.ClassDef):
+    for sub in ast.walk(class_node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "read" and not sub.args:
+            base = dotted_name(sub.func.value)
+            if base and last_segment(base) == "rfile":
+                yield sub
+
+
+def _handler_classes(module):
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            verbs = [m for m in node.body
+                     if isinstance(m, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                     and m.name in _VERBS]
+            if verbs:
+                yield node, verbs
+
+
+def run(modules, index) -> CheckerResult:
+    findings: List[Finding] = []
+    n_handlers = 0
+    for module in modules:
+        for class_node, verbs in _handler_classes(module):
+            for method in verbs:
+                n_handlers += 1
+                symbol = f"{class_node.name}.{method.name}"
+                walker = _PathWalker(module, class_node.name, index)
+                fallthrough = walker.flow(method.body, {0})
+                terminals = list(walker.terminals)
+                for c in fallthrough:
+                    terminals.append((c, "return", method))
+                reported_drop = reported_double = False
+                for count, kind, node in terminals:
+                    line = getattr(node, "lineno", method.lineno)
+                    col = getattr(node, "col_offset", 0)
+                    if kind == "raise":
+                        continue
+                    if count == 0 and not reported_drop:
+                        reported_drop = True
+                        findings.append(Finding(
+                            checker=CHECKER_ID, path=module.path,
+                            line=line, col=col, symbol=symbol,
+                            message=f"{symbol} has a path that returns "
+                                    f"without sending any response — "
+                                    f"the client sees a dropped "
+                                    f"connection (the PR 10 /resize "
+                                    f"shape)",
+                            hint="every branch must reach "
+                                 "send_response/send_error exactly "
+                                 "once (helpers that call them count)"))
+                    elif count >= 2 and not reported_double:
+                        reported_double = True
+                        findings.append(Finding(
+                            checker=CHECKER_ID, path=module.path,
+                            line=line, col=col, symbol=symbol,
+                            message=f"{symbol} has a path that sends "
+                                    f"more than one response — "
+                                    f"keep-alive framing corrupts "
+                                    f"silently",
+                            hint="return after the first reply on "
+                                 "each branch"))
+            for read in _unbounded_body_reads(class_node):
+                findings.append(Finding(
+                    checker=CHECKER_ID, path=module.path,
+                    line=read.lineno, col=read.col_offset,
+                    symbol=class_node.name,
+                    message="rfile.read() with no length bound blocks "
+                            "forever on a keep-alive socket",
+                    hint="read exactly int(self.headers['Content-"
+                         "Length']) bytes"))
+    return CheckerResult(findings=findings,
+                         report={"handlers": n_handlers})

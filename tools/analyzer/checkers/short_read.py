@@ -1,0 +1,187 @@
+"""short-read: verify Content-Length before trusting an HTTP body.
+
+The incident this encodes (docs/DESIGN.md §8): PR 19's delta-fetch path
+(``distrib/fetch.py``) read chunk bodies piecewise with ``read(n)`` —
+which reports a torn connection as a plain short body, NOT as
+``http.client.IncompleteRead`` (only the unsized ``read()`` raises that)
+— and handed truncated bytes to the chunk-hash verifier. The fix
+compares received length against the ``Content-Length`` header and
+treats a mismatch as a transport error (retryable) instead of corrupt
+data (fatal). The same hole existed in the router's backend proxy
+(``serve/router.py http_exchange``) and the dataset fetch
+(``data/download.py``).
+
+Mechanically: inside one function, a *receiver* is a name bound from
+``urlopen(...)`` or ``conn.getresponse(...)`` (assignment or
+``with ... as r``). A ``receiver.read(...)`` call fires unless:
+
+- the function *validates length*: some name tainted by the string
+  ``"Content-Length"`` (header lookup, propagated through assignments)
+  participates in a comparison — the received-vs-expected check, or
+- the read's result is fed straight to ``json.loads(...)`` — a torn
+  JSON body fails the parse, so the decode IS the integrity check, or
+- the result is discarded (a bare expression statement): draining a
+  keep-alive socket does not *use* the bytes.
+
+Receivers passed in from a caller are that caller's responsibility —
+the checker is owner-scoped, like thread-lifecycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.analyzer._ast_util import (
+    call_name,
+    dotted_name,
+    iter_functions,
+    last_segment,
+    walk_body_in_scope,
+)
+from tools.analyzer.core import CheckerResult, Finding
+
+CHECKER_ID = "short-read"
+
+_RECEIVER_CALLS = {"urlopen", "getresponse"}
+_HEADER_NEEDLE = "content-length"
+
+
+def _mentions_content_length(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and sub.value.lower() == _HEADER_NEEDLE:
+            return True
+    return False
+
+
+def _assigned_names(target: ast.expr) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out.extend(_assigned_names(e))
+        return out
+    return []
+
+
+def _collect_receivers(fn: ast.AST) -> Set[str]:
+    receivers: Set[str] = set()
+    for sub in walk_body_in_scope(fn.body):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                isinstance(sub.value, ast.Call) and \
+                last_segment(call_name(sub.value)) in _RECEIVER_CALLS:
+            receivers.add(sub.targets[0].id)
+        elif isinstance(sub, ast.withitem) and \
+                isinstance(sub.context_expr, ast.Call) and \
+                last_segment(call_name(sub.context_expr)) in \
+                _RECEIVER_CALLS and \
+                isinstance(sub.optional_vars, ast.Name):
+            receivers.add(sub.optional_vars.id)
+    return receivers
+
+
+def _validates_length(fn: ast.AST) -> bool:
+    """Taint names from Content-Length lookups through assignments; a
+    comparison touching any tainted name is the received-length check."""
+    tainted: Set[str] = set()
+    changed = True
+    rounds = 0
+    while changed and rounds < 8:
+        changed = False
+        rounds += 1
+        for sub in walk_body_in_scope(fn.body):
+            if not isinstance(sub, ast.Assign):
+                continue
+            rhs_tainted = _mentions_content_length(sub.value) or any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for n in ast.walk(sub.value))
+            if not rhs_tainted:
+                continue
+            for t in sub.targets:
+                for name in _assigned_names(t):
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+    if not tainted:
+        return False
+    for sub in walk_body_in_scope(fn.body):
+        if isinstance(sub, ast.Compare):
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+    return False
+
+
+def _parent_map(fn: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _is_json_decoded(read_call: ast.Call,
+                     parents: Dict[int, ast.AST]) -> bool:
+    cur: ast.AST = read_call
+    while True:
+        parent = parents.get(id(cur))
+        if parent is None or isinstance(parent, ast.stmt):
+            return False
+        if isinstance(parent, ast.Call) and (
+                cur in parent.args or
+                any(kw.value is cur for kw in parent.keywords)):
+            if last_segment(call_name(parent)) in ("loads", "load"):
+                return True
+            return False  # handed to some other consumer: its bytes now
+        cur = parent
+
+
+def _is_discarded(read_call: ast.Call,
+                  parents: Dict[int, ast.AST]) -> bool:
+    parent = parents.get(id(read_call))
+    return isinstance(parent, ast.Expr) and parent.value is read_call
+
+
+def _fn_findings(fn: ast.AST, module, symbol: str) -> List[Finding]:
+    receivers = _collect_receivers(fn)
+    if not receivers:
+        return []
+    if _validates_length(fn):
+        return []
+    parents = _parent_map(fn)
+    findings: List[Finding] = []
+    for sub in walk_body_in_scope(fn.body):
+        if not (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "read"):
+            continue
+        base = dotted_name(sub.func.value)
+        if base not in receivers:
+            continue
+        if _is_json_decoded(sub, parents) or _is_discarded(sub, parents):
+            continue
+        findings.append(Finding(
+            checker=CHECKER_ID, path=module.path, line=sub.lineno,
+            col=sub.col_offset, symbol=symbol,
+            message="HTTP body read without comparing received length "
+                    "to Content-Length — a torn connection hands "
+                    "truncated bytes downstream (the PR 19 "
+                    "distrib/fetch.py torn-chunk shape)",
+            hint="read the Content-Length header and verify the "
+                 "received byte count against it (a mismatch is a "
+                 "retryable transport error, not data)"))
+    return findings
+
+
+def run(modules) -> CheckerResult:
+    findings: List[Finding] = []
+    n_receivers = 0
+    for module in modules:
+        for fn, qual, _classname in iter_functions(module.tree):
+            n_receivers += len(_collect_receivers(fn))
+            findings.extend(_fn_findings(fn, module, qual))
+    return CheckerResult(findings=findings,
+                         report={"http_receivers": n_receivers})

@@ -1,0 +1,457 @@
+"""thread-lifecycle: every spawned Thread/Timer/Popen must be reapable.
+
+The incident record this encodes (docs/DESIGN.md §8):
+
+- PR 6: the input-feeder thread (``data/staging.py``) originally outlived
+  its epoch — ``close()`` had to grow an explicit ``join()`` so a feeder
+  blocked on a full queue could not keep reading a dataset the trainer
+  had already abandoned.
+- PR 10: chaos twins spawned loadgen subprocesses and reaped them only
+  on the success path; a ``communicate(timeout=...)`` expiry propagated
+  past the reap and left an orphan loadgen hammering a server the twin
+  was about to kill.
+
+Rules (each with its exemption surface):
+
+1. ``self.X = Thread(...)``: some method of the owning class must call a
+   lifecycle method (``join``/``cancel``) through ``self.X``. The
+   attribute handle is the owner's promise of deterministic teardown, so
+   ``daemon=True`` does NOT exempt it — a daemon feeder still holds the
+   dataset hostage until the interpreter dies (the PR 6 lesson).
+2. A thread bound to a local: the owner must join it, or visibly hand it
+   off (return/yield it, store it on ``self``, pass it to a call, put it
+   in a container), or it must be ``daemon=True`` with a sentinel-shaped
+   target (the target loops on ``Event.wait``/``is_set`` — a service
+   loop with an explicit stop signal).
+3. ``Thread(...).start()`` with no binding at all: ``daemon=True`` only.
+4. ``Popen``: the same binding shapes, but the reap (``wait`` /
+   ``communicate`` / ``kill`` / ``terminate``) must be *protected* —
+   inside a ``finally`` or ``except`` block — because the PR 10 orphan
+   was precisely an inline ``communicate(timeout=)`` whose expiry raised
+   past it. ``with Popen(...)`` is exempt (the context manager waits);
+   a container of Popens needs a protected reap loop over it.
+5. A daemon ``Timer`` is exempt everywhere: it self-terminates after its
+   interval by construction (the watchdog hard-exit shape).
+
+Everything here is syntactic and owner-scoped: a handle that escapes the
+creating scope is the *recipient's* problem (checked where it lands, if
+it lands in an attribute), never silently this checker's.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyzer._ast_util import (
+    call_name,
+    dotted_name,
+    iter_functions,
+    last_segment,
+    module_name,
+    walk_body_in_scope,
+)
+from tools.analyzer.core import CheckerResult, Finding
+
+CHECKER_ID = "thread-lifecycle"
+NEEDS_INDEX = True
+
+_THREAD_CTORS = {"Thread", "Timer"}
+_POPEN_CTORS = {"Popen"}
+_THREAD_LIFECYCLE = {"join", "cancel"}
+_POPEN_LIFECYCLE = {"wait", "communicate", "kill", "terminate"}
+_SENTINEL_CALLS = {"wait", "is_set"}
+
+
+def _is_creation(node: ast.AST) -> Optional[str]:
+    """'thread' / 'popen' when ``node`` constructs one, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    seg = last_segment(call_name(node))
+    if seg in _THREAD_CTORS:
+        return "thread"
+    if seg in _POPEN_CTORS:
+        return "popen"
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_daemon(call: ast.Call, fn: ast.AST,
+               bound_name: Optional[str]) -> bool:
+    v = _kw(call, "daemon")
+    if isinstance(v, ast.Constant) and v.value is True:
+        return True
+    if bound_name is None:
+        return False
+    # `t.daemon = True` after construction (the Timer idiom).
+    for sub in walk_body_in_scope(fn.body):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                dotted_name(sub.targets[0]) == f"{bound_name}.daemon" and \
+                isinstance(sub.value, ast.Constant) and \
+                sub.value.value is True:
+            return True
+    return False
+
+
+def _target_expr(call: ast.Call) -> Optional[ast.expr]:
+    v = _kw(call, "target")
+    if v is not None:
+        return v
+    if call.args:
+        return call.args[0]
+    return None
+
+
+def _has_sentinel_loop(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.While):
+            for t in ast.walk(sub.test):
+                if isinstance(t, ast.Call) and \
+                        last_segment(call_name(t)) in _SENTINEL_CALLS:
+                    return True
+    return False
+
+
+def _sentinel_target(call: ast.Call, fn: ast.AST, module, classname,
+                     index) -> bool:
+    """True when the Thread's target resolves to a function whose main
+    loop polls a stop signal (``while not stop.wait(...)`` & friends)."""
+    target = _target_expr(call)
+    if target is None:
+        return False
+    if isinstance(target, ast.Lambda):
+        return False
+    name = dotted_name(target)
+    if not name:
+        return False
+    # Local def in the spawning function first, then the project index.
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                sub.name == last_segment(name):
+            return _has_sentinel_loop(sub)
+    fqs = index.resolve(name, module_name(module.path), classname,
+                        module.path)
+    if not fqs and "." in name:
+        fqs = index.by_name.get(last_segment(name), [])[:4]
+    for fq in fqs:
+        info = index.functions.get(fq)
+        if info is not None and _has_sentinel_loop(info.node):
+            return True
+    return False
+
+
+def _parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _protected_nodes(fn_body: List[ast.stmt]) -> Set[int]:
+    """ids of every in-scope node inside an ``except`` handler or a
+    ``finally`` block — where a Popen reap counts as exception-safe."""
+    out: Set[int] = set()
+    for sub in walk_body_in_scope(fn_body):
+        if isinstance(sub, ast.Try):
+            for h in sub.handlers:
+                for n in walk_body_in_scope(h.body):
+                    out.add(id(n))
+            for n in walk_body_in_scope(sub.finalbody):
+                out.add(id(n))
+    return out
+
+
+def _name_reads(fn_body: List[ast.stmt], name: str):
+    for sub in walk_body_in_scope(fn_body):
+        if isinstance(sub, ast.Name) and sub.id == name and \
+                isinstance(sub.ctx, ast.Load):
+            yield sub
+
+
+def _escapes(fn_body: List[ast.stmt], name: str, creation: ast.Call,
+             parents: Dict[int, ast.AST]) -> bool:
+    """The handle leaves this scope: returned/yielded, aliased into
+    another binding, passed to a call. The recipient owns the lifecycle
+    then. Only the handle ITSELF escaping counts — a path that climbs
+    through anything but container/packing literals is a *use* of the
+    handle (``out, _ = p.communicate()`` reads p's method, it does not
+    hand p off), never an escape — and neither is a builtin that only
+    inspects (``len(procs)``, ``enumerate(procs)``)."""
+    _PACKING = (ast.Tuple, ast.List, ast.Set, ast.Dict, ast.Starred)
+    _INSPECTORS = {"len", "enumerate", "sorted", "reversed", "zip",
+                   "any", "all", "sum", "min", "max", "iter", "next",
+                   "repr", "str", "print", "id", "bool"}
+    for read in _name_reads(fn_body, name):
+        cur: Optional[ast.AST] = read
+        packed = True  # path so far is the bare handle or literal packs
+        while cur is not None:
+            parent = parents.get(id(cur))
+            if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if packed:
+                    return True
+                break
+            if isinstance(parent, ast.Assign):
+                if packed and cur is parent.value:
+                    return True  # aliased / packed into another binding
+                break
+            if isinstance(parent, ast.Call) and parent is not creation:
+                if packed and (cur in parent.args
+                               or cur in parent.keywords):
+                    if not (isinstance(parent.func, ast.Name) and
+                            parent.func.id in _INSPECTORS):
+                        return True
+            if isinstance(parent, ast.stmt):
+                break
+            if not isinstance(parent, _PACKING + (ast.keyword,)):
+                packed = False
+            cur = parent
+    return False
+
+
+def _lifecycle_calls(root_body: List[ast.stmt], name: str,
+                     lifecycle: Set[str]):
+    """Calls like ``name.join()`` / ``name[0].kill()`` in ``root_body``."""
+    for sub in walk_body_in_scope(root_body):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in lifecycle:
+            base = sub.func.value
+            for n in ast.walk(base):
+                if isinstance(n, ast.Name) and n.id == name:
+                    yield sub
+                    break
+
+
+def _attr_lifecycle_calls(class_node: ast.ClassDef, attr: str,
+                          lifecycle: Set[str]):
+    """Calls reaching a lifecycle method through ``self.<attr>`` anywhere
+    in the class (``self.X.join()``, ``self.X.pop(n).join()``, ...)."""
+    for sub in ast.walk(class_node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in lifecycle:
+            for n in ast.walk(sub.func.value):
+                if isinstance(n, ast.Attribute) and n.attr == attr and \
+                        isinstance(n.value, ast.Name) and \
+                        n.value.id == "self":
+                    yield sub
+                    break
+
+
+def _container_reaped(fn_body: List[ast.stmt], container: str,
+                      lifecycle: Set[str], protected: Set[int],
+                      require_protected: bool) -> bool:
+    """A loop/comprehension over ``container`` whose target gets a
+    lifecycle call — the ``for t in threads: t.join()`` shape."""
+    for sub in walk_body_in_scope(fn_body):
+        if isinstance(sub, ast.For) and isinstance(sub.target, ast.Name):
+            names = {n.id for n in ast.walk(sub.iter)
+                     if isinstance(n, ast.Name)}
+            if container not in names:
+                continue
+            for call in _lifecycle_calls(sub.body, sub.target.id,
+                                         lifecycle):
+                if not require_protected or id(call) in protected or \
+                        id(sub) in protected:
+                    return True
+    return False
+
+
+def _classify(creation: ast.Call, parents: Dict[int, ast.AST]
+              ) -> Tuple[str, Optional[str]]:
+    """(shape, binding) for one construction site. Shapes:
+    'with' | 'local' | 'attr' | 'container' | 'anon' | 'escape' |
+    'orphan'."""
+    node: ast.AST = creation
+    while True:
+        parent = parents.get(id(node))
+        if parent is None:
+            return "escape", None
+        if isinstance(parent, ast.withitem):
+            return "with", None
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            outer = parents.get(id(parent))
+            if isinstance(outer, ast.Call) and outer.func is parent:
+                if parent.attr == "start":
+                    return "anon", None
+                return "escape", None  # Popen(...).pid and such
+            return "escape", None
+        if isinstance(parent, ast.Assign):
+            if len(parent.targets) != 1:
+                return "escape", None
+            t = parent.targets[0]
+            if isinstance(t, ast.Name):
+                if isinstance(parent.value, (ast.Tuple, ast.List,
+                                             ast.ListComp, ast.SetComp,
+                                             ast.GeneratorExp)):
+                    return "container", t.id
+                return "local", t.id
+            d = dotted_name(t)
+            if d and head_segment_is_self(d):
+                return "attr", d.split(".")[1]
+            if isinstance(t, ast.Subscript):
+                base = dotted_name(t.value)
+                if base and head_segment_is_self(base):
+                    return "attr", base.split(".")[1]
+                if base:
+                    return "container", base.split(".")[0]
+            return "escape", None
+        if isinstance(parent, ast.keyword):
+            outer = parents.get(id(parent))
+            if isinstance(outer, ast.Call):
+                return "escape", None  # f(proc=Popen(...)): handed off
+        if isinstance(parent, ast.Call) and (
+                node in parent.args or
+                any(kw.value is node for kw in parent.keywords)):
+            fname = parent.func
+            if isinstance(fname, ast.Attribute) and \
+                    fname.attr in ("append", "add", "insert") and \
+                    isinstance(fname.value, ast.Name):
+                return "container", fname.value.id
+            return "escape", None
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return "escape", None
+        if isinstance(parent, ast.Expr):
+            return "orphan", None
+        if isinstance(parent, ast.stmt):
+            return "escape", None
+        node = parent
+
+
+def head_segment_is_self(dotted: str) -> bool:
+    return dotted.split(".", 1)[0] == "self" and dotted.count(".") >= 1
+
+
+def _fn_findings(fn_body: List[ast.stmt], fn: ast.AST, module,
+                 classname: Optional[str], symbol: str, index,
+                 class_node: Optional[ast.ClassDef]) -> List[Finding]:
+    parents = _parent_map(fn)
+    protected = _protected_nodes(fn_body)
+    findings: List[Finding] = []
+    for sub in walk_body_in_scope(fn_body):
+        kind = _is_creation(sub)
+        if kind is None:
+            continue
+        shape, binding = _classify(sub, parents)
+        ctor = last_segment(call_name(sub))
+        daemon = _is_daemon(sub, fn, binding)
+        if shape in ("with", "escape"):
+            continue
+        if kind == "thread" and ctor == "Timer" and daemon:
+            continue  # a daemon Timer self-terminates by construction
+        lifecycle = _THREAD_LIFECYCLE if kind == "thread" \
+            else _POPEN_LIFECYCLE
+        if shape == "anon":
+            if kind == "thread" and daemon:
+                continue
+            findings.append(Finding(
+                checker=CHECKER_ID, path=module.path, line=sub.lineno,
+                col=sub.col_offset, symbol=symbol,
+                message=f"anonymous {ctor}(...).start() can never be "
+                        f"joined or reaped",
+                hint="bind the handle and join/reap it, or make it a "
+                     "daemon with a sentinel-stop loop"))
+            continue
+        if shape == "orphan":
+            findings.append(Finding(
+                checker=CHECKER_ID, path=module.path, line=sub.lineno,
+                col=sub.col_offset, symbol=symbol,
+                message=f"{ctor}(...) constructed and discarded — the "
+                        f"child outlives every handle to it",
+                hint="keep the handle and reap it (join/wait), or use "
+                     "`with Popen(...)`"))
+            continue
+        if shape == "attr":
+            if class_node is not None and any(True for _ in
+                    _attr_lifecycle_calls(class_node, binding, lifecycle)):
+                continue
+            findings.append(Finding(
+                checker=CHECKER_ID, path=module.path, line=sub.lineno,
+                col=sub.col_offset, symbol=symbol,
+                message=f"self.{binding} holds a {ctor} but no method of "
+                        f"the class ever join/reaps it (the PR 6 feeder-"
+                        f"leak shape)",
+                hint=f"call self.{binding}.join()/wait() from close()/"
+                     f"stop(); daemon=True does not excuse an attribute "
+                     f"handle"))
+            continue
+        # local or container binding
+        satisfied = False
+        if shape == "local":
+            for call in _lifecycle_calls(fn_body, binding, lifecycle):
+                if kind == "thread" or id(call) in protected:
+                    satisfied = True
+                    break
+            if not satisfied and _escapes(fn_body, binding, sub, parents):
+                continue
+        else:  # container
+            if _container_reaped(fn_body, binding, lifecycle, protected,
+                                 require_protected=(kind == "popen")):
+                satisfied = True
+            elif _escapes(fn_body, binding, sub, parents):
+                continue
+        if satisfied:
+            continue
+        if kind == "thread" and daemon and \
+                _sentinel_target(sub, fn, module, classname, index):
+            continue
+        if kind == "popen":
+            has_any = any(True for _ in _lifecycle_calls(
+                fn_body, binding or "", _POPEN_LIFECYCLE)) or (
+                shape == "container" and _container_reaped(
+                    fn_body, binding or "", _POPEN_LIFECYCLE, protected,
+                    require_protected=False))
+            if has_any:
+                msg = (f"Popen bound to {binding!r} is reaped only on "
+                       f"the happy path — an exception (communicate "
+                       f"timeout, failed probe) orphans the child (the "
+                       f"PR 10 orphaned-loadgen shape)")
+                hint = "move the kill()/wait() into a finally/except " \
+                       "block so every exit path reaps it"
+            else:
+                msg = f"Popen bound to {binding!r} is never reaped"
+                hint = "wait()/kill() it in a finally block, or use " \
+                       "`with Popen(...)`"
+        else:
+            msg = (f"{ctor} bound to {binding!r} is never joined and "
+                   f"has no daemon sentinel loop")
+            hint = "join it before the owner returns, or make it " \
+                   "daemon=True with a target that polls a stop Event"
+        findings.append(Finding(
+            checker=CHECKER_ID, path=module.path, line=sub.lineno,
+            col=sub.col_offset, symbol=symbol, message=msg, hint=hint))
+    return findings
+
+
+def run(modules, index) -> CheckerResult:
+    findings: List[Finding] = []
+    n_sites = 0
+    for module in modules:
+        modname = module_name(module.path)
+        for fn, qual, classname in iter_functions(module.tree):
+            class_node = index.class_node(modname, classname) \
+                if classname else None
+            findings.extend(_fn_findings(
+                fn.body, fn, module, classname, qual, index, class_node))
+            n_sites += sum(1 for s in walk_body_in_scope(fn.body)
+                           if _is_creation(s))
+        # Module top level (scripts): the module body is one owner scope.
+        # Top-level def/class STATEMENTS are excluded — walk_body_in_scope
+        # only prunes scope nodes one level down, and those scopes were
+        # already handled above.
+        top = [s for s in module.tree.body
+               if not isinstance(s, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef))]
+        findings.extend(_fn_findings(
+            top, module.tree, module, None, "<module>", index, None))
+        n_sites += sum(1 for s in walk_body_in_scope(top)
+                       if _is_creation(s))
+    return CheckerResult(findings=findings,
+                         report={"spawn_sites": n_sites})

@@ -21,11 +21,16 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 SCHEMA_VERSION = 1
+
+#: Version salt for the on-disk findings cache — bump whenever a checker's
+#: semantics change in a way file hashes cannot see.
+CACHE_VERSION = 1
 
 #: Checker ids whose findings a baseline entry may suppress. Parse errors
 #: are never baselinable: an unparseable file means the analyzer saw
@@ -83,6 +88,7 @@ class AnalysisResult:
     n_files: int = 0
     checkers: Tuple[str, ...] = ()
     paths: Tuple[str, ...] = ()
+    cache_info: Optional[Dict] = None  # {"hit": bool, "files": N}
 
     @property
     def ok(self) -> bool:
@@ -102,6 +108,7 @@ class AnalysisResult:
             "stale_baseline": list(self.stale_baseline),
             "baseline_problems": list(self.baseline_problems),
             "reports": self.reports,
+            "cache": self.cache_info,
             "summary": {
                 "files": self.n_files,
                 "findings": len(self.findings),
@@ -334,15 +341,104 @@ def checker_registry() -> Dict[str, object]:
     return checkers.REGISTRY
 
 
+def default_cache_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".cache.json")
+
+
+def _file_hashes(files: Sequence[str]) -> Dict[str, str]:
+    """Repo-relative path -> sha256 of file bytes (unreadable files hash
+    to "" so the cache can never mask a parse-error finding)."""
+    hashes: Dict[str, str] = {}
+    root_cache: Dict[str, Optional[str]] = {}
+    for path in files:
+        norm = _normalize(path, root_cache)
+        try:
+            with open(path, "rb") as f:
+                hashes[norm] = hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            hashes[norm] = ""
+    return hashes
+
+
+def _load_cache(path: str, ids: Sequence[str], hashes: Dict[str, str],
+                paths: Sequence[str]) -> Optional[Dict]:
+    """The cached payload when it is valid for exactly this run: same
+    cache schema, same checker list, same input paths, same file set
+    with byte-identical contents. Anything else is a miss."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if payload.get("cache_version") != CACHE_VERSION:
+        return None
+    if payload.get("schema_version") != SCHEMA_VERSION:
+        return None
+    if payload.get("checkers") != list(ids):
+        return None
+    if payload.get("paths") != [str(p) for p in paths]:
+        return None
+    if payload.get("files") != hashes:
+        return None
+    return payload
+
+
+def _store_cache(path: str, ids: Sequence[str], hashes: Dict[str, str],
+                 paths: Sequence[str], findings: Sequence[Finding],
+                 reports: Dict[str, Dict],
+                 module_paths: Sequence[str]) -> None:
+    payload = {
+        "cache_version": CACHE_VERSION,
+        "schema_version": SCHEMA_VERSION,
+        "checkers": list(ids),
+        "paths": [str(p) for p in paths],
+        "files": hashes,
+        "findings": [f.to_dict() for f in findings],
+        "reports": reports,
+        "module_paths": list(module_paths),
+    }
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # best effort: a cold run next time, never a failure now
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def run_analysis(
     paths: Sequence[str],
     checkers: Optional[Sequence[str]] = None,
     baseline: Optional[str] = "default",
+    cache: Optional[str] = None,
+    changed: Optional[Sequence[str]] = None,
 ) -> AnalysisResult:
     """Analyze ``paths`` with the selected checkers.
 
     ``baseline``: a file path, ``"default"`` (the checked-in
     ``tools/analyzer/baseline.json``), or ``None`` (no suppression).
+
+    ``cache``: a file path for the per-file content-hash findings cache.
+    A warm run on an unchanged tree (same files, same bytes, same
+    checkers) skips parsing and checking entirely and replays the stored
+    findings byte-for-byte; the baseline is always re-applied fresh so
+    editing it never needs a cache flush.
+
+    ``changed``: restrict *checking* to these files plus every module
+    that transitively imports one of them (reverse dependencies from the
+    cross-module index). The whole tree is still parsed and indexed —
+    cross-module checkers must see the full call graph — but findings
+    are only produced for the restricted set, and baseline staleness is
+    only judged there (the existing path-subset contract).
     """
     registry = checker_registry()
     ids = list(checkers) if checkers is not None else list(registry)
@@ -352,15 +448,55 @@ def run_analysis(
             f"unknown checker(s) {unknown}; available: {list(registry)}")
 
     files, problems = collect_files(paths)
-    modules, parse_problems = parse_modules(files)
-    findings: List[Finding] = list(problems) + list(parse_problems)
-    reports: Dict[str, Dict] = {}
-    for cid in ids:
-        result: CheckerResult = registry[cid].run(modules)
-        findings.extend(result.findings)
-        if result.report is not None:
-            reports[cid] = result.report
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+    cache_info: Optional[Dict] = None
+    hashes: Optional[Dict[str, str]] = None
+    payload: Optional[Dict] = None
+    if cache is not None and changed is None:
+        hashes = _file_hashes(files)
+        payload = _load_cache(cache, ids, hashes, paths)
+        cache_info = {"hit": payload is not None, "files": len(files)}
+
+    if payload is not None:
+        findings = list(problems) + \
+            [Finding(**d) for d in payload["findings"]]
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+        reports = dict(payload["reports"])
+        module_paths = list(payload["module_paths"])
+    else:
+        modules, parse_problems = parse_modules(files)
+        findings = list(problems) + list(parse_problems)
+        needs_index = changed is not None or any(
+            getattr(registry[cid], "NEEDS_INDEX", False) for cid in ids)
+        index = None
+        if needs_index:
+            from tools.analyzer._ast_util import ProjectIndex
+
+            index = ProjectIndex(modules)
+        target_modules = modules
+        if changed is not None:
+            root_cache: Dict[str, Optional[str]] = {}
+            norm_changed = {_normalize(p, root_cache) for p in changed}
+            restrict = index.reverse_dependencies(
+                {m.path for m in modules if m.path in norm_changed})
+            target_modules = [m for m in modules if m.path in restrict]
+        reports = {}
+        for cid in ids:
+            mod = registry[cid]
+            if getattr(mod, "NEEDS_INDEX", False):
+                result: CheckerResult = mod.run(target_modules, index)
+            else:
+                result = mod.run(target_modules)
+            findings.extend(result.findings)
+            if result.report is not None:
+                reports[cid] = result.report
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+        module_paths = [m.path for m in target_modules]
+        if cache is not None and changed is None:
+            # usage findings (bad input paths) are re-derived fresh each
+            # run; everything content-derived is cached.
+            _store_cache(cache, ids, hashes, paths,
+                         [f for f in findings if f.checker != "usage"],
+                         reports, module_paths)
 
     if baseline == "default":
         bl_path: Optional[str] = default_baseline_path()
@@ -379,13 +515,14 @@ def run_analysis(
         return bool(root) and os.path.isfile(os.path.join(root, path))
 
     kept, suppressed, stale = apply_baseline(
-        findings, entries, analyzed_paths=[m.path for m in modules],
+        findings, entries, analyzed_paths=module_paths,
         file_exists=_entry_file_exists)
 
     return AnalysisResult(
         findings=kept, suppressed=suppressed, stale_baseline=stale,
         baseline_problems=bl_problems, reports=reports,
-        n_files=len(modules), checkers=tuple(ids), paths=tuple(paths),
+        n_files=len(module_paths), checkers=tuple(ids),
+        paths=tuple(paths), cache_info=cache_info,
     )
 
 
@@ -404,9 +541,18 @@ def analyze_snippet(
             f"unknown checker(s) {unknown}; available: {list(registry)}")
     tree = ast.parse(source, filename=filename)
     module = Module(path=filename, tree=tree, source=source)
+    index = None
+    if any(getattr(registry[cid], "NEEDS_INDEX", False) for cid in ids):
+        from tools.analyzer._ast_util import ProjectIndex
+
+        index = ProjectIndex([module])
     findings: List[Finding] = []
     for cid in ids:
-        findings.extend(registry[cid].run([module]).findings)
+        mod = registry[cid]
+        if getattr(mod, "NEEDS_INDEX", False):
+            findings.extend(mod.run([module], index).findings)
+        else:
+            findings.extend(mod.run([module]).findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
     return findings
 
@@ -423,9 +569,69 @@ def render_text(result: AnalysisResult) -> str:
     for problem in result.baseline_problems:
         lines.append(f"baseline problem: {problem}")
     s = result.to_dict()["summary"]
+    cache_note = ""
+    if result.cache_info is not None:
+        cache_note = " [cache hit]" if result.cache_info.get("hit") \
+            else " [cache miss]"
     lines.append(
         f"tpumnist-lint: {s['files']} files, {s['findings']} finding(s), "
         f"{s['suppressed']} baselined, {s['stale_baseline']} stale "
         f"baseline entr{'y' if s['stale_baseline'] == 1 else 'ies'} -> "
-        f"{'OK' if result.ok else 'FAIL'}")
+        f"{'OK' if result.ok else 'FAIL'}{cache_note}")
     return "\n".join(lines)
+
+
+def render_sarif(result: AnalysisResult) -> str:
+    """Minimal valid SARIF 2.1.0: one run, one rule per checker, one
+    result per finding; baselined findings appear with an external
+    suppression carrying the baseline justification."""
+    registry = checker_registry()
+    rule_ids = sorted({*result.checkers,
+                       *(f.checker for f in result.findings),
+                       *(f.checker for f, _ in result.suppressed)})
+    rules = []
+    for cid in rule_ids:
+        mod = registry.get(cid)
+        doc = (getattr(mod, "__doc__", "") or "").strip().splitlines()
+        rules.append({
+            "id": cid,
+            "shortDescription": {"text": doc[0] if doc else cid},
+        })
+
+    def _sarif_result(f: Finding, entry: Optional[Dict] = None) -> Dict:
+        text = f.message
+        if f.hint:
+            text += f" (hint: {f.hint})"
+        r: Dict = {
+            "ruleId": f.checker,
+            "level": "error",
+            "message": {"text": text},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+            }],
+        }
+        if entry is not None:
+            r["suppressions"] = [{
+                "kind": "external",
+                "justification": str(entry.get("justification", "")),
+            }]
+        return r
+
+    sarif = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpumnist-lint",
+                "version": f"{SCHEMA_VERSION}.0.0",
+                "rules": rules,
+            }},
+            "results": [_sarif_result(f) for f in result.findings]
+            + [_sarif_result(f, e) for f, e in result.suppressed],
+        }],
+    }
+    return json.dumps(sarif, indent=2, sort_keys=True)

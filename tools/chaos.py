@@ -322,6 +322,21 @@ def _boot_router(env: dict, backend_urls: list, timeout: float,
     return router, log, url
 
 
+def _communicate_reaped(proc: subprocess.Popen, timeout: float):
+    """``communicate()`` that cannot orphan: on a timeout expiry — or
+    any other failure — the child is killed and waited before the error
+    propagates. The original shape reaped only on the happy path, and a
+    ``TimeoutExpired`` left an orphan loadgen hammering a server the
+    twin was about to kill (the PR 10 incident; thread-lifecycle pins
+    this)."""
+    try:
+        return proc.communicate(timeout=timeout)
+    except BaseException:
+        proc.kill()
+        proc.wait()
+        raise
+
+
 def _loadgen_report(proc_out: str) -> dict:
     line = proc_out.strip().splitlines()[-1] if proc_out.strip() else "{}"
     print(line)
@@ -384,7 +399,7 @@ def run_autoscale_spike(args) -> int:
         lg = subprocess.Popen(loadgen_spike + ["--url", url],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)
-        out, _ = lg.communicate(timeout=args.timeout)
+        out, _ = _communicate_reaped(lg, args.timeout)
         _loadgen_report(out)
         stats = _get_json(url, "/stats")
         scaler = stats.get("autoscaler") or {}
@@ -425,7 +440,7 @@ def run_autoscale_spike(args) -> int:
                 scaled_up = True
                 break
             time.sleep(0.3)
-        out, _ = lg.communicate(timeout=args.timeout)
+        out, _ = _communicate_reaped(lg, args.timeout)
         report = _loadgen_report(out)
         if not scaled_up:
             stats = _get_json(url, "/stats")
@@ -498,8 +513,8 @@ def run_quota_abuse(args) -> int:
              "--duration", str(duration), "--client-id", "good",
              "--timeout", "20"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        hog_out, _ = hog.communicate(timeout=args.timeout)
-        good_out, _ = good.communicate(timeout=args.timeout)
+        hog_out, _ = _communicate_reaped(hog, args.timeout)
+        good_out, _ = _communicate_reaped(good, args.timeout)
         hog_report = _loadgen_report(hog_out)
         good_report = _loadgen_report(good_out)
         if not hog_report.get("quota_rejected"):
@@ -603,7 +618,7 @@ def run_cache_storm(args) -> int:
             _say(f"hot reload never landed (model_epoch={epoch}, want "
                  f"{new_epoch})")
             return 1
-        out, _ = storm.communicate(timeout=args.timeout)
+        out, _ = _communicate_reaped(storm, args.timeout)
         report = _loadgen_report(out)
         sends = _sends(report)
         dropped = (report.get("transport_errors", 0)
@@ -741,7 +756,7 @@ def run_serve_chaos(args) -> int:
             reply = _post_json(url, "/resize", {"serve_devices": target})
             _say(f"/resize -> {target} replicas: topology generation "
                  f"{reply['new']['topology_generation']}")
-        out, _ = loadgen.communicate(timeout=args.timeout)
+        out, _ = _communicate_reaped(loadgen, args.timeout)
         loadgen_rc = loadgen.returncode
         loadgen = None  # reaped; nothing left for the finally to kill
         report_line = out.strip().splitlines()[-1] if out.strip() else "{}"
@@ -970,7 +985,7 @@ def run_fleet_chaos(args) -> int:
             _say(f"SIGKILL backend {args.kill_backend} ({victim[3]})")
             victim[0].kill()
             victim[0].wait()
-            out, _ = loadgen.communicate(timeout=args.timeout)
+            out, _ = _communicate_reaped(loadgen, args.timeout)
             report = _loadgen_report(out)
             answered = sum(report.get("status_counts", {}).values())
             dropped = (report.get("transport_errors", 0)
@@ -1040,7 +1055,7 @@ def run_fleet_chaos(args) -> int:
                 _say(f"rolling reload failed: {reply}")
                 return 1
             _say(f"rolled epoch 1 onto {reply['updated']}")
-            out, _ = loadgen.communicate(timeout=args.timeout)
+            out, _ = _communicate_reaped(loadgen, args.timeout)
             report = _loadgen_report(out)
             answered = sum(report.get("status_counts", {}).values())
             dropped = (report.get("transport_errors", 0)
@@ -1157,7 +1172,7 @@ def run_fleet_chaos(args) -> int:
                 if not converged:
                     time.sleep(0.2)
             consistency_s = time.monotonic() - t0
-            out, _ = loadgen.communicate(timeout=args.timeout)
+            out, _ = _communicate_reaped(loadgen, args.timeout)
             report = _loadgen_report(out)
             answered = sum(report.get("status_counts", {}).values())
             dropped = (report.get("transport_errors", 0)

@@ -3,7 +3,12 @@
 # gate tests. Mirrors exactly what CI enforces:
 #
 #   tools/lint.sh            # all three stages
-#   tools/lint.sh --fast     # analyzer only (milliseconds, no pytest)
+#   tools/lint.sh --fast     # analyzer only (the gate itself; the
+#                            # warm findings cache makes re-runs
+#                            # near-instant)
+#   tools/lint.sh --changed  # analyzer only, scoped to git-changed
+#                            # files PLUS their reverse dependencies
+#                            # from the cross-module import graph
 #
 # Exit code: first failing stage's code. Ruff is optional tooling — a
 # missing binary prints a SKIP (the pytest gate skips the same way).
@@ -16,11 +21,17 @@ fail=0
 # not overwrite it — the analyzer's 1-vs-2 exit contract survives).
 note() { if [ "$fail" -eq 0 ]; then fail=$1; fi; }
 
+analyzer_flags=()
+if [ "${1:-}" = "--changed" ]; then
+  analyzer_flags+=(--changed)
+fi
+
 echo "== tpumnist-lint (tools/analyzer) =="
-python -m tools.analyzer pytorch_distributed_mnist_tpu tools bench.py \
+python -m tools.analyzer "${analyzer_flags[@]+"${analyzer_flags[@]}"}" \
+  pytorch_distributed_mnist_tpu tools bench.py \
   || note $?
 
-if [ "${1:-}" = "--fast" ]; then
+if [ "${1:-}" = "--fast" ] || [ "${1:-}" = "--changed" ]; then
   exit "$fail"
 fi
 
